@@ -1,0 +1,22 @@
+"""isotope-tpu: TPU-native service-mesh traffic laboratory.
+
+Re-implements the capabilities of istio-isotope (istio/tools) — declarative
+service-graph topologies, mock-service execution semantics, load generation,
+and Fortio/Prometheus-compatible metrics — as a vectorized discrete-event
+simulation compiled with JAX for TPU meshes.
+
+Layer map (mirrors SURVEY.md §1):
+  models/    L0 graph IR: Service/Script/Command types, YAML codec, validation,
+             topology generators.
+  ops/       graph -> tensor-plan compiler + the jitted event-step engine
+             (the TPU-native analogue of isotope/service's script executor).
+  parallel/  mesh construction and sharded execution (pjit/shard_map).
+  metrics/   Fortio-style percentile summaries and isotope's five Prometheus
+             series, drop-in compatible layouts.
+  convert/   parity exporters: Kubernetes manifests and Graphviz DOT.
+  utils/     Go-compatible duration parsing, config loading.
+"""
+
+__version__ = "0.1.0"
+
+from isotope_tpu.models.graph import ServiceGraph  # noqa: F401

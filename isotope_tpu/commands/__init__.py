@@ -1,0 +1,15 @@
+"""CLI subcommand registry."""
+from __future__ import annotations
+
+
+def register_all(sub) -> None:
+    from isotope_tpu.commands import convert_cmd, generate_cmd
+
+    convert_cmd.register(sub)
+    generate_cmd.register(sub)
+    try:
+        from isotope_tpu.commands import simulate_cmd
+
+        simulate_cmd.register(sub)
+    except ImportError:  # jax not importable in a minimal env
+        pass

@@ -1,0 +1,70 @@
+"""``isotope-tpu kubernetes`` and ``isotope-tpu graphviz`` subcommands.
+
+Mirror the reference converter CLI (isotope/convert/cmd/kubernetes.go:30-90,
+cmd/graphviz.go:28-48).
+"""
+from __future__ import annotations
+
+import sys
+
+from isotope_tpu.convert import graphviz as graphviz_mod
+from isotope_tpu.convert import kubernetes as k8s_mod
+from isotope_tpu.models.graph import ServiceGraph
+
+
+def register(sub) -> None:
+    k8s = sub.add_parser(
+        "kubernetes",
+        help="convert a topology YAML to Kubernetes manifests (stdout)",
+    )
+    k8s.add_argument("topology", help="path to the service graph YAML")
+    k8s.add_argument(
+        "--service-image", default=k8s_mod.DEFAULT_SERVICE_IMAGE
+    )
+    k8s.add_argument("--client-image", default=k8s_mod.DEFAULT_CLIENT_IMAGE)
+    k8s.add_argument(
+        "--environment-name",
+        default="NONE",
+        choices=["NONE", "ISTIO"],
+        help="mesh environment (cmd/kubernetes.go:78)",
+    )
+    k8s.add_argument(
+        "--max-idle-connections-per-host", type=int, default=0
+    )
+    k8s.set_defaults(func=run_kubernetes)
+
+    gv = sub.add_parser(
+        "graphviz", help="convert a topology YAML to Graphviz DOT"
+    )
+    gv.add_argument("topology")
+    gv.add_argument(
+        "output", nargs="?", help="output file (default: stdout)"
+    )
+    gv.set_defaults(func=run_graphviz)
+
+
+def run_kubernetes(args) -> int:
+    with open(args.topology) as f:
+        topology_yaml = f.read()
+    graph = ServiceGraph.from_yaml(topology_yaml)
+    k8s_mod.validate_service_types(graph)
+    opts = k8s_mod.ConvertOptions(
+        service_image=args.service_image,
+        client_image=args.client_image,
+        environment_name=args.environment_name,
+        max_idle_connections_per_host=args.max_idle_connections_per_host,
+    )
+    manifests = k8s_mod.service_graph_to_manifests(graph, topology_yaml, opts)
+    sys.stdout.write(k8s_mod.manifests_to_yaml(manifests))
+    return 0
+
+
+def run_graphviz(args) -> int:
+    graph = ServiceGraph.from_yaml_file(args.topology)
+    dot = graphviz_mod.to_dot(graph)
+    if args.output:
+        with open(args.output, "w") as f:
+            f.write(dot)
+    else:
+        sys.stdout.write(dot)
+    return 0

@@ -1,0 +1,86 @@
+"""``isotope-tpu generate`` subcommand: synthetic topologies.
+
+Mirrors isotope/create_tree_topology.py and create_realistic_topology.py,
+with the constants promoted to flags (the reference Makefile passes --type
+flags the scripts never parsed — isotope/Makefile:30-72 vs
+create_realistic_topology.py:159-165; here they work).
+"""
+from __future__ import annotations
+
+import sys
+
+import yaml
+
+from isotope_tpu.models import generators
+
+
+def register(sub) -> None:
+    gen = sub.add_parser("generate", help="generate a topology YAML")
+    kind = gen.add_subparsers(dest="kind", required=True)
+
+    tree = kind.add_parser("tree", help="BFS-complete tree topology")
+    tree.add_argument("--levels", type=int, default=3)
+    tree.add_argument("--branches", type=int, default=3)
+    tree.add_argument("--request-size", type=int, default=128)
+    tree.add_argument("--response-size", type=int, default=128)
+    tree.add_argument("--num-replicas", type=int, default=1)
+    tree.add_argument(
+        "--sleep", default=None, help='per-service sleep, e.g. "10ms"'
+    )
+    tree.add_argument("-o", "--output", default=None)
+    tree.set_defaults(func=run_tree)
+
+    real = kind.add_parser(
+        "realistic", help="scale-free Barabasi-Albert topology"
+    )
+    real.add_argument("--services", type=int, default=10)
+    real.add_argument(
+        "--type",
+        dest="archetype",
+        default="multitier",
+        choices=sorted(generators.ARCHETYPES),
+    )
+    real.add_argument("--request-size", type=int, default=128)
+    real.add_argument("--response-size", type=int, default=128)
+    real.add_argument("--num-replicas", type=int, default=1)
+    real.add_argument("--seed", type=int, default=0)
+    real.add_argument("-o", "--output", default=None)
+    real.set_defaults(func=run_realistic)
+
+
+def _emit(doc: dict, output) -> int:
+    text = yaml.safe_dump(doc, default_flow_style=False, sort_keys=False)
+    if output:
+        with open(output, "w") as f:
+            f.write(text)
+    else:
+        sys.stdout.write(text)
+    return 0
+
+
+def run_tree(args) -> int:
+    return _emit(
+        generators.tree_topology(
+            num_levels=args.levels,
+            num_branches=args.branches,
+            request_size=args.request_size,
+            response_size=args.response_size,
+            num_replicas=args.num_replicas,
+            sleep=args.sleep,
+        ),
+        args.output,
+    )
+
+
+def run_realistic(args) -> int:
+    return _emit(
+        generators.realistic_topology(
+            num_services=args.services,
+            archetype=args.archetype,
+            request_size=args.request_size,
+            response_size=args.response_size,
+            num_replicas=args.num_replicas,
+            seed=args.seed,
+        ),
+        args.output,
+    )

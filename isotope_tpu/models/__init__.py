@@ -1,0 +1,25 @@
+from isotope_tpu.models.pct import Percentage
+from isotope_tpu.models.size import ByteSize
+from isotope_tpu.models.svctype import ServiceType
+from isotope_tpu.models.script import (
+    Command,
+    ConcurrentCommand,
+    RequestCommand,
+    Script,
+    SleepCommand,
+)
+from isotope_tpu.models.service import Service
+from isotope_tpu.models.graph import ServiceGraph
+
+__all__ = [
+    "Percentage",
+    "ByteSize",
+    "ServiceType",
+    "Command",
+    "SleepCommand",
+    "RequestCommand",
+    "ConcurrentCommand",
+    "Script",
+    "Service",
+    "ServiceGraph",
+]

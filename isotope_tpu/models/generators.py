@@ -1,0 +1,144 @@
+"""Synthetic topology generators.
+
+Capability parity with the reference's two generators:
+
+- ``tree_topology``: BFS-complete trees where each service calls its
+  children in ONE concurrent step (isotope/create_tree_topology.py:24-80),
+  generalized so depth/branching/sizes are parameters instead of constants.
+- ``realistic_topology``: scale-free Barabási-Albert graphs with the four
+  archetypes from isotope/create_realistic_topology.py:55-99 — star(0.9,
+  0.01), multitier(0.9, 3.25), auxiliary-services(0.05, 3.25),
+  star-auxiliary(0.05, 0.01) — with edges reversed so node 0 is the source
+  (:34-47), node 0 the entrypoint, and children called SEQUENTIALLY
+  (:176-187). The BA process is implemented directly in numpy (nonlinear
+  preferential attachment, m=1) instead of igraph.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import numpy as np
+
+ARCHETYPES: Dict[str, tuple] = {
+    # name -> (power, zero_appeal); create_realistic_topology.py:55-78
+    "star": (0.9, 0.01),
+    "multitier": (0.9, 3.25),
+    "auxiliary-services": (0.05, 3.25),
+    "star-auxiliary": (0.05, 0.01),
+}
+
+
+def tree_topology(
+    num_levels: int = 3,
+    num_branches: int = 3,
+    request_size: int = 128,
+    response_size: int = 128,
+    num_replicas: int = 1,
+    sleep: Optional[str] = None,
+) -> dict:
+    """Complete tree; each parent calls all children in one concurrent step.
+
+    Service naming follows the reference's path scheme: root "svc-0",
+    children "svc-0-0", "svc-0-1", ... (create_tree_topology.py:47-57).
+    """
+    num_services = sum(num_branches**i for i in range(num_levels))
+    services: List[dict] = []
+    queue: List[tuple] = [({"name": "svc-0", "isEntrypoint": True}, ["0"])]
+    while queue and len(services) < num_services:
+        current, path = queue.pop(0)
+        services.append(current)
+        remaining = num_services - len(services) - len(queue)
+        if remaining > 0:
+            children = []
+            for i in range(min(num_branches, remaining)):
+                child_path = path + [str(i)]
+                child = {"name": "svc-" + "-".join(child_path)}
+                children.append(child)
+                queue.append((child, child_path))
+            step = [{"call": c["name"]} for c in children]
+            if sleep:
+                current["script"] = [{"sleep": sleep}, step]
+            else:
+                current["script"] = [step]
+    return {
+        "defaults": {
+            "requestSize": request_size,
+            "responseSize": response_size,
+            "numReplicas": num_replicas,
+        },
+        "services": services,
+    }
+
+
+def barabasi_albert_edges(
+    n: int,
+    power: float,
+    zero_appeal: float,
+    rng: np.random.Generator,
+) -> np.ndarray:
+    """Nonlinear preferential attachment with m=1 (igraph Barabasi
+    semantics: new node j attaches to existing i with probability
+    proportional to in_degree(i)**power + zero_appeal).
+
+    Returns an array of (source, target) pairs where source is the NEW node
+    — the reference then reverses edges so node 0 becomes the root caller
+    (create_realistic_topology.py:34-47); we emit caller->callee directly
+    by treating the attachment target as the callee's caller, i.e. edge
+    (target -> source) after reversal. Here we return (parent, child) pairs
+    with parent < child, matching the reversed orientation.
+    """
+    if n < 1:
+        raise ValueError("need at least one node")
+    edges = np.empty((max(n - 1, 0), 2), dtype=np.int64)
+    in_degree = np.zeros(n, dtype=np.float64)
+    for j in range(1, n):
+        weights = in_degree[:j] ** power + zero_appeal
+        probs = weights / weights.sum()
+        target = rng.choice(j, p=probs)
+        # igraph edge j->target; reversed: target is the caller of j.
+        edges[j - 1] = (target, j)
+        in_degree[target] += 1
+    return edges
+
+
+def realistic_topology(
+    num_services: int = 10,
+    archetype: str = "multitier",
+    request_size: int = 128,
+    response_size: int = 128,
+    num_replicas: int = 1,
+    seed: int = 0,
+    name_prefix: str = "mock-",
+) -> dict:
+    """Scale-free topology; node 0 is the entrypoint, children are called
+    sequentially (one call step each, create_realistic_topology.py:176-187).
+    """
+    if archetype not in ARCHETYPES:
+        raise ValueError(
+            f"there is no graph model named as {archetype}; "
+            f"try either: {sorted(ARCHETYPES)}"
+        )
+    power, zero_appeal = ARCHETYPES[archetype]
+    rng = np.random.default_rng(seed)
+    edges = barabasi_albert_edges(num_services, power, zero_appeal, rng)
+    children: List[List[int]] = [[] for _ in range(num_services)]
+    for parent, child in edges:
+        children[int(parent)].append(int(child))
+    services = []
+    for i in range(num_services):
+        svc: dict = {"name": f"{name_prefix}{i}"}
+        if i == 0:
+            svc["isEntrypoint"] = True
+        if children[i]:
+            svc["script"] = [
+                {"call": f"{name_prefix}{c}"} for c in children[i]
+            ]
+        services.append(svc)
+    return {
+        "defaults": {
+            "requestSize": request_size,
+            "responseSize": response_size,
+            "numReplicas": num_replicas,
+        },
+        "services": services,
+    }

@@ -1,0 +1,186 @@
+"""ServiceGraph: the L0 topology IR.
+
+Mirrors ``graph.ServiceGraph`` (isotope/convert/pkg/graph/graph.go:21-23)
+plus the decode pipeline (unmarshal.go:30-112): a top-level ``defaults``
+block seeds per-service and per-call defaults (type=http, numReplicas=1 when
+absent), then each service is decoded against those defaults and the result
+is validated (validation.go:28-67): every call must target a defined
+service, and concurrent commands may not nest.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import List
+
+import yaml
+
+from isotope_tpu.models.pct import Percentage
+from isotope_tpu.models.script import (
+    ConcurrentCommand,
+    RequestCommand,
+    Script,
+)
+from isotope_tpu.models.service import Service, decode_strict_int
+from isotope_tpu.models.size import ByteSize
+from isotope_tpu.models.svctype import ServiceType
+
+
+class RequestToUndefinedServiceError(ValueError):
+    def __init__(self, service_name: str):
+        self.service_name = service_name
+        super().__init__(f'cannot call undefined service "{service_name}"')
+
+
+class NestedConcurrentCommandError(ValueError):
+    def __init__(self):
+        super().__init__("concurrent commands may not be nested")
+
+
+_DEFAULTS_FIELDS = {
+    "type",
+    "errorRate",
+    "responseSize",
+    "script",
+    "requestSize",
+    "numReplicas",
+    "numRbacPolicies",
+}
+
+
+@dataclasses.dataclass
+class ServiceGraph:
+    services: List[Service] = dataclasses.field(default_factory=list)
+    # Retained so encode() can round-trip the defaults block.
+    defaults: dict = dataclasses.field(default_factory=dict)
+
+    # -- decode ------------------------------------------------------------
+
+    @classmethod
+    def decode(cls, doc: dict) -> "ServiceGraph":
+        if not isinstance(doc, dict):
+            raise ValueError(f"service graph must be a mapping: {doc!r}")
+        raw_defaults = doc.get("defaults") or {}
+        default_service, default_request = _effective_defaults(raw_defaults)
+        services = [
+            Service.decode(s, default_service, default_request)
+            for s in (doc.get("services") or [])
+        ]
+        graph = cls(services=services, defaults=dict(raw_defaults))
+        graph.validate()
+        return graph
+
+    @classmethod
+    def from_yaml(cls, text: str) -> "ServiceGraph":
+        return cls.decode(yaml.safe_load(text))
+
+    @classmethod
+    def from_yaml_file(cls, path) -> "ServiceGraph":
+        with open(path) as f:
+            return cls.decode(yaml.safe_load(f))
+
+    # -- encode ------------------------------------------------------------
+
+    def encode(self) -> dict:
+        out: dict = {}
+        if self.defaults:
+            out["defaults"] = dict(self.defaults)
+        default_service, _ = _effective_defaults(self.defaults)
+        out["services"] = [s.encode(default_service) for s in self.services]
+        return out
+
+    def to_yaml(self) -> str:
+        return yaml.safe_dump(
+            self.encode(), default_flow_style=False, sort_keys=False
+        )
+
+    # -- validation (validation.go:28-67) ----------------------------------
+
+    def validate(self) -> None:
+        names = {s.name for s in self.services}
+        for service in self.services:
+            _validate_commands(service.script, names)
+
+    # -- convenience -------------------------------------------------------
+
+    def service_names(self) -> List[str]:
+        return [s.name for s in self.services]
+
+    def entrypoints(self) -> List[Service]:
+        return [s for s in self.services if s.is_entrypoint]
+
+    def __len__(self) -> int:
+        return len(self.services)
+
+
+def _effective_defaults(raw_defaults: dict):
+    """Build the effective per-service / per-call defaults from a raw
+    ``defaults`` block (unmarshal.go:66-112)."""
+    unknown = set(raw_defaults) - _DEFAULTS_FIELDS
+    if unknown:
+        raise ValueError(f"unknown defaults fields: {sorted(unknown)}")
+
+    # Per-call default: requestSize seeds RequestCommand.Size
+    # (unmarshal.go:104-107).
+    default_request = RequestCommand(
+        service_name="",
+        size=(
+            ByteSize.decode(raw_defaults["requestSize"])
+            if "requestSize" in raw_defaults
+            else ByteSize(0)
+        ),
+    )
+    # Per-service defaults (unmarshal.go:66-73, 96-103): type=http,
+    # numReplicas=1 unless overridden.
+    default_service = Service(
+        name="",
+        type=(
+            ServiceType.decode(raw_defaults["type"])
+            if "type" in raw_defaults
+            else ServiceType.HTTP
+        ),
+        num_replicas=(
+            decode_strict_int(raw_defaults["numReplicas"], "numReplicas")
+            if "numReplicas" in raw_defaults
+            else 1
+        ),
+        error_rate=(
+            Percentage.decode(raw_defaults["errorRate"])
+            if "errorRate" in raw_defaults
+            else Percentage(0.0)
+        ),
+        response_size=(
+            ByteSize.decode(raw_defaults["responseSize"])
+            if "responseSize" in raw_defaults
+            else ByteSize(0)
+        ),
+        # In the reference the defaults block is unmarshaled in the
+        # metadata pass BEFORE DefaultRequestCommand is installed
+        # (unmarshal.go:30-43), so calls inside the defaults script do
+        # NOT inherit requestSize — they get a zero-size default.
+        script=(
+            Script.decode(
+                raw_defaults["script"], RequestCommand(service_name="")
+            )
+            if "script" in raw_defaults
+            else Script()
+        ),
+        num_rbac_policies=(
+            decode_strict_int(
+                raw_defaults["numRbacPolicies"], "numRbacPolicies"
+            )
+            if "numRbacPolicies" in raw_defaults
+            else 0
+        ),
+    )
+    return default_service, default_request
+
+
+def _validate_commands(cmds, names) -> None:
+    for cmd in cmds:
+        if isinstance(cmd, RequestCommand):
+            if cmd.service_name not in names:
+                raise RequestToUndefinedServiceError(cmd.service_name)
+        elif isinstance(cmd, ConcurrentCommand):
+            _validate_commands(cmd, names)
+            if any(isinstance(sub, ConcurrentCommand) for sub in cmd):
+                raise NestedConcurrentCommandError()

@@ -1,0 +1,14 @@
+"""Test configuration: force an 8-device virtual CPU mesh.
+
+All sharding tests run against ``jax.sharding.Mesh`` over 8 virtual CPU
+devices so multi-chip paths are exercised without TPU hardware (the driver
+separately dry-runs ``__graft_entry__.dryrun_multichip``).
+"""
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()

@@ -1,0 +1,89 @@
+"""Converter (kubernetes + graphviz) tests.
+
+Mirrors the reference's graphviz golden test and the manifest generator's
+structure (kubernetes.go:56-137).
+"""
+import yaml
+
+from isotope_tpu.convert import graphviz as gv
+from isotope_tpu.convert import kubernetes as k8s
+from isotope_tpu.models.graph import ServiceGraph
+
+CANONICAL = "examples/topologies/canonical.yaml"
+
+
+def _manifests(environment="NONE"):
+    with open(CANONICAL) as f:
+        text = f.read()
+    graph = ServiceGraph.from_yaml(text)
+    opts = k8s.ConvertOptions(environment_name=environment)
+    return graph, k8s.service_graph_to_manifests(graph, text, opts)
+
+
+def test_manifest_kinds_and_counts():
+    graph, manifests = _manifests()
+    kinds = [m["kind"] for m in manifests]
+    # Namespace + ConfigMap + 4x(Service+Deployment) + fortio client
+    # Deployment+Service (kubernetes.go:56-137, fortio_client.go:28-78).
+    assert kinds.count("Namespace") == 1
+    assert kinds.count("ConfigMap") == 1
+    assert kinds.count("Service") == 4 + 1
+    assert kinds.count("Deployment") == 4 + 1
+
+
+def test_namespace_istio_injection():
+    _, manifests = _manifests()
+    ns = manifests[0]
+    assert ns["metadata"]["labels"] == {"istio-injection": "enabled"}
+
+
+def test_config_map_embeds_topology():
+    graph, manifests = _manifests()
+    cm = manifests[1]
+    embedded = yaml.safe_load(cm["data"]["service-graph.yaml"])
+    assert ServiceGraph.decode(embedded).service_names() == graph.service_names()
+
+
+def test_deployment_env_and_mount():
+    _, manifests = _manifests()
+    dep = next(
+        m
+        for m in manifests
+        if m["kind"] == "Deployment" and m["metadata"]["name"] == "a"
+    )
+    container = dep["spec"]["template"]["spec"]["containers"][0]
+    env = {e["name"] for e in container["env"]}
+    assert {"SERVICE_NAME", "PODNAME", "PODIP", "NAMESPACE", "NODENAME"} <= env
+    assert container["volumeMounts"][0]["mountPath"] == "/etc/config"
+    annotations = dep["spec"]["template"]["metadata"]["annotations"]
+    assert annotations["prometheus.io/scrape"] == "true"
+
+
+def test_rbac_only_for_istio():
+    _, none_manifests = _manifests("NONE")
+    _, istio_manifests = _manifests("ISTIO")
+    assert not any(m["kind"] == "ServiceRole" for m in none_manifests)
+    roles = [m for m in istio_manifests if m["kind"] == "ServiceRole"]
+    # canonical.yaml: numRbacPolicies 3 via defaults, 4 services.
+    assert len(roles) == 12
+    assert any(m["kind"] == "RbacConfig" for m in istio_manifests)
+
+
+def test_manifests_yaml_parses():
+    _, manifests = _manifests()
+    docs = list(yaml.safe_load_all(k8s.manifests_to_yaml(manifests)))
+    assert len(docs) == len(manifests)
+
+
+def test_dot_output():
+    graph = ServiceGraph.from_yaml_file(CANONICAL)
+    dot = gv.to_dot(graph)
+    assert dot.startswith("digraph {")
+    # every service gets a node; every call gets an edge from its step port
+    for name in "abcd":
+        assert f'"{name}"' in dot
+    assert '"d":s0 -> "a";' in dot
+    assert '"d":s0 -> "c";' in dot
+    assert '"d":s1 -> "b";' in dot
+    assert '"c":s0 -> "a";' in dot
+    assert '"c":s1 -> "b";' in dot

@@ -1,0 +1,56 @@
+"""Go-duration parse/format tests (time.ParseDuration grammar)."""
+import pytest
+
+from isotope_tpu.utils.duration import (
+    InvalidDurationError,
+    format_duration_ns,
+    parse_duration_ns,
+    parse_duration_seconds,
+)
+
+
+@pytest.mark.parametrize(
+    "s,ns",
+    [
+        ("0", 0),
+        ("100ms", 100_000_000),
+        ("1s", 1_000_000_000),
+        ("1.5s", 1_500_000_000),
+        ("10ns", 10),
+        ("5us", 5_000),
+        ("5µs", 5_000),
+        ("2m", 120_000_000_000),
+        ("1h", 3_600_000_000_000),
+        ("1h2m3s", 3_723_000_000_000),
+        ("-5s", -5_000_000_000),
+        ("1m30s", 90_000_000_000),
+    ],
+)
+def test_parse(s, ns):
+    assert parse_duration_ns(s) == ns
+
+
+@pytest.mark.parametrize("s", ["", "5", "abc", "1x", "s", "5 s"])
+def test_parse_invalid(s):
+    with pytest.raises(InvalidDurationError):
+        parse_duration_ns(s)
+
+
+@pytest.mark.parametrize(
+    "ns,s",
+    [
+        (0, "0s"),
+        (10, "10ns"),
+        (5_000, "5µs"),
+        (100_000_000, "100ms"),
+        (1_500_000_000, "1.5s"),
+        (90_000_000_000, "1m30s"),
+        (3_723_000_000_000, "1h2m3s"),
+    ],
+)
+def test_format(ns, s):
+    assert format_duration_ns(ns) == s
+
+
+def test_seconds_roundtrip():
+    assert parse_duration_seconds("250ms") == pytest.approx(0.25)

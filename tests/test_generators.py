@@ -1,0 +1,81 @@
+"""Topology generator tests (tree + realistic)."""
+import numpy as np
+import pytest
+
+from isotope_tpu.models.generators import (
+    ARCHETYPES,
+    barabasi_albert_edges,
+    realistic_topology,
+    tree_topology,
+)
+from isotope_tpu.models.graph import ServiceGraph
+from isotope_tpu.models.script import ConcurrentCommand
+
+
+def test_tree_counts():
+    doc = tree_topology(num_levels=3, num_branches=3)
+    g = ServiceGraph.decode(doc)
+    assert len(g) == 1 + 3 + 9
+    (entry,) = g.entrypoints()
+    assert entry.name == "svc-0"
+
+
+def test_tree_children_called_concurrently():
+    # create_tree_topology.py:79-80: one step that is a list of calls.
+    doc = tree_topology(num_levels=2, num_branches=3)
+    g = ServiceGraph.decode(doc)
+    (entry,) = g.entrypoints()
+    assert len(entry.script) == 1
+    assert isinstance(entry.script[0], ConcurrentCommand)
+    assert len(entry.script[0]) == 3
+
+
+def test_tree_naming_scheme():
+    doc = tree_topology(num_levels=2, num_branches=2)
+    names = {s["name"] for s in doc["services"]}
+    assert names == {"svc-0", "svc-0-0", "svc-0-1"}
+
+
+def test_tree_leaf_has_no_script():
+    doc = tree_topology(num_levels=2, num_branches=2)
+    leaves = [s for s in doc["services"] if s["name"] != "svc-0"]
+    assert all("script" not in s for s in leaves)
+
+
+def test_ba_edges_connected_tree():
+    rng = np.random.default_rng(0)
+    edges = barabasi_albert_edges(50, power=0.9, zero_appeal=3.25, rng=rng)
+    assert edges.shape == (49, 2)
+    # every node except 0 appears exactly once as a child, parent < child
+    assert sorted(edges[:, 1]) == list(range(1, 50))
+    assert (edges[:, 0] < edges[:, 1]).all()
+
+
+@pytest.mark.parametrize("archetype", sorted(ARCHETYPES))
+def test_realistic_valid_graph(archetype):
+    doc = realistic_topology(num_services=30, archetype=archetype, seed=1)
+    g = ServiceGraph.decode(doc)  # validates: no undefined callees
+    assert len(g) == 30
+    (entry,) = g.entrypoints()
+    assert entry.name == "mock-0"
+
+
+def test_realistic_sequential_calls():
+    # create_realistic_topology.py:176-187: children called sequentially.
+    doc = realistic_topology(num_services=20, archetype="star", seed=2)
+    g = ServiceGraph.decode(doc)
+    for svc in g.services:
+        for cmd in svc.script:
+            assert not isinstance(cmd, ConcurrentCommand)
+
+
+def test_realistic_star_is_flat():
+    # power=0.9, zero_appeal=0.01 concentrates attachment on the hub.
+    doc = realistic_topology(num_services=50, archetype="star", seed=3)
+    entry = doc["services"][0]
+    assert len(entry.get("script", [])) > 10
+
+
+def test_realistic_unknown_archetype():
+    with pytest.raises(ValueError):
+        realistic_topology(num_services=5, archetype="mesh")

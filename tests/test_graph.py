@@ -1,0 +1,189 @@
+"""ServiceGraph decode + validation tests.
+
+Coverage mirrors the reference's graph/unmarshal_test.go end-to-end fixture
+(defaults inheritance) and validation.go error cases.
+"""
+import pytest
+
+from isotope_tpu.models.graph import (
+    NestedConcurrentCommandError,
+    RequestToUndefinedServiceError,
+    ServiceGraph,
+)
+from isotope_tpu.models.pct import Percentage
+from isotope_tpu.models.script import (
+    ConcurrentCommand,
+    RequestCommand,
+    SleepCommand,
+)
+from isotope_tpu.models.size import ByteSize
+from isotope_tpu.models.svctype import ServiceType
+
+FULL_YAML = """
+defaults:
+  type: http
+  numReplicas: 2
+  errorRate: 0.1%
+  responseSize: 512
+  requestSize: 128
+services:
+- name: a
+- name: b
+  type: grpc
+  numReplicas: 3
+  errorRate: 5%
+  responseSize: 1k
+- name: c
+  isEntrypoint: true
+  script:
+  - sleep: 100ms
+  - call: a
+  - call: {service: b, size: 256, probability: 50}
+  - - call: a
+    - call: b
+"""
+
+
+def test_decode_defaults_inheritance():
+    g = ServiceGraph.from_yaml(FULL_YAML)
+    a, b, c = g.services
+
+    assert a.name == "a"
+    assert a.type == ServiceType.HTTP
+    assert a.num_replicas == 2
+    assert float(a.error_rate) == pytest.approx(0.001)
+    assert a.response_size == 512
+    assert a.script == []
+
+    assert b.type == ServiceType.GRPC
+    assert b.num_replicas == 3
+    assert float(b.error_rate) == pytest.approx(0.05)
+    assert b.response_size == 1024
+
+    assert c.is_entrypoint
+    assert c.script[0] == SleepCommand(0.1)
+    # string-form call inherits default requestSize 128
+    assert c.script[1] == RequestCommand(service_name="a", size=ByteSize(128))
+    assert c.script[2] == RequestCommand(
+        service_name="b", size=ByteSize(256), probability=50
+    )
+    assert isinstance(c.script[3], ConcurrentCommand)
+
+
+def test_undefined_callee_rejected():
+    with pytest.raises(RequestToUndefinedServiceError):
+        ServiceGraph.from_yaml(
+            """
+services:
+- name: a
+  script:
+  - call: ghost
+"""
+        )
+
+
+def test_nested_concurrent_rejected():
+    with pytest.raises(NestedConcurrentCommandError):
+        ServiceGraph.from_yaml(
+            """
+services:
+- name: a
+- name: b
+  script:
+  - - call: a
+    - - call: a
+      - call: a
+"""
+        )
+
+
+def test_service_requires_name():
+    with pytest.raises(ValueError):
+        ServiceGraph.from_yaml("services:\n- type: http\n")
+
+
+def test_canonical_topology(tmp_path):
+    g = ServiceGraph.from_yaml_file("examples/topologies/canonical.yaml")
+    assert g.service_names() == ["a", "b", "c", "d"]
+    (entry,) = g.entrypoints()
+    assert entry.name == "d"
+    # concurrent first step, then a sequential call
+    assert isinstance(entry.script[0], ConcurrentCommand)
+    assert entry.script[1].service_name == "b"
+    # defaults: 1 KB sizes, 3 rbac policies
+    assert g.services[0].response_size == 1024
+    assert g.services[0].num_rbac_policies == 3
+
+
+def test_yaml_roundtrip():
+    g = ServiceGraph.from_yaml(FULL_YAML)
+    again = ServiceGraph.from_yaml(g.to_yaml())
+    assert again.services == g.services
+
+
+def test_roundtrip_with_overridden_defaults():
+    # Regression: a service field explicitly equal to a BUILT-IN default must
+    # survive encode/decode when the graph-level default differs.
+    g = ServiceGraph.from_yaml(
+        """
+defaults:
+  numReplicas: 3
+  responseSize: 10k
+services:
+- name: a
+  numReplicas: 1
+  responseSize: 0
+- name: b
+"""
+    )
+    again = ServiceGraph.from_yaml(g.to_yaml())
+    assert again.services == g.services
+    assert again.services[0].num_replicas == 1
+    assert int(again.services[0].response_size) == 0
+    assert again.services[1].num_replicas == 3
+
+
+def test_empty_services_key():
+    g = ServiceGraph.from_yaml("services:\n")
+    assert len(g) == 0
+
+
+def test_defaults_script_does_not_inherit_request_size():
+    # unmarshal.go:30-43: the defaults block is parsed before
+    # DefaultRequestCommand is installed, so calls in the defaults script
+    # get size 0, not requestSize.
+    g = ServiceGraph.from_yaml(
+        """
+defaults:
+  requestSize: 10k
+  script:
+  - call: a
+services:
+- name: a
+- name: b
+"""
+    )
+    assert g.services[1].script[0].size == 0
+    # ...while calls in a service's own script DO inherit requestSize.
+    g2 = ServiceGraph.from_yaml(
+        """
+defaults:
+  requestSize: 10k
+services:
+- name: a
+- name: b
+  script:
+  - call: a
+"""
+    )
+    assert g2.services[1].script[0].size == 10240
+
+
+def test_strict_int_fields():
+    for doc in (
+        "services:\n- name: a\n  numReplicas: true\n",
+        "services:\n- name: a\n  numReplicas: 2.9\n",
+        "defaults:\n  numRbacPolicies: 1.5\nservices:\n- name: a\n",
+    ):
+        with pytest.raises(ValueError):
+            ServiceGraph.from_yaml(doc)

@@ -1,0 +1,61 @@
+"""Percentage decode/format tests.
+
+Coverage mirrors the reference's table-driven pct/percentage_test.go.
+"""
+import pytest
+
+from isotope_tpu.models.pct import (
+    InvalidPercentageStringError,
+    OutOfRangeError,
+    Percentage,
+)
+
+
+@pytest.mark.parametrize(
+    "s,expected",
+    [
+        ("0%", 0.0),
+        ("100%", 1.0),
+        ("50%", 0.5),
+        ("0.01%", 0.0001),
+        ("12.5%", 0.125),
+    ],
+)
+def test_from_string(s, expected):
+    assert Percentage.from_string(s) == pytest.approx(expected)
+
+
+@pytest.mark.parametrize("s", ["", "50", "abc%", "%"])
+def test_from_string_invalid(s):
+    with pytest.raises(InvalidPercentageStringError):
+        Percentage.from_string(s)
+
+
+@pytest.mark.parametrize("s", ["101%", "-1%"])
+def test_from_string_out_of_range(s):
+    with pytest.raises(OutOfRangeError):
+        Percentage.from_string(s)
+
+
+@pytest.mark.parametrize("f,ok", [(0.0, True), (1.0, True), (0.5, True), (1.5, False), (-0.5, False)])
+def test_from_float(f, ok):
+    if ok:
+        assert Percentage.from_float(f) == f
+    else:
+        with pytest.raises(OutOfRangeError):
+            Percentage.from_float(f)
+
+
+def test_decode_number_and_string():
+    assert Percentage.decode(0.25) == 0.25
+    assert Percentage.decode("25%") == 0.25
+
+
+def test_str():
+    # percentage.go:28-30: "%0.2f%%" of p*100.
+    assert str(Percentage(0.125)) == "12.50%"
+    assert str(Percentage(1.0)) == "100.00%"
+
+
+def test_encode_is_number():
+    assert Percentage(0.5).encode() == 0.5

@@ -1,0 +1,107 @@
+"""Script / command decode tests.
+
+Coverage mirrors the reference's script/{script,sleep_command,
+request_command,concurrent_command}_test.go table-driven suites.
+"""
+import pytest
+import yaml
+
+from isotope_tpu.models.script import (
+    ConcurrentCommand,
+    InvalidCommandError,
+    MultipleKeysInCommandError,
+    RequestCommand,
+    Script,
+    SleepCommand,
+    UnknownCommandKeyError,
+    decode_command,
+)
+from isotope_tpu.models.size import ByteSize
+
+NO_DEFAULT = RequestCommand(service_name="")
+
+
+def decode(doc, default=NO_DEFAULT):
+    return Script.decode(yaml.safe_load(doc), default)
+
+
+def test_sleep_command():
+    (cmd,) = decode("- sleep: 100ms")
+    assert cmd == SleepCommand(0.1)
+
+
+def test_call_string_form():
+    (cmd,) = decode("- call: a")
+    assert cmd == RequestCommand(service_name="a")
+
+
+def test_call_string_form_inherits_default_size():
+    default = RequestCommand(service_name="", size=ByteSize(128))
+    (cmd,) = decode("- call: a", default)
+    assert cmd.size == 128
+
+
+def test_call_object_form():
+    (cmd,) = decode("- call: {service: b, size: 1k, probability: 30}")
+    assert cmd == RequestCommand(service_name="b", size=ByteSize(1024), probability=30)
+    assert cmd.send_probability == pytest.approx(0.3)
+
+
+def test_probability_zero_means_always():
+    (cmd,) = decode("- call: a")
+    assert cmd.probability == 0
+    assert cmd.send_probability == 1.0
+
+
+@pytest.mark.parametrize("p", [-1, 101])
+def test_probability_out_of_range(p):
+    with pytest.raises(InvalidCommandError):
+        decode(f"- call: {{service: a, probability: {p}}}")
+
+
+def test_concurrent_command_from_list():
+    (cmd,) = decode(
+        """
+- - call: a
+  - call: b
+  - sleep: 10ms
+"""
+    )
+    assert isinstance(cmd, ConcurrentCommand)
+    assert len(cmd) == 3
+    assert cmd[0] == RequestCommand(service_name="a")
+    assert cmd[2] == SleepCommand(0.01)
+
+
+def test_sequential_script_order():
+    script = decode(
+        """
+- sleep: 10ms
+- call: a
+- call: b
+"""
+    )
+    assert [type(c) for c in script] == [SleepCommand, RequestCommand, RequestCommand]
+
+
+def test_multiple_keys_error():
+    with pytest.raises(MultipleKeysInCommandError):
+        decode_command({"sleep": "1s", "call": "a"}, NO_DEFAULT)
+
+
+def test_unknown_key_error():
+    with pytest.raises(UnknownCommandKeyError):
+        decode_command({"jump": "1s"}, NO_DEFAULT)
+
+
+def test_encode_roundtrip():
+    doc = """
+- sleep: 100ms
+- call: {service: a, size: 1k, probability: 30}
+- - call: b
+  - call: c
+"""
+    script = decode(doc)
+    encoded = script.encode()
+    again = Script.decode(encoded, NO_DEFAULT)
+    assert again == script

@@ -1,0 +1,68 @@
+"""ByteSize decode/format tests.
+
+Coverage mirrors the reference's size/byte_size_test.go (go-units RAMInBytes
+semantics: binary 1024-based, case-insensitive suffixes).
+"""
+import pytest
+
+from isotope_tpu.models.size import (
+    ByteSize,
+    InvalidSizeStringError,
+    NegativeSizeError,
+)
+
+
+@pytest.mark.parametrize(
+    "s,expected",
+    [
+        ("32", 32),
+        ("32b", 32),
+        ("32B", 32),
+        ("32k", 32 * 1024),
+        ("32K", 32 * 1024),
+        ("32kb", 32 * 1024),
+        ("32Kb", 32 * 1024),
+        ("32Mb", 32 * 1024 ** 2),
+        ("32Gb", 32 * 1024 ** 3),
+        ("32Tb", 32 * 1024 ** 4),
+        ("32Pb", 32 * 1024 ** 5),
+        ("16 KiB", 16 * 1024),
+        ("1 KB", 1024),
+        ("0.5k", 512),
+        ("128", 128),
+    ],
+)
+def test_from_string(s, expected):
+    assert ByteSize.from_string(s) == expected
+
+
+@pytest.mark.parametrize("s", ["", "hello", "-32", "32.3.4k", "32 q"])
+def test_from_string_invalid(s):
+    with pytest.raises(InvalidSizeStringError):
+        ByteSize.from_string(s)
+
+
+def test_from_int():
+    assert ByteSize.from_int(100) == 100
+    with pytest.raises(NegativeSizeError):
+        ByteSize.from_int(-1)
+
+
+def test_decode():
+    assert ByteSize.decode(1024) == 1024
+    assert ByteSize.decode("1k") == 1024
+
+
+@pytest.mark.parametrize(
+    "n,s",
+    [
+        (0, "0B"),
+        (128, "128B"),
+        (1024, "1KiB"),
+        (1536, "1.5KiB"),
+        (1024 ** 2, "1MiB"),
+    ],
+)
+def test_str(n, s):
+    # go-units BytesSize: %.4g with binary abbreviations.
+    assert str(ByteSize(n)) == s

@@ -1,0 +1,31 @@
+"""ServiceType tests (mirrors svctype/service_type_test.go)."""
+import pytest
+
+from isotope_tpu.models.svctype import (
+    InvalidServiceTypeStringError,
+    ServiceType,
+)
+
+
+@pytest.mark.parametrize(
+    "s,t", [("http", ServiceType.HTTP), ("grpc", ServiceType.GRPC)]
+)
+def test_from_string(s, t):
+    assert ServiceType.from_string(s) == t
+
+
+@pytest.mark.parametrize("s", ["", "HTTP", "tcp", "h2"])
+def test_from_string_invalid(s):
+    with pytest.raises(InvalidServiceTypeStringError):
+        ServiceType.from_string(s)
+
+
+def test_str():
+    assert str(ServiceType.HTTP) == "HTTP"
+    assert str(ServiceType.GRPC) == "gRPC"
+    assert str(ServiceType.UNKNOWN) == ""
+
+
+def test_encode():
+    assert ServiceType.HTTP.encode() == "http"
+    assert ServiceType.GRPC.encode() == "grpc"
