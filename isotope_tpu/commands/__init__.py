@@ -7,9 +7,3 @@ def register_all(sub) -> None:
 
     convert_cmd.register(sub)
     generate_cmd.register(sub)
-    try:
-        from isotope_tpu.commands import simulate_cmd
-
-        simulate_cmd.register(sub)
-    except ImportError:  # jax not importable in a minimal env
-        pass

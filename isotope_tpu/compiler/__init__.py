@@ -1,0 +1,32 @@
+"""Graph compiler: lower the ServiceGraph IR to dense tensors.
+
+This is the TPU-native analogue of the reference's
+``kubernetes.ServiceGraphToKubernetesManifests``
+(isotope/convert/pkg/kubernetes/kubernetes.go:56-137): same input — a
+validated ``ServiceGraph`` — different target.  Instead of k8s manifests
+that *deploy* the topology, we emit static arrays that *simulate* it: a
+per-service parameter table plus the entrypoint's call tree unrolled into a
+level-ordered hop program that the vectorized engine evaluates with pure
+tensor ops.
+"""
+from isotope_tpu.compiler.program import (
+    CompiledGraph,
+    HopLevel,
+    ServiceTable,
+)
+from isotope_tpu.compiler.compile import (
+    CycleError,
+    HopBudgetExceededError,
+    NoEntrypointError,
+    compile_graph,
+)
+
+__all__ = [
+    "CompiledGraph",
+    "HopLevel",
+    "ServiceTable",
+    "CycleError",
+    "HopBudgetExceededError",
+    "NoEntrypointError",
+    "compile_graph",
+]

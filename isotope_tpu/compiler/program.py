@@ -1,0 +1,126 @@
+"""Compiled-program dataclasses (host-side, NumPy).
+
+The compiled form has two parts:
+
+- ``ServiceTable``: per-service parameter arrays (the analogue of the
+  per-service Deployment fields the reference renders,
+  isotope/convert/pkg/kubernetes/kubernetes.go:189-270).
+- the unrolled **hop tree**: every request entering the entrypoint walks a
+  statically known call tree (the recursion of
+  isotope/service/pkg/srv/handler.go:66-76 + executable.go:94-179 over a
+  fixed topology).  Each node of that tree is a *hop* — one service
+  invocation.  Hops are laid out level-by-level (BFS order) so the engine
+  can sweep depth levels with static shapes.
+
+Everything here is plain NumPy; the engine moves it on-device once.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Tuple
+
+import numpy as np
+
+
+@dataclasses.dataclass(frozen=True)
+class ServiceTable:
+    """Per-service parameters, indexed by a dense service id.
+
+    Mirrors ``svc.Service`` (isotope/convert/pkg/graph/svc/service.go:25-51)
+    minus the deployment-only fields (RBAC policy counts live in the k8s
+    converter, not the simulator).
+    """
+
+    names: Tuple[str, ...]
+    replicas: np.ndarray       # (S,) int32  — NumReplicas => queueing servers
+    error_rate: np.ndarray     # (S,) f32    — P(injected 500) in [0, 1]
+    response_size: np.ndarray  # (S,) f32    — bytes
+    is_entrypoint: np.ndarray  # (S,) bool
+
+    @property
+    def num_services(self) -> int:
+        return len(self.names)
+
+    def index_of(self, name: str) -> int:
+        return self.names.index(name)
+
+
+@dataclasses.dataclass(frozen=True)
+class HopLevel:
+    """All hops at one depth of the unrolled call tree.
+
+    ``Pmax`` is the graph-wide maximum script length; every hop's script is
+    padded to it.  Step slots hold either a fixed base duration (sleep
+    commands — including the max over sleeps inside a concurrent group,
+    which run in parallel with the group's calls,
+    srv/executable.go:148-179) or a join over child hops.
+
+    Child arrays describe the hops at depth+1 (in that level's local
+    order): ``child_seg`` maps each child to the flat ``parent_local * Pmax
+    + step`` slot so a scatter-max computes per-step joins — the
+    vectorized form of the reference's WaitGroup join
+    (srv/executable.go:171-175).
+    """
+
+    hop_ids: np.ndarray        # (L,) int32 — global hop ids, level-local order
+    service: np.ndarray        # (L,) int32
+    step_is_real: np.ndarray   # (L, Pmax) bool — slot holds an actual step
+    step_base: np.ndarray      # (L, Pmax) f32 — sleep seconds (0 for calls)
+    child_ids: np.ndarray      # (C,) int32 — global hop ids at depth+1
+    child_seg: np.ndarray      # (C,) int32 — parent_local * Pmax + step
+
+    @property
+    def num_hops(self) -> int:
+        return len(self.hop_ids)
+
+    @property
+    def num_children(self) -> int:
+        return len(self.child_ids)
+
+
+@dataclasses.dataclass(frozen=True)
+class CompiledGraph:
+    """A ServiceGraph lowered for vectorized simulation."""
+
+    services: ServiceTable
+    entry_service: int
+
+    # -- flat hop arrays (H hops, BFS order; hop 0 is the root) ------------
+    hop_service: np.ndarray    # (H,) int32
+    hop_parent: np.ndarray     # (H,) int32 — -1 for the root
+    hop_depth: np.ndarray      # (H,) int32
+    hop_step: np.ndarray       # (H,) int32 — step index in parent's script
+    hop_send_prob: np.ndarray  # (H,) f32 — this hop's own coin, [0, 1]
+    hop_request_size: np.ndarray  # (H,) f32 — bytes sent to the hop
+    # P(hop is reached) = prod over path of send_prob * (1 - parent error
+    # rate); drives offered-load estimates for the queueing model.
+    hop_reach: np.ndarray      # (H,) f64
+
+    levels: Tuple[HopLevel, ...]
+    max_steps: int             # Pmax
+
+    @property
+    def num_hops(self) -> int:
+        return len(self.hop_service)
+
+    @property
+    def num_services(self) -> int:
+        return self.services.num_services
+
+    @property
+    def depth(self) -> int:
+        return len(self.levels)
+
+    def expected_visits(self) -> np.ndarray:
+        """Expected hops per root request, per service (f64, shape (S,)).
+
+        Offered load at service s under root rate R is ``R *
+        expected_visits()[s]`` — the simulator's replacement for measuring
+        per-service request rates off live Prometheus counters
+        (service/pkg/srv/prometheus/handler.go:37-49).
+        """
+        return np.bincount(
+            self.hop_service,
+            weights=self.hop_reach,
+            minlength=self.num_services,
+        )

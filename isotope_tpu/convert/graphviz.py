@@ -33,6 +33,12 @@ def _html_escape(s: str) -> str:
     )
 
 
+def _dot_id(name: str) -> str:
+    """Quote a node id for DOT, escaping quotes/backslashes in names."""
+    escaped = name.replace("\\", "\\\\").replace('"', '\\"')
+    return f'"{escaped}"'
+
+
 def to_dot(graph: ServiceGraph) -> str:
     lines = [
         "digraph {",
@@ -56,12 +62,13 @@ def to_dot(graph: ServiceGraph) -> str:
             + "\n".join(rows)
             + "\n  </table>>"
         )
-        shape = "" if not svc.is_entrypoint else ""
-        lines.append(f'  "{svc.name}" [label={label}]{shape};')
+        lines.append(f"  {_dot_id(svc.name)} [label={label}];")
     for svc in graph.services:
         for i, cmd in enumerate(svc.script):
             for callee in _callees(cmd):
-                lines.append(f'  "{svc.name}":s{i} -> "{callee}";')
+                lines.append(
+                    f"  {_dot_id(svc.name)}:s{i} -> {_dot_id(callee)};"
+                )
     lines.append("}")
     return "\n".join(lines) + "\n"
 

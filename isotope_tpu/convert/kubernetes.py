@@ -48,8 +48,11 @@ class ConvertOptions:
 
 
 def service_graph_to_manifests(
-    graph: ServiceGraph, topology_yaml: str, opts: ConvertOptions = ConvertOptions()
+    graph: ServiceGraph,
+    topology_yaml: str,
+    opts: Optional[ConvertOptions] = None,
 ) -> List[dict]:
+    opts = opts if opts is not None else ConvertOptions()
     manifests: List[dict] = [
         _namespace(),
         _config_map(topology_yaml),

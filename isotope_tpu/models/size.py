@@ -74,6 +74,16 @@ class ByteSize(int):
                 raise InvalidSizeStringError(repr(value))
         return cls.from_int(value)
 
-    def encode(self) -> str:
-        """Marshal as a JSON string (byte_size.go:33-36)."""
-        return str(self)
+    def encode(self):
+        """Marshal for YAML/JSON (byte_size.go:33-36).
+
+        go-units' %.4g formatting is lossy for non-round sizes ("120.6KiB"
+        re-decodes to a different byte count), which would silently perturb
+        payload sizes on a load/save/deploy cycle.  Emit the pretty string
+        only when it round-trips exactly; otherwise emit the plain integer
+        (also valid input, byte_size.go:44-52).
+        """
+        pretty = str(self)
+        if int(ByteSize.from_string(pretty)) == int(self):
+            return pretty
+        return int(self)

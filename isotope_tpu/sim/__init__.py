@@ -1,0 +1,19 @@
+"""Vectorized discrete-event simulation of a compiled service graph.
+
+The TPU-native replacement for running the mock-service fleet for real:
+the reference's per-request script interpreter
+(isotope/service/pkg/srv/executable.go) plus the Fortio load loop
+(perf/benchmark/runner/runner.py:255-268) become one jit-compiled tensor
+program over a (request x hop) event tensor.
+"""
+from isotope_tpu.sim.config import LoadModel, NetworkModel, SimParams
+from isotope_tpu.sim.engine import SimResults, Simulator, simulate
+
+__all__ = [
+    "LoadModel",
+    "NetworkModel",
+    "SimParams",
+    "SimResults",
+    "Simulator",
+    "simulate",
+]

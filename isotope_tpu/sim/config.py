@@ -1,0 +1,89 @@
+"""Simulation parameters: service-time, network, and load models.
+
+These are the knobs the reference distributes across deployment reality —
+vCPU limits on the service pods (isotope/example-config.toml [server]),
+cluster networking, and the Fortio command line
+(perf/benchmark/runner/runner.py:255-268: ``fortio load -c C -qps Q -t
+Ds``).  Here they are explicit, reproducible model parameters.
+"""
+from __future__ import annotations
+
+import dataclasses
+
+
+# The reference's mock service saturates at 12-14k QPS on one vCPU
+# (isotope/service/README.md:28-34) => ~77 microseconds of CPU per request.
+DEFAULT_CPU_TIME_S = 1.0 / 13_000.0
+
+SERVICE_TIME_EXPONENTIAL = "exponential"
+SERVICE_TIME_DETERMINISTIC = "deterministic"
+
+
+@dataclasses.dataclass(frozen=True)
+class NetworkModel:
+    """Per-edge network delay: base one-way latency + bytes / bandwidth.
+
+    The reference's edges are kube-DNS-addressed HTTP/1.1 keep-alive hops
+    through optional Envoy sidecars (srv/request.go:30-48); intra-cluster
+    one-way latency is typically a few hundred microseconds and payloads
+    ride ~10 Gbps NICs.
+    """
+
+    base_latency_s: float = 250e-6
+    bytes_per_second: float = 1.25e9  # 10 Gbit/s
+
+    def one_way(self, size_bytes):
+        return self.base_latency_s + size_bytes / self.bytes_per_second
+
+
+@dataclasses.dataclass(frozen=True)
+class SimParams:
+    """Model parameters fixed at trace time."""
+
+    cpu_time_s: float = DEFAULT_CPU_TIME_S
+    # "exponential" matches the M/M/k queue model exactly (closed-form
+    # validation); "deterministic" uses the fixed CPU demand (an M/D/k
+    # approximation sampled with M/M/k waits).
+    service_time: str = SERVICE_TIME_EXPONENTIAL
+    network: NetworkModel = NetworkModel()
+
+    def __post_init__(self):
+        if self.service_time not in (
+            SERVICE_TIME_EXPONENTIAL,
+            SERVICE_TIME_DETERMINISTIC,
+        ):
+            raise ValueError(f"unknown service_time: {self.service_time!r}")
+        if self.cpu_time_s <= 0:
+            raise ValueError("cpu_time_s must be positive")
+
+
+OPEN_LOOP = "open"
+CLOSED_LOOP = "closed"
+
+
+@dataclasses.dataclass(frozen=True)
+class LoadModel:
+    """The client side of the experiment.
+
+    - ``open``: Poisson arrivals at ``qps`` (Nighthawk's open-loop mode,
+      runner.py:270-316) — arrival times are independent of latencies.
+    - ``closed``: ``connections`` workers each issue requests serially,
+      pacing to ``qps`` overall when it is finite (Fortio's default
+      closed-loop mode, runner.py:255-268; ``qps=None`` is Fortio's
+      ``-qps max``).
+    """
+
+    kind: str = OPEN_LOOP
+    qps: float | None = 1000.0
+    connections: int = 64
+    duration_s: float = 240.0
+
+    def __post_init__(self):
+        if self.kind not in (OPEN_LOOP, CLOSED_LOOP):
+            raise ValueError(f"unknown load model kind: {self.kind!r}")
+        if self.kind == OPEN_LOOP and (self.qps is None or self.qps <= 0):
+            raise ValueError("open-loop load requires a positive qps")
+        if self.qps is not None and self.qps <= 0:
+            raise ValueError("qps must be positive (or None for max)")
+        if self.connections <= 0:
+            raise ValueError("connections must be positive")

@@ -1,0 +1,362 @@
+"""The vectorized event-tree simulation engine.
+
+One jit-compiled tensor program replaces the reference's entire data plane:
+
+- the per-request script interpreter (isotope/service/pkg/srv/handler.go:
+  66-76 + executable.go:43-179) becomes two static sweeps over the depth
+  levels of the unrolled call tree — an upward pass computing each hop's
+  server-side duration (concurrent fan-out joins via scatter-max, the
+  vectorized WaitGroup of executable.go:171-175; sequential steps sum,
+  handler.go:66) and a downward pass assigning absolute start times;
+- Fortio's load loop (perf/benchmark/runner/runner.py:255-268) becomes an
+  arrival-time vector: Poisson cumsum for open-loop, per-connection pacing
+  cumsum for closed-loop;
+- queueing delay at each service is sampled from the analytic M/M/k model
+  (see sim/queueing.py) with k = NumReplicas and offered load derived from
+  the compile-time expected-visit counts;
+- ``errorRate`` — spec'd but never implemented by the reference runtime
+  (SURVEY.md §2.7) — is implemented for real: a hop errors with its
+  service's probability, returns a fast 500 (skips its script), and sends
+  nothing downstream.  Matching executable.go:132-143, a downstream error
+  does NOT fail the caller.
+
+Everything is static-shaped: (num_requests x num_hops) event tensors, depth
+levels unrolled at trace time, RNG via ``jax.random`` keys.
+"""
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import Dict, List, NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from isotope_tpu.compiler.program import CompiledGraph
+from isotope_tpu.sim import queueing
+from isotope_tpu.sim.config import (
+    CLOSED_LOOP,
+    OPEN_LOOP,
+    SERVICE_TIME_DETERMINISTIC,
+    LoadModel,
+    SimParams,
+)
+
+
+class SimResults(NamedTuple):
+    """Raw per-request / per-hop outcomes of one simulated run.
+
+    Hop axis order is the compiled BFS order (level-concatenated).  All
+    times are seconds; ``hop_start`` is when the request *arrives* at the
+    service (before queueing), ``hop_latency`` the server-side duration
+    (wait + script + cpu) — i.e. what the reference's
+    ``service_request_duration_seconds`` histogram observes
+    (srv/prometheus/handler.go:57-61).
+    """
+
+    client_start: jax.Array    # (N,) client send time
+    client_latency: jax.Array  # (N,) client-observed round trip
+    client_error: jax.Array    # (N,) bool — entry service injected a 500
+    hop_sent: jax.Array        # (N, H) bool
+    hop_error: jax.Array       # (N, H) bool (only where sent)
+    hop_latency: jax.Array     # (N, H) f32
+    hop_start: jax.Array       # (N, H) f32
+    utilization: jax.Array     # (S,) rho per service at the offered load
+    unstable: jax.Array        # (S,) bool — offered load >= capacity
+    offered_qps: jax.Array     # scalar f32 — the rate the queues saw
+
+    @property
+    def client_end(self) -> jax.Array:
+        return self.client_start + self.client_latency
+
+    @property
+    def hop_events(self) -> jax.Array:
+        """Total executed hops — the benchmark's unit of work."""
+        return self.hop_sent.sum()
+
+
+@dataclasses.dataclass(frozen=True)
+class _Level:
+    """Device-resident constants for one depth level."""
+
+    offset: int                 # start of this level's slice in hop order
+    size: int
+    pmax: int
+    step_mask: jax.Array        # (L, Pmax) f32 — 1 where a real step
+    step_base: jax.Array        # (L, Pmax) f32
+    child_seg: jax.Array        # (C,) i32 — parent_local * Pmax + step
+    child_parent_local: jax.Array  # (C,) i32
+    child_rtt: jax.Array        # (C,) f32 — request + response wire time
+    child_net_out: jax.Array    # (C,) f32 — one-way request wire time
+    child_send_prob: jax.Array  # (C,) f32
+
+    @property
+    def num_children(self) -> int:
+        return len(self.child_seg)
+
+
+class Simulator:
+    """Holds a compiled graph's device constants and jitted entry points."""
+
+    def __init__(self, compiled: CompiledGraph, params: SimParams = SimParams()):
+        self.compiled = compiled
+        self.params = params
+        t = compiled.services
+        net = params.network
+
+        self._replicas = jnp.asarray(t.replicas)
+        self._k_max = int(t.replicas.max())
+        self._visits = jnp.asarray(compiled.expected_visits(), jnp.float32)
+        self._mu = 1.0 / params.cpu_time_s
+
+        # Per-hop gathers are resolved at trace time (static indices).
+        hs = compiled.hop_service
+        self._hop_service = jnp.asarray(hs)
+        self._hop_err_rate = jnp.asarray(t.error_rate[hs])
+        resp = t.response_size.astype(np.float64)
+        req = compiled.hop_request_size.astype(np.float64)
+        net_out = net.base_latency_s + req / net.bytes_per_second
+        net_back = net.base_latency_s + resp[hs] / net.bytes_per_second
+        self._root_net = float(net_out[0] + net_back[0])
+
+        levels: List[_Level] = []
+        offset = 0
+        for lvl in compiled.levels:
+            cids = lvl.child_ids
+            levels.append(
+                _Level(
+                    offset=offset,
+                    size=lvl.num_hops,
+                    pmax=compiled.max_steps,
+                    step_mask=jnp.asarray(lvl.step_is_real, jnp.float32),
+                    step_base=jnp.asarray(lvl.step_base),
+                    child_seg=jnp.asarray(lvl.child_seg),
+                    child_parent_local=jnp.asarray(
+                        lvl.child_seg // compiled.max_steps
+                    ),
+                    child_rtt=jnp.asarray(
+                        (net_out[cids] + net_back[cids]), jnp.float32
+                    ),
+                    child_net_out=jnp.asarray(net_out[cids], jnp.float32),
+                    child_send_prob=jnp.asarray(
+                        compiled.hop_send_prob[cids]
+                    ),
+                )
+            )
+            offset += lvl.num_hops
+        self._levels: Tuple[_Level, ...] = tuple(levels)
+        self._fns: Dict[Tuple[int, str, bool], "jax.stages.Wrapped"] = {}
+
+    # -- public entry points ----------------------------------------------
+
+    def run(
+        self,
+        load: LoadModel,
+        num_requests: int,
+        key: jax.Array,
+        fixed_point_iters: int = 3,
+    ) -> SimResults:
+        """Simulate ``num_requests`` under ``load``.
+
+        Open-loop: queues see exactly ``load.qps``.  Closed-loop: the rate
+        the queues see is latency-dependent (Fortio's workers self-throttle),
+        so we solve ``lam = min(qps, C / E[latency(lam)], capacity)`` by a
+        few pilot iterations before the full run.
+        """
+        if load.kind == OPEN_LOOP:
+            return self._get(num_requests, OPEN_LOOP)(
+                key, jnp.float32(load.qps), jnp.float32(0.0)
+            )
+        cap = 0.999 * self.capacity_qps()
+        lam = min(load.qps, cap) if load.qps is not None else cap
+        pilot_n = min(num_requests, 2048)
+        pilot = self._get(pilot_n, CLOSED_LOOP, load.connections)
+        gap = (
+            jnp.float32(load.connections / load.qps)
+            if load.qps is not None
+            else jnp.float32(0.0)
+        )
+        for i in range(fixed_point_iters):
+            res = pilot(jax.random.fold_in(key, i), jnp.float32(lam), gap)
+            mean_lat = float(res.client_latency.mean())
+            implied = load.connections / max(mean_lat, 1e-9)
+            lam = min(implied, cap)
+            if load.qps is not None:
+                lam = min(lam, load.qps)
+        return self._get(num_requests, CLOSED_LOOP, load.connections)(
+            key, jnp.float32(lam), gap
+        )
+
+    def capacity_qps(self) -> float:
+        """Saturation throughput: the bottleneck station's capacity."""
+        t = self.compiled.services
+        visits = np.asarray(self._visits)
+        with np.errstate(divide="ignore"):
+            per_svc = np.where(
+                visits > 0,
+                t.replicas * self._mu / np.maximum(visits, 1e-30),
+                np.inf,
+            )
+        return float(per_svc.min())
+
+    # -- jit plumbing ------------------------------------------------------
+
+    def _get(self, n: int, kind: str, connections: int = 0):
+        key = (n, kind, connections)
+        if key not in self._fns:
+            self._fns[key] = jax.jit(
+                partial(self._simulate, n, kind, connections)
+            )
+        return self._fns[key]
+
+    # -- the tensor program ------------------------------------------------
+
+    def _simulate(
+        self,
+        n: int,
+        kind: str,
+        connections: int,
+        key: jax.Array,
+        offered_qps: jax.Array,
+        pace_gap: jax.Array,
+    ) -> SimResults:
+        H = self.compiled.num_hops
+        k_send, k_err, k_wait_u, k_wait_e, k_svc, k_arr = jax.random.split(
+            key, 6
+        )
+        u_send = jax.random.uniform(k_send, (n, H))
+        u_err = jax.random.uniform(k_err, (n, H))
+        u_wait = jax.random.uniform(k_wait_u, (n, H))
+        e_wait = jax.random.exponential(k_wait_e, (n, H))
+
+        # M/M/k parameters at the offered load; gather to hops.
+        qp = queueing.mmk_params(
+            offered_qps * self._visits, self._mu, self._replicas, self._k_max
+        )
+        hop_qp = queueing.QueueParams(
+            p_wait=qp.p_wait[self._hop_service],
+            wait_rate=qp.wait_rate[self._hop_service],
+            utilization=None,
+            unstable=None,
+        )
+        wait = queueing.sample_wait(hop_qp, u_wait, e_wait)  # (N, H)
+        if self.params.service_time == SERVICE_TIME_DETERMINISTIC:
+            svc_time = jnp.full((n, H), self.params.cpu_time_s)
+        else:
+            svc_time = (
+                jax.random.exponential(k_svc, (n, H)) * self.params.cpu_time_s
+            )
+
+        err_coin = u_err < self._hop_err_rate  # (N, H)
+
+        # ---- downward pass 1: which hops actually happen -----------------
+        sent_lvls: List[jax.Array] = [jnp.ones((n, 1), bool)]
+        for lvl in self._levels[:-1]:
+            if lvl.num_children == 0:
+                sent_lvls.append(jnp.zeros((n, 0), bool))
+                continue
+            sl = slice(lvl.offset, lvl.offset + lvl.size)
+            parent_sent = sent_lvls[-1][:, lvl.child_parent_local]
+            parent_err = err_coin[:, sl][:, lvl.child_parent_local]
+            nxt = self._levels[len(sent_lvls)]
+            csl = slice(nxt.offset, nxt.offset + nxt.size)
+            coin = u_send[:, csl] < lvl.child_send_prob
+            sent_lvls.append(parent_sent & ~parent_err & coin)
+
+        # ---- upward pass: server-side durations --------------------------
+        lat_lvls: List[Optional[jax.Array]] = [None] * len(self._levels)
+        off_lvls: List[Optional[jax.Array]] = [None] * len(self._levels)
+        for d in reversed(range(len(self._levels))):
+            lvl = self._levels[d]
+            sl = slice(lvl.offset, lvl.offset + lvl.size)
+            if lvl.num_children > 0:
+                contrib = jnp.where(
+                    sent_lvls[d + 1],
+                    lvl.child_rtt + lat_lvls[d + 1],
+                    0.0,
+                )
+                agg = (
+                    jnp.zeros((n, lvl.size * lvl.pmax))
+                    .at[:, lvl.child_seg]
+                    .max(contrib)
+                    .reshape(n, lvl.size, lvl.pmax)
+                )
+                step_dur = jnp.maximum(lvl.step_base, agg) * lvl.step_mask
+            else:
+                step_dur = (
+                    jnp.broadcast_to(
+                        lvl.step_base, (n, lvl.size, lvl.pmax)
+                    )
+                    * lvl.step_mask
+                )
+            busy = step_dur.sum(-1)
+            errored = err_coin[:, sl]
+            lat_lvls[d] = (
+                wait[:, sl]
+                + svc_time[:, sl]
+                + jnp.where(errored, 0.0, busy)
+            )
+            if lvl.num_children > 0:
+                prefix = jnp.cumsum(step_dur, axis=-1) - step_dur
+                off_lvls[d] = prefix.reshape(n, -1)[:, lvl.child_seg]
+
+        # ---- arrivals ----------------------------------------------------
+        root_lat = self._root_net + lat_lvls[0][:, 0]
+        if kind == OPEN_LOOP:
+            gaps = jax.random.exponential(k_arr, (n,)) / offered_qps
+            arrivals = jnp.cumsum(gaps)
+        else:
+            # closed loop: C workers, serial requests, paced to qps overall.
+            c = connections
+            per = n // c
+            lat_conn = root_lat[: c * per].reshape(c, per)
+            spent = jnp.maximum(lat_conn, pace_gap)
+            starts = jnp.cumsum(spent, axis=-1) - spent
+            arrivals = jnp.concatenate(
+                [
+                    starts.reshape(-1),
+                    # remainder requests (n % c) start at t=0 on fresh conns
+                    jnp.zeros((n - c * per,)),
+                ]
+            )
+
+        # ---- downward pass 2: absolute start times -----------------------
+        start_lvls: List[jax.Array] = [
+            (arrivals + self.params.network.one_way(0.0))[:, None]
+        ]
+        for d in range(len(self._levels) - 1):
+            lvl = self._levels[d]
+            if lvl.num_children == 0:
+                start_lvls.append(jnp.zeros((n, 0)))
+                continue
+            sl = slice(lvl.offset, lvl.offset + lvl.size)
+            base = (start_lvls[d] + wait[:, sl])[:, lvl.child_parent_local]
+            start_lvls.append(base + off_lvls[d] + lvl.child_net_out)
+
+        hop_sent = jnp.concatenate(sent_lvls, axis=1)
+        hop_lat = jnp.concatenate(lat_lvls, axis=1)
+        hop_start = jnp.concatenate(start_lvls, axis=1)
+        return SimResults(
+            client_start=arrivals,
+            client_latency=root_lat,
+            client_error=err_coin[:, 0],
+            hop_sent=hop_sent,
+            hop_error=err_coin & hop_sent,
+            hop_latency=hop_lat,
+            hop_start=hop_start,
+            utilization=qp.utilization,
+            unstable=qp.unstable,
+            offered_qps=offered_qps,
+        )
+
+
+def simulate(
+    compiled: CompiledGraph,
+    load: LoadModel,
+    num_requests: int,
+    key: jax.Array,
+    params: SimParams = SimParams(),
+) -> SimResults:
+    """One-shot convenience wrapper around :class:`Simulator`."""
+    return Simulator(compiled, params).run(load, num_requests, key)

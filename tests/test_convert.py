@@ -87,3 +87,15 @@ def test_dot_output():
     assert '"d":s1 -> "b";' in dot
     assert '"c":s0 -> "a";' in dot
     assert '"c":s1 -> "b";' in dot
+
+
+def test_dot_escapes_quoted_node_ids():
+    from isotope_tpu.convert.graphviz import to_dot
+    from isotope_tpu.models.graph import ServiceGraph
+
+    g = ServiceGraph.decode(
+        {"services": [{"name": 'a"b'}, {"name": "c", "script": [{"call": 'a"b'}]}]}
+    )
+    dot = to_dot(g)
+    assert '"a\\"b"' in dot
+    assert '-> "a\\"b";' in dot

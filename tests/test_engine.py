@@ -1,0 +1,243 @@
+"""Simulation engine tests.
+
+Strategy (SURVEY.md §4): deterministic golden values for the tree
+semantics (sequential steps sum, concurrent fan-out joins at the max,
+sleeps overlap a group's calls), distribution checks against closed-form
+M/M/1, and behavioral checks for probability / errorRate — the semantics
+the reference's executor implements (or promises) in
+isotope/service/pkg/srv/executable.go.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from isotope_tpu.compiler import compile_graph
+from isotope_tpu.models.graph import ServiceGraph
+from isotope_tpu.sim import LoadModel, NetworkModel, SimParams, Simulator
+from isotope_tpu.sim import queueing
+
+KEY = jax.random.PRNGKey(7)
+
+# Deterministic service time + negligible load => queueing waits are
+# almost surely zero, so latencies are exact sums/maxes.
+DET = SimParams(service_time="deterministic", network=NetworkModel())
+QUIET = LoadModel(kind="open", qps=0.001, duration_s=1.0)
+CPU = DET.cpu_time_s
+RTT1 = 2 * DET.network.base_latency_s  # zero-byte round trip
+
+
+def run(yaml, n=64, params=DET, load=QUIET, key=KEY):
+    sim = Simulator(compile_graph(ServiceGraph.from_yaml(yaml)), params)
+    return sim.run(load, n, key)
+
+
+def test_single_service_golden():
+    res = run("services:\n- name: a\n  isEntrypoint: true\n")
+    np.testing.assert_allclose(res.client_latency, RTT1 + CPU, rtol=1e-5)
+    assert not bool(res.client_error.any())
+    assert int(res.hop_events) == 64
+
+
+def test_sequential_steps_sum():
+    res = run(
+        """
+services:
+- name: entry
+  isEntrypoint: true
+  script:
+  - sleep: 10ms
+  - call: leaf
+  - sleep: 5ms
+- name: leaf
+"""
+    )
+    # entry busy = 10ms + (rtt + leaf) + 5ms; leaf = cpu
+    want = RTT1 + CPU + 0.010 + (RTT1 + CPU) + 0.005
+    np.testing.assert_allclose(res.client_latency, want, rtol=1e-5)
+
+
+def test_concurrent_fanout_joins_at_max():
+    res = run(
+        """
+services:
+- name: entry
+  isEntrypoint: true
+  script:
+  - - call: slow
+    - call: fast
+    - sleep: 1ms
+- name: slow
+  script:
+  - sleep: 50ms
+- name: fast
+  script:
+  - sleep: 2ms
+"""
+    )
+    # group duration = max(1ms, rtt+fast, rtt+slow) = rtt + cpu + 50ms
+    want = RTT1 + CPU + (RTT1 + CPU + 0.050)
+    np.testing.assert_allclose(res.client_latency, want, rtol=1e-5)
+
+
+def test_concurrent_sleep_dominates_when_longest():
+    res = run(
+        """
+services:
+- name: entry
+  isEntrypoint: true
+  script:
+  - - sleep: 100ms
+    - call: leaf
+- name: leaf
+"""
+    )
+    want = RTT1 + CPU + 0.100  # the 100ms sleep outlasts the call
+    np.testing.assert_allclose(res.client_latency, want, rtol=1e-5)
+
+
+def test_call_probability_rate():
+    res = run(
+        """
+services:
+- name: entry
+  isEntrypoint: true
+  script:
+  - call: {service: leaf, probability: 25}
+- name: leaf
+""",
+        n=8000,
+    )
+    rate = float(res.hop_sent[:, 1].mean())
+    assert rate == pytest.approx(0.25, abs=0.02)
+
+
+def test_error_rate_injects_500_and_skips_script():
+    res = run(
+        """
+services:
+- name: entry
+  isEntrypoint: true
+  errorRate: 100%
+  script:
+  - sleep: 500ms
+  - call: leaf
+- name: leaf
+""",
+        n=32,
+    )
+    assert bool(res.client_error.all())
+    # fail-fast: the 500ms sleep is skipped and leaf is never called
+    assert int(res.hop_sent[:, 1].sum()) == 0
+    np.testing.assert_allclose(res.client_latency, RTT1 + CPU, rtol=1e-5)
+
+
+def test_downstream_error_does_not_fail_caller():
+    # executable.go:132-143: non-200 from a callee is recorded, not
+    # propagated — the caller still returns 200.
+    res = run(
+        """
+services:
+- name: entry
+  isEntrypoint: true
+  script:
+  - call: leaf
+- name: leaf
+  errorRate: 100%
+""",
+        n=32,
+    )
+    assert not bool(res.client_error.any())
+    assert bool(res.hop_error[:, 1].all())
+
+
+def test_start_times_respect_causality():
+    res = run(
+        """
+services:
+- name: entry
+  isEntrypoint: true
+  script:
+  - sleep: 10ms
+  - call: mid
+- name: mid
+  script:
+  - call: leaf
+- name: leaf
+""",
+        n=16,
+        load=LoadModel(kind="open", qps=100.0),
+    )
+    start = np.asarray(res.hop_start)
+    # entry -> mid: at least the 10ms sleep + network later
+    assert (start[:, 1] >= start[:, 0] + 0.010).all()
+    # mid -> leaf: one-way wire time later
+    assert (start[:, 2] >= start[:, 1] + 2e-4).all()
+    # open-loop arrivals are strictly increasing
+    assert (np.diff(np.asarray(res.client_start)) > 0).all()
+
+
+def test_mm1_sojourn_distribution():
+    """Single-station latencies must match the closed-form M/M/1 sojourn.
+
+    With exponential service times, wait+service of our Erlang-C sampler
+    is exactly Exp(mu - lambda) for k=1 — p50/p99 within a few percent.
+    """
+    params = SimParams(service_time="exponential")
+    mu = 1.0 / params.cpu_time_s
+    lam = 0.8 * mu
+    res = run(
+        "services:\n- name: a\n  isEntrypoint: true\n",
+        n=200_000,
+        params=params,
+        load=LoadModel(kind="open", qps=lam),
+    )
+    sojourn = np.asarray(res.client_latency) - RTT1
+    for q in (0.5, 0.9, 0.99):
+        want = float(queueing.mm1_sojourn_quantile(q, lam, mu))
+        got = float(np.quantile(sojourn, q))
+        assert got == pytest.approx(want, rel=0.05), q
+    assert float(res.utilization[0]) == pytest.approx(0.8, rel=1e-3)
+    assert not bool(res.unstable[0])
+
+
+def test_unstable_station_reported():
+    res = run(
+        "services:\n- name: a\n  isEntrypoint: true\n",
+        load=LoadModel(kind="open", qps=1e6),
+        n=128,
+    )
+    assert bool(res.unstable[0])
+    assert float(res.utilization[0]) > 1.0
+
+
+def test_closed_loop_throughput_self_throttles():
+    # qps=None (fortio -qps max): 4 workers issue back-to-back; at the
+    # implied rate queueing kicks in, so compare means, not constants.
+    res = run(
+        "services:\n- name: a\n  isEntrypoint: true\n",
+        n=4096,
+        load=LoadModel(kind="closed", qps=None, connections=4),
+    )
+    lat = np.asarray(res.client_latency)
+    starts = np.asarray(res.client_start).reshape(4, 1024)
+    gaps = np.diff(starts, axis=1)
+    # workers are never idle: gap between consecutive sends == latency
+    np.testing.assert_allclose(
+        gaps.mean(), lat.reshape(4, 1024)[:, :-1].mean(), rtol=1e-5
+    )
+    # the fixed point lands near lam = C / E[latency]
+    assert float(res.offered_qps) == pytest.approx(
+        4 / lat.mean(), rel=0.15
+    )
+
+
+def test_closed_loop_paced_by_qps():
+    res = run(
+        "services:\n- name: a\n  isEntrypoint: true\n",
+        n=1000,
+        load=LoadModel(kind="closed", qps=100.0, connections=10),
+    )
+    # each of 10 workers paces to 10 rps => gaps of 100ms >> latency
+    starts = np.asarray(res.client_start).reshape(10, 100)
+    np.testing.assert_allclose(np.diff(starts, axis=1), 0.1, rtol=1e-4)

@@ -1,0 +1,63 @@
+"""Queueing model tests against textbook closed forms."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from isotope_tpu.sim import queueing
+
+
+def test_erlang_b_known_values():
+    # B(1, a) = a / (1 + a); B(2, a) = a*B1 / (2 + a*B1)
+    a = jnp.asarray([0.5, 2.0])
+    rows = queueing.erlang_b(a, 2)
+    np.testing.assert_allclose(rows[0], [0.5 / 1.5, 2.0 / 3.0], rtol=1e-6)
+    b1 = np.asarray([0.5 / 1.5, 2.0 / 3.0])
+    np.testing.assert_allclose(
+        rows[1], a * b1 / (2 + a * b1), rtol=1e-6
+    )
+
+
+def test_erlang_c_reduces_to_rho_for_single_server():
+    # M/M/1: P(wait) = rho
+    p = queueing.mmk_params(
+        arrival_rate=jnp.asarray([300.0]),
+        service_rate=jnp.asarray([1000.0]),
+        replicas=jnp.asarray([1]),
+        k_max=4,
+    )
+    np.testing.assert_allclose(p.p_wait, [0.3], rtol=1e-5)
+    np.testing.assert_allclose(p.utilization, [0.3], rtol=1e-6)
+    assert not bool(p.unstable[0])
+
+
+def test_erlang_c_mm2_textbook():
+    # M/M/2 with lambda=3, mu=2 => rho=0.75, C = 0.6428571...
+    p = queueing.mmk_params(3.0, 2.0, jnp.asarray([2]), k_max=2)
+    np.testing.assert_allclose(p.p_wait, 9.0 / 14.0, rtol=1e-5)
+    np.testing.assert_allclose(p.wait_rate, 1.0, rtol=1e-5)
+
+
+def test_unstable_station_flagged_and_clamped():
+    p = queueing.mmk_params(2000.0, 1000.0, jnp.asarray([1]), k_max=1)
+    assert bool(p.unstable[0])
+    assert float(p.utilization[0]) == pytest.approx(2.0)
+    assert float(p.wait_rate[0]) > 0  # clamped, still finite sampling
+
+
+def test_sampled_mean_wait_matches_closed_form():
+    lam, mu, k = 800.0, 1000.0, jnp.asarray([1])
+    p = queueing.mmk_params(lam, mu, k, k_max=1)
+    key = jax.random.PRNGKey(0)
+    n = 200_000
+    u = jax.random.uniform(key, (n,))
+    e = jax.random.exponential(jax.random.fold_in(key, 1), (n,))
+    waits = queueing.sample_wait(p, u, e)
+    expected = float(queueing.mmk_mean_wait(lam, mu, k, k_max=1)[0])
+    assert float(waits.mean()) == pytest.approx(expected, rel=0.02)
+
+
+def test_mm1_sojourn_quantile():
+    # mu - lambda = 200 => p50 = ln(2)/200
+    q = queueing.mm1_sojourn_quantile(0.5, 800.0, 1000.0)
+    assert float(q) == pytest.approx(np.log(2) / 200.0, rel=1e-5)

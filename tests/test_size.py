@@ -66,3 +66,12 @@ def test_decode():
 def test_str(n, s):
     # go-units BytesSize: %.4g with binary abbreviations.
     assert str(ByteSize(n)) == s
+
+
+def test_encode_lossy_sizes_fall_back_to_integer():
+    # 123456 formats as "120.6KiB" which re-decodes to 123494 — encode must
+    # emit the exact integer instead so round-trips never perturb sizes.
+    assert ByteSize(123456).encode() == 123456
+    assert ByteSize.decode(ByteSize(123456).encode()) == 123456
+    # round sizes keep the pretty form
+    assert ByteSize(1024).encode() == "1KiB"
