@@ -1,0 +1,42 @@
+"""Metrics / observability layer.
+
+The simulator emits the same series the reference's data plane does, so
+existing analysis keeps working (SURVEY.md §5.5):
+
+- the mock service's five Prometheus series with the reference's exact
+  bucket layouts (isotope/service/pkg/srv/prometheus/handler.go:27-69) —
+  see :mod:`isotope_tpu.metrics.prometheus`;
+- Fortio-style result JSON + the benchmark runner's flattened single-line
+  schema and CSV (perf/benchmark/runner/fortio.py:38-75,215-232) with its
+  trim-window and error-discard semantics — see
+  :mod:`isotope_tpu.metrics.fortio`.
+"""
+from isotope_tpu.metrics.prometheus import (
+    DURATION_BUCKETS,
+    SIZE_BUCKETS,
+    MetricsCollector,
+    ServiceMetrics,
+)
+from isotope_tpu.metrics.fortio import (
+    METRICS_END_SKIP_DURATION,
+    METRICS_START_SKIP_DURATION,
+    METRICS_SUMMARY_DURATION,
+    convert_data,
+    fortio_result,
+    trim_window_summary,
+    write_csv,
+)
+
+__all__ = [
+    "DURATION_BUCKETS",
+    "SIZE_BUCKETS",
+    "MetricsCollector",
+    "ServiceMetrics",
+    "METRICS_START_SKIP_DURATION",
+    "METRICS_END_SKIP_DURATION",
+    "METRICS_SUMMARY_DURATION",
+    "convert_data",
+    "fortio_result",
+    "trim_window_summary",
+    "write_csv",
+]

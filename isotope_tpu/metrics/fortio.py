@@ -1,0 +1,256 @@
+"""Fortio-compatible result formatting and summarization.
+
+Produces the same artifacts the reference's collection pipeline scrapes and
+flattens (perf/benchmark/runner/fortio.py):
+
+- ``fortio_result``: a Fortio-style result JSON (the schema ``fortio load
+  -json`` writes and ``convert_data`` consumes: DurationHistogram with
+  Min/Max/Avg/StdDev/Percentiles, RetCodes, Sizes, ActualQPS...);
+- ``convert_data``: the reference's single-line flattening
+  (fortio.py:38-75) — integer microsecond percentiles, errorPercent,
+  Payload — reimplemented so downstream CSV/BigQuery consumers are
+  drop-in;
+- ``trim_window_summary``: the reference's Prometheus-join window
+  semantics (fortio.py:116-121, 175-186): skip the first 62s and last
+  30s, summarize at most 180s, and flag runs with >10% errors as
+  discarded;
+- ``write_csv``: fortio.py:215-232's key-list CSV writer.
+"""
+from __future__ import annotations
+
+import dataclasses
+from datetime import datetime, timezone
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from isotope_tpu.sim.config import LoadModel
+from isotope_tpu.sim.engine import SimResults
+
+# fortio.py:116-121
+METRICS_START_SKIP_DURATION = 62
+METRICS_END_SKIP_DURATION = 30
+METRICS_SUMMARY_DURATION = 180
+# fortio.py:175-177
+MAX_ERROR_PERCENT = 10.0
+
+# ints for the round percentiles: the reference's flattener builds keys
+# with str(Percentile) (fortio.py:60-62), so 50 must print as "50" -> p50.
+PERCENTILES = (50, 75, 90, 99, 99.9)
+
+# fortio histogram resolution: runner.py:136-137 passes -r 0.001 (1ms).
+HISTOGRAM_RESOLUTION_S = 0.001
+
+
+def _percentile_list(lat: np.ndarray) -> List[dict]:
+    qs = np.quantile(lat, [p / 100.0 for p in PERCENTILES]) if len(lat) else (
+        np.zeros(len(PERCENTILES))
+    )
+    return [
+        {"Percentile": p, "Value": float(v)} for p, v in zip(PERCENTILES, qs)
+    ]
+
+
+def _histogram_data(lat: np.ndarray) -> List[dict]:
+    """Fortio-style bucket records at 1ms resolution (capped at 1000 rows)."""
+    if len(lat) == 0:
+        return []
+    res = HISTOGRAM_RESOLUTION_S
+    hi = min(int(np.ceil(lat.max() / res)), 1000)
+    edges = np.arange(hi + 1) * res
+    counts, _ = np.histogram(np.minimum(lat, edges[-1] - 1e-12), bins=edges)
+    total = len(lat)
+    data = []
+    for i, c in enumerate(counts):
+        if c == 0:
+            continue
+        data.append(
+            {
+                "Start": float(edges[i]),
+                "End": float(edges[i + 1]),
+                "Percent": float(100.0 * c / total),
+                "Count": int(c),
+            }
+        )
+    return data
+
+
+def fortio_result(
+    res: SimResults,
+    load: LoadModel,
+    labels: str = "",
+    start_time: Optional[datetime] = None,
+    response_size_bytes: float = 0.0,
+) -> dict:
+    """Render a run as a Fortio result JSON document."""
+    lat = np.asarray(res.client_latency, np.float64)
+    err = np.asarray(res.client_error)
+    n = len(lat)
+    end = np.asarray(res.client_end, np.float64)
+    actual_duration_s = float(end.max()) if n else 0.0
+    start_time = start_time or datetime.now(timezone.utc)
+    ret_codes: Dict[str, int] = {}
+    n_ok = int((~err).sum())
+    if n_ok:
+        ret_codes["200"] = n_ok
+    if n - n_ok:
+        ret_codes["500"] = int(n - n_ok)
+    return {
+        "RunType": "HTTP",
+        "Labels": labels,
+        "StartTime": start_time.isoformat(),
+        "RequestedQPS": "max" if load.qps is None else str(load.qps),
+        "RequestedDuration": f"{load.duration_s}s",
+        "ActualQPS": (n / actual_duration_s) if actual_duration_s > 0 else 0.0,
+        "ActualDuration": int(actual_duration_s * 1e9),  # nanoseconds
+        "NumThreads": load.connections,
+        "DurationHistogram": {
+            "Count": n,
+            "Min": float(lat.min()) if n else 0.0,
+            "Max": float(lat.max()) if n else 0.0,
+            "Sum": float(lat.sum()),
+            "Avg": float(lat.mean()) if n else 0.0,
+            "StdDev": float(lat.std()) if n else 0.0,
+            "Data": _histogram_data(lat),
+            "Percentiles": _percentile_list(lat),
+        },
+        "RetCodes": ret_codes,
+        # the payload the client receives: the entrypoint's responseSize
+        "Sizes": {"Count": n, "Avg": float(response_size_bytes)},
+    }
+
+
+def convert_data(data: dict) -> Optional[dict]:
+    """Flatten a Fortio result JSON exactly like fortio.py:38-75."""
+    obj: dict = {}
+    for key in (
+        "Labels",
+        "StartTime",
+        "RequestedQPS",
+        "ActualQPS",
+        "NumThreads",
+        "RunType",
+        "ActualDuration",
+    ):
+        if key == "RequestedQPS" and data[key] == "max":
+            obj[key] = 99999999
+            continue
+        if key in ("RequestedQPS", "ActualQPS"):
+            obj[key] = int(round(float(data[key])))
+            continue
+        if key == "ActualDuration":
+            obj[key] = int(data[key] / 10 ** 9)
+            continue
+        obj[key] = data[key]
+
+    h = data["DurationHistogram"]
+    obj["min"] = int(h["Min"] * 10 ** 6)
+    obj["max"] = int(h["Max"] * 10 ** 6)
+    for pp in h["Percentiles"]:
+        obj["p" + str(pp["Percentile"]).replace(".", "")] = int(
+            pp["Value"] * 10 ** 6
+        )
+    success = int(data["RetCodes"].get("200", 0))
+    if data["RunType"] == "HTTP":
+        count = int(data["Sizes"]["Count"])
+        obj["errorPercent"] = 100 * (count - success) / count if count else 0.0
+        obj["Payload"] = int(data["Sizes"]["Avg"])
+    return obj
+
+
+@dataclasses.dataclass(frozen=True)
+class WindowSummary:
+    """Steady-state window statistics (the sim's stand-in for the
+    Prometheus CPU/mem join of fortio.py:178-195)."""
+
+    start_s: float
+    duration_s: float
+    count: int
+    qps: float
+    error_percent: float
+    discarded: bool           # >10% errors or run shorter than 92s
+    discard_reason: str
+    percentiles_us: Dict[str, int]
+    # simulated per-service CPU (cores): utilization x replicas — what the
+    # reference measures off cadvisor (prom.py:116-120)
+    cpu_cores: Dict[str, float]
+
+
+def trim_window_summary(
+    res: SimResults,
+    load: LoadModel,
+    service_names=(),
+    replicas=None,
+) -> WindowSummary:
+    lat = np.asarray(res.client_latency, np.float64)
+    starts = np.asarray(res.client_start, np.float64)
+    err = np.asarray(res.client_error)
+    actual_duration = float(np.asarray(res.client_end).max()) if len(lat) else 0.0
+
+    min_duration = METRICS_START_SKIP_DURATION + METRICS_END_SKIP_DURATION
+    count = len(lat)
+    error_percent = 100.0 * float(err.sum()) / count if count else 0.0
+
+    discarded, reason = False, ""
+    if error_percent > MAX_ERROR_PERCENT:
+        discarded, reason = True, f"{error_percent:.1f}% errors"
+    elif actual_duration < min_duration:
+        discarded, reason = (
+            True,
+            f"duration={actual_duration:.0f}s is less than minimum "
+            f"{min_duration}s",
+        )
+
+    w_start = float(METRICS_START_SKIP_DURATION)
+    w_len = min(
+        max(actual_duration - min_duration, 0.0), METRICS_SUMMARY_DURATION
+    )
+    mask = (starts >= w_start) & (starts < w_start + w_len)
+    wlat = lat[mask]
+    werr = err[mask]
+    wcount = int(mask.sum())
+    percentiles = {}
+    if wcount:
+        qs = np.quantile(wlat, [p / 100.0 for p in PERCENTILES])
+        percentiles = {
+            "p" + str(p).replace(".", ""): int(v * 1e6)
+            for p, v in zip(PERCENTILES, qs)
+        }
+    util = np.asarray(res.utilization, np.float64)
+    reps = (
+        np.asarray(replicas, np.float64)
+        if replicas is not None
+        else np.ones_like(util)
+    )
+    cpu = {
+        name: float(util[i] * reps[i])
+        for i, name in enumerate(service_names)
+    }
+    return WindowSummary(
+        start_s=w_start,
+        duration_s=w_len,
+        count=wcount,
+        qps=(wcount / w_len) if w_len > 0 else 0.0,
+        error_percent=(
+            100.0 * float(werr.sum()) / wcount if wcount else error_percent
+        ),
+        discarded=discarded,
+        discard_reason=reason,
+        percentiles_us=percentiles,
+        cpu_cores=cpu,
+    )
+
+
+DEFAULT_CSV_KEYS = (
+    "Labels,StartTime,RequestedQPS,ActualQPS,NumThreads,min,max,"
+    "p50,p75,p90,p99,p999,errorPercent"
+)
+
+
+def write_csv(keys: str, data: List[dict], path) -> None:
+    """fortio.py:215-232: header then one row per record, '-' for gaps."""
+    lst = keys.split(",")
+    with open(path, "w") as out:
+        out.write(keys + "\n")
+        for gd in data:
+            out.write(",".join(str(gd.get(k, "-")) for k in lst) + "\n")

@@ -1,0 +1,270 @@
+"""Prometheus-compatible service metrics.
+
+Replicates the reference mock service's five series with identical names,
+labels, and bucket layouts (isotope/service/pkg/srv/prometheus/handler.go:
+27-69):
+
+- ``service_incoming_requests_total``            counter
+- ``service_outgoing_requests_total``            counter, by destination
+- ``service_outgoing_request_size``              histogram, by destination
+- ``service_request_duration_seconds``           histogram, by code
+- ``service_response_size``                      histogram, by code
+
+In the reference each pod exposes its own ``/metrics`` and Prometheus adds
+pod identity at scrape time (kubernetes.go:49-52); the simulator has no
+pods, so every series carries an explicit ``service`` label instead.
+
+Collection is a jit-friendly scatter-add over the (request x hop) event
+tensor; exposition renders the standard text format so any Prometheus
+parser/scraper tooling keeps working.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from isotope_tpu.compiler.program import CompiledGraph
+from isotope_tpu.sim.engine import SimResults
+
+# srv/prometheus/handler.go:27-31 — 32 buckets, 7ms..500ms.
+DURATION_BUCKETS = np.asarray(
+    [
+        0.007, 0.008, 0.009, 0.01, 0.011, 0.012, 0.014, 0.016, 0.018, 0.02,
+        0.025, 0.03, 0.035, 0.04, 0.045, 0.05, 0.06, 0.07, 0.08, 0.09, 0.1,
+        0.12, 0.14, 0.16, 0.18, 0.2, 0.25, 0.3, 0.35, 0.4, 0.45, 0.5,
+    ],
+    np.float64,
+)
+
+# srv/prometheus/handler.go:32-35 — decade buckets 1B..1GB.
+SIZE_BUCKETS = np.asarray([10.0 ** e for e in range(10)], np.float64)
+
+# The client that drives the entrypoint (fortio_client.go:28-78).
+CLIENT_NAME = "fortio-client"
+
+_NB = len(DURATION_BUCKETS) + 1  # +overflow (+Inf)
+
+
+class ServiceMetrics(NamedTuple):
+    """Device-side accumulators (all counts are float32 for scatter-adds)."""
+
+    incoming_total: jax.Array        # (S,)
+    outgoing_total: jax.Array        # (E,) per static call edge
+    outgoing_size_hist: jax.Array    # (E, len(SIZE_BUCKETS)+1)
+    outgoing_size_sum: jax.Array     # (E,)
+    duration_hist: jax.Array         # (S, 2, _NB) code axis: 0=200, 1=500
+    duration_sum: jax.Array          # (S, 2)
+    response_size_hist: jax.Array    # (S, 2, len(SIZE_BUCKETS)+1)
+    response_size_sum: jax.Array     # (S, 2)
+
+    def __add__(self, other: "ServiceMetrics") -> "ServiceMetrics":
+        return jax.tree.map(jnp.add, self, other)
+
+
+class MetricsCollector:
+    """Compiled-topology-specific metric reduction.
+
+    The hop -> (source, destination) edge map is static, so outgoing
+    counters aggregate with one segment-sum.  Edge 0 is always the client
+    -> entrypoint edge.
+    """
+
+    def __init__(self, compiled: CompiledGraph):
+        self.compiled = compiled
+        src = np.where(
+            compiled.hop_parent >= 0,
+            compiled.hop_service[np.maximum(compiled.hop_parent, 0)],
+            -1,  # client
+        )
+        dst = compiled.hop_service
+        pairs: List[Tuple[int, int]] = []
+        pair_idx: Dict[Tuple[int, int], int] = {}
+        hop_edge = np.zeros(compiled.num_hops, np.int32)
+        for h in range(compiled.num_hops):
+            p = (int(src[h]), int(dst[h]))
+            if p not in pair_idx:
+                pair_idx[p] = len(pairs)
+                pairs.append(p)
+            hop_edge[h] = pair_idx[p]
+        self.edges: List[Tuple[int, int]] = pairs
+        self._hop_edge = jnp.asarray(hop_edge)
+        # static per-hop byte sizes -> static size-bucket index
+        self._hop_size_bucket = jnp.asarray(
+            np.searchsorted(SIZE_BUCKETS, compiled.hop_request_size, "left"),
+            jnp.int32,
+        )
+        self._hop_service = jnp.asarray(compiled.hop_service)
+        resp = compiled.services.response_size.astype(np.float64)
+        self._svc_resp_bucket = jnp.asarray(
+            np.searchsorted(SIZE_BUCKETS, resp, "left"), jnp.int32
+        )
+        self._svc_resp_size = jnp.asarray(resp, jnp.float32)
+
+    # -- device-side collection (jittable) --------------------------------
+
+    def collect(self, res: SimResults) -> ServiceMetrics:
+        c = self.compiled
+        S, E = c.num_services, len(self.edges)
+        sent = res.hop_sent
+        sent_f = sent.astype(jnp.float32)
+        code = res.hop_error.astype(jnp.int32)  # 0 => 200, 1 => 500
+
+        incoming = jnp.zeros(S).at[self._hop_service].add(sent_f.sum(0))
+        outgoing = jnp.zeros(E).at[self._hop_edge].add(sent_f.sum(0))
+
+        out_size = (
+            jnp.zeros((E, len(SIZE_BUCKETS) + 1))
+            .at[self._hop_edge, self._hop_size_bucket]
+            .add(sent_f.sum(0))
+        )
+        out_size_sum = (
+            jnp.zeros(E)
+            .at[self._hop_edge]
+            .add(sent_f.sum(0) * jnp.asarray(
+                self.compiled.hop_request_size, jnp.float32))
+        )
+
+        # duration histogram: scatter every sent hop into (svc, code, bucket)
+        dbuckets = jnp.searchsorted(
+            jnp.asarray(DURATION_BUCKETS, jnp.float32),
+            res.hop_latency,
+            side="left",
+        ).astype(jnp.int32)
+        svc = jnp.broadcast_to(self._hop_service, sent.shape)
+        dur_hist = (
+            jnp.zeros((S, 2, _NB))
+            .at[svc, code, dbuckets]
+            .add(sent_f)
+        )
+        dur_sum = (
+            jnp.zeros((S, 2))
+            .at[svc, code]
+            .add(jnp.where(sent, res.hop_latency, 0.0))
+        )
+
+        rbucket = jnp.broadcast_to(self._svc_resp_bucket[c.hop_service], sent.shape)
+        resp_hist = (
+            jnp.zeros((S, 2, len(SIZE_BUCKETS) + 1))
+            .at[svc, code, rbucket]
+            .add(sent_f)
+        )
+        resp_sum = (
+            jnp.zeros((S, 2))
+            .at[svc, code]
+            .add(jnp.where(sent, self._svc_resp_size[c.hop_service], 0.0))
+        )
+        return ServiceMetrics(
+            incoming_total=incoming,
+            outgoing_total=outgoing,
+            outgoing_size_hist=out_size,
+            outgoing_size_sum=out_size_sum,
+            duration_hist=dur_hist,
+            duration_sum=dur_sum,
+            response_size_hist=resp_hist,
+            response_size_sum=resp_sum,
+        )
+
+    # -- host-side exposition ----------------------------------------------
+
+    def to_text(self, m: ServiceMetrics) -> str:
+        """Render the Prometheus text exposition format."""
+        names = self.compiled.services.names
+
+        def ename(i: int) -> str:
+            return CLIENT_NAME if i < 0 else names[i]
+
+        out: List[str] = []
+
+        out.append(
+            "# HELP service_incoming_requests_total Number of requests sent"
+            " to this service."
+        )
+        out.append("# TYPE service_incoming_requests_total counter")
+        inc = np.asarray(m.incoming_total)
+        for s, name in enumerate(names):
+            out.append(
+                f'service_incoming_requests_total{{service="{name}"}}'
+                f" {inc[s]:.10g}"
+            )
+
+        out.append(
+            "# HELP service_outgoing_requests_total Number of requests sent"
+            " from this service."
+        )
+        out.append("# TYPE service_outgoing_requests_total counter")
+        outc = np.asarray(m.outgoing_total)
+        for e, (src, dst) in enumerate(self.edges):
+            out.append(
+                "service_outgoing_requests_total{"
+                f'service="{ename(src)}",destination_service="{ename(dst)}"'
+                f"}} {outc[e]:.10g}"
+            )
+
+        self._histogram(
+            out,
+            "service_outgoing_request_size",
+            "Size in bytes of requests sent from this service.",
+            SIZE_BUCKETS,
+            np.asarray(m.outgoing_size_hist),
+            np.asarray(m.outgoing_size_sum),
+            [
+                (
+                    f'service="{ename(src)}",'
+                    f'destination_service="{ename(dst)}"'
+                )
+                for src, dst in self.edges
+            ],
+        )
+
+        dur = np.asarray(m.duration_hist)
+        dur_sum = np.asarray(m.duration_sum)
+        labels, rows, sums = self._by_code(names, dur, dur_sum)
+        self._histogram(
+            out,
+            "service_request_duration_seconds",
+            "Duration in seconds it took to serve requests to this service.",
+            DURATION_BUCKETS,
+            rows,
+            sums,
+            labels,
+        )
+
+        resp = np.asarray(m.response_size_hist)
+        resp_sum = np.asarray(m.response_size_sum)
+        labels, rows, sums = self._by_code(names, resp, resp_sum)
+        self._histogram(
+            out,
+            "service_response_size",
+            "Size in bytes of responses sent from this service.",
+            SIZE_BUCKETS,
+            rows,
+            sums,
+            labels,
+        )
+        return "\n".join(out) + "\n"
+
+    @staticmethod
+    def _by_code(names, hist, sums):
+        labels, rows, row_sums = [], [], []
+        for s, name in enumerate(names):
+            for ci, code in enumerate(("200", "500")):
+                labels.append(f'service="{name}",code="{code}"')
+                rows.append(hist[s, ci])
+                row_sums.append(sums[s, ci])
+        return labels, np.asarray(rows), np.asarray(row_sums)
+
+    @staticmethod
+    def _histogram(out, name, help_text, buckets, rows, sums, labels):
+        out.append(f"# HELP {name} {help_text}")
+        out.append(f"# TYPE {name} histogram")
+        rows = np.asarray(rows)
+        for row, s, label in zip(rows, np.asarray(sums), labels):
+            cum = np.cumsum(row)
+            for le, c in zip(buckets, cum[:-1]):
+                out.append(f'{name}_bucket{{{label},le="{le:g}"}} {c:.10g}')
+            out.append(f'{name}_bucket{{{label},le="+Inf"}} {cum[-1]:.10g}')
+            out.append(f"{name}_sum{{{label}}} {s:.10g}")
+            out.append(f"{name}_count{{{label}}} {cum[-1]:.10g}")

@@ -1,0 +1,194 @@
+"""Metrics layer tests: Prometheus series parity + Fortio schema."""
+import json
+
+import jax
+import numpy as np
+import pytest
+
+from isotope_tpu.compiler import compile_graph
+from isotope_tpu.metrics import (
+    DURATION_BUCKETS,
+    MetricsCollector,
+    SIZE_BUCKETS,
+    convert_data,
+    fortio_result,
+    trim_window_summary,
+    write_csv,
+)
+from isotope_tpu.models.graph import ServiceGraph
+from isotope_tpu.sim import LoadModel, SimParams, Simulator
+
+YAML = """
+defaults:
+  requestSize: 128
+  responseSize: 512
+services:
+- name: entry
+  isEntrypoint: true
+  script:
+  - call: mid
+- name: mid
+  errorRate: 50%
+  script:
+  - call: leaf
+- name: leaf
+"""
+
+
+@pytest.fixture(scope="module")
+def run():
+    compiled = compile_graph(ServiceGraph.from_yaml(YAML))
+    sim = Simulator(compiled, SimParams(service_time="deterministic"))
+    res = sim.run(LoadModel(kind="open", qps=10.0), 2000, jax.random.PRNGKey(3))
+    return compiled, res
+
+
+def test_bucket_layouts_match_reference():
+    # srv/prometheus/handler.go:27-35
+    assert len(DURATION_BUCKETS) == 32
+    assert DURATION_BUCKETS[0] == 0.007 and DURATION_BUCKETS[-1] == 0.5
+    np.testing.assert_allclose(SIZE_BUCKETS, [10.0 ** e for e in range(10)])
+
+
+def test_counters_respect_error_gating(run):
+    compiled, res = run
+    m = MetricsCollector(compiled).collect(res)
+    inc = np.asarray(m.incoming_total)
+    i = {n: inc[k] for k, n in enumerate(compiled.services.names)}
+    # entry sees all 2000; mid sees all (entry has no errorRate);
+    # leaf sees only requests where mid did NOT error (~50%)
+    assert i["entry"] == 2000
+    assert i["mid"] == 2000
+    assert 850 < i["leaf"] < 1150
+    # duration histogram count: 200-code mid ~= leaf count, 500-code the rest
+    dur = np.asarray(m.duration_hist)
+    mid = compiled.services.index_of("mid")
+    assert dur[mid, 0].sum() == i["leaf"]
+    assert dur[mid, 1].sum() == 2000 - i["leaf"]
+
+
+def test_edges_and_outgoing(run):
+    compiled, res = run
+    coll = MetricsCollector(compiled)
+    m = coll.collect(res)
+    names = compiled.services.names
+    labeled = {
+        (
+            "client" if s < 0 else names[s],
+            names[d],
+        ): float(np.asarray(m.outgoing_total)[e])
+        for e, (s, d) in enumerate(coll.edges)
+    }
+    assert labeled[("client", "entry")] == 2000
+    assert labeled[("entry", "mid")] == 2000
+    assert labeled[("mid", "leaf")] == float(
+        np.asarray(m.incoming_total)[compiled.services.index_of("leaf")]
+    )
+
+
+def test_prometheus_text_parses(run):
+    compiled, res = run
+    coll = MetricsCollector(compiled)
+    text = coll.to_text(coll.collect(res))
+    # all five reference series present, with reference names
+    for series in (
+        "service_incoming_requests_total",
+        "service_outgoing_requests_total",
+        "service_outgoing_request_size",
+        "service_request_duration_seconds",
+        "service_response_size",
+    ):
+        assert f"# TYPE {series}" in text
+    # bucket monotonicity + +Inf == count for one histogram
+    lines = [
+        line
+        for line in text.splitlines()
+        if line.startswith(
+            'service_request_duration_seconds_bucket{service="entry",code="200"'
+        )
+    ]
+    vals = [float(line.rsplit(" ", 1)[1]) for line in lines]
+    assert vals == sorted(vals)
+    count = [
+        line
+        for line in text.splitlines()
+        if line.startswith(
+            'service_request_duration_seconds_count{service="entry",code="200"'
+        )
+    ]
+    assert float(count[0].rsplit(" ", 1)[1]) == vals[-1]
+
+
+def test_fortio_result_roundtrips_through_reference_flattener(run):
+    _, res = run
+    load = LoadModel(kind="open", qps=10.0)
+    doc = fortio_result(res, load, labels="canonical_none", response_size_bytes=512)
+    json.dumps(doc)  # must be JSON-serializable
+    flat = convert_data(doc)
+    assert flat["Labels"] == "canonical_none"
+    assert flat["RequestedQPS"] == 10
+    assert flat["NumThreads"] == 64
+    assert flat["p50"] > 0 and flat["p999"] >= flat["p99"] >= flat["p50"]
+    assert flat["errorPercent"] == 0.0  # downstream errors don't hit client
+    assert flat["Payload"] == 512
+    h = doc["DurationHistogram"]
+    assert h["Count"] == 2000
+    assert sum(d["Count"] for d in h["Data"]) == 2000
+
+
+def test_requested_qps_max_flattens_to_sentinel(run):
+    _, res = run
+    doc = fortio_result(res, LoadModel(kind="closed", qps=None, connections=8))
+    assert convert_data(doc)["RequestedQPS"] == 99999999
+
+
+def test_trim_window_semantics(run):
+    compiled, res = run
+    # 2000 req at 10qps => ~200s run; window = [62, 62+min(200-92,180))
+    s = trim_window_summary(
+        res,
+        LoadModel(kind="open", qps=10.0),
+        service_names=compiled.services.names,
+        replicas=compiled.services.replicas,
+    )
+    assert not s.discarded
+    assert s.start_s == 62
+    assert 90 < s.duration_s <= 180
+    assert s.qps == pytest.approx(10.0, rel=0.15)
+    assert set(s.percentiles_us) == {"p50", "p75", "p90", "p99", "p999"}
+    assert all(v >= 0 for v in s.cpu_cores.values())
+
+
+def test_short_run_discarded():
+    compiled = compile_graph(
+        ServiceGraph.from_yaml("services:\n- name: a\n  isEntrypoint: true\n")
+    )
+    sim = Simulator(compiled)
+    res = sim.run(LoadModel(kind="open", qps=100.0, duration_s=10), 1000,
+                  jax.random.PRNGKey(0))
+    s = trim_window_summary(res, LoadModel(kind="open", qps=100.0))
+    assert s.discarded and "less than minimum" in s.discard_reason
+
+
+def test_high_error_run_discarded():
+    compiled = compile_graph(
+        ServiceGraph.from_yaml(
+            "services:\n- name: a\n  isEntrypoint: true\n  errorRate: 50%\n"
+        )
+    )
+    res = Simulator(compiled).run(
+        LoadModel(kind="open", qps=100.0), 20000, jax.random.PRNGKey(0)
+    )
+    s = trim_window_summary(res, LoadModel(kind="open", qps=100.0))
+    assert s.discarded and "errors" in s.discard_reason
+
+
+def test_write_csv(tmp_path, run):
+    _, res = run
+    doc = fortio_result(res, LoadModel(kind="open", qps=10.0), labels="x")
+    flat = convert_data(doc)
+    path = tmp_path / "out.csv"
+    write_csv("Labels,p50,nothere", [flat], path)
+    lines = path.read_text().splitlines()
+    assert lines[0] == "Labels,p50,nothere"
+    assert lines[1].startswith("x,") and lines[1].endswith(",-")
