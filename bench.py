@@ -1,0 +1,69 @@
+"""Benchmark: simulated hop-events per second on one chip.
+
+Workload: the ~120-service complete tree (BASELINE.json configs[1]) under
+open-loop load — every request executes all 121 hops, so one batch of N
+requests is N x 121 hop-events.  The timed step is the full jitted
+simulation (RNG, queue sampling, both tree sweeps, arrival stream) plus
+the fine latency-histogram reduction; only scalars/histograms leave the
+device.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+vs_baseline is measured against the north-star per-chip rate of the
+BASELINE.json target (1e9 hop-events/s on a v5e-8 => 1.25e8 per chip).
+"""
+from __future__ import annotations
+
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+
+NORTH_STAR_PER_CHIP = 1e9 / 8.0
+
+
+def main() -> None:
+    from __graft_entry__ import _flagship
+    from isotope_tpu.metrics.histogram import latency_histogram
+    from isotope_tpu.sim.config import OPEN_LOOP
+    from isotope_tpu.sim.engine import Simulator
+
+    compiled = _flagship()  # 121 services / 121 hops per request
+    sim = Simulator(compiled)
+    platform = jax.devices()[0].platform
+    n = 65_536 if platform != "cpu" else 4_096
+    qps = jnp.float32(100_000.0)
+
+    @jax.jit
+    def step(key):
+        res = sim._simulate(n, OPEN_LOOP, 0, key, qps, jnp.float32(0.0), qps)
+        return res.hop_events, latency_histogram(res.client_latency)
+
+    key = jax.random.PRNGKey(0)
+    hops, hist = step(key)  # compile + warmup
+    jax.block_until_ready((hops, hist))
+    hops_per_batch = float(hops)
+
+    iters = 10 if platform != "cpu" else 3
+    t0 = time.perf_counter()
+    out = None
+    for i in range(iters):
+        out = step(jax.random.fold_in(key, i))
+    jax.block_until_ready(out)
+    dt = time.perf_counter() - t0
+
+    rate = hops_per_batch * iters / dt
+    print(
+        json.dumps(
+            {
+                "metric": "simulated hop-events/sec/chip",
+                "value": rate,
+                "unit": "hop-events/s",
+                "vs_baseline": rate / NORTH_STAR_PER_CHIP,
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,13 @@
+"""Sharded execution over a TPU mesh.
+
+The reference scales horizontally by deploying more namespaces x replicas
+onto more nodes (perf/load/common.sh:68-90); the simulator scales by
+sharding the (request x hop) event tensor over a ``jax.sharding.Mesh`` and
+merging metrics with XLA collectives over ICI — psum for counters and
+histograms, psum_scatter to leave per-service histogram state sharded over
+the ``svc`` axis (SURVEY.md §2.5, §5.8).
+"""
+from isotope_tpu.parallel.mesh import default_mesh, make_mesh
+from isotope_tpu.parallel.sharded import ShardedSimulator, ShardedSummary
+
+__all__ = ["default_mesh", "make_mesh", "ShardedSimulator", "ShardedSummary"]

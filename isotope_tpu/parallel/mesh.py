@@ -1,0 +1,42 @@
+"""Mesh construction helpers.
+
+Axis conventions:
+
+- ``data``: shards the request batch (every device simulates a disjoint
+  slice of the arrival stream — the analogue of running more Fortio
+  clients, perf/load/common.sh:68-90);
+- ``svc``: shards per-service metric state (the analogue of services
+  living on different nodes/namespaces).  Compute for all hops is still
+  data-parallel; cross-``svc`` traffic is the metrics reduce-scatter.
+"""
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+DATA_AXIS = "data"
+SVC_AXIS = "svc"
+
+
+def make_mesh(
+    data: int,
+    svc: int = 1,
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> Mesh:
+    devices = list(devices) if devices is not None else jax.devices()
+    if data * svc > len(devices):
+        raise ValueError(
+            f"mesh {data}x{svc} needs {data * svc} devices, have "
+            f"{len(devices)}"
+        )
+    grid = np.asarray(devices[: data * svc]).reshape(data, svc)
+    return Mesh(grid, (DATA_AXIS, SVC_AXIS))
+
+
+def default_mesh(devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
+    """All available devices on the data axis."""
+    devices = list(devices) if devices is not None else jax.devices()
+    return make_mesh(len(devices), 1, devices)

@@ -166,7 +166,8 @@ class Simulator:
         """
         if load.kind == OPEN_LOOP:
             return self._get(num_requests, OPEN_LOOP)(
-                key, jnp.float32(load.qps), jnp.float32(0.0)
+                key, jnp.float32(load.qps), jnp.float32(0.0),
+                jnp.float32(load.qps),
             )
         cap = 0.999 * self.capacity_qps()
         lam = min(load.qps, cap) if load.qps is not None else cap
@@ -178,14 +179,17 @@ class Simulator:
             else jnp.float32(0.0)
         )
         for i in range(fixed_point_iters):
-            res = pilot(jax.random.fold_in(key, i), jnp.float32(lam), gap)
+            res = pilot(
+                jax.random.fold_in(key, i), jnp.float32(lam), gap,
+                jnp.float32(lam),
+            )
             mean_lat = float(res.client_latency.mean())
             implied = load.connections / max(mean_lat, 1e-9)
             lam = min(implied, cap)
             if load.qps is not None:
                 lam = min(lam, load.qps)
         return self._get(num_requests, CLOSED_LOOP, load.connections)(
-            key, jnp.float32(lam), gap
+            key, jnp.float32(lam), gap, jnp.float32(lam)
         )
 
     def capacity_qps(self) -> float:
@@ -220,7 +224,12 @@ class Simulator:
         key: jax.Array,
         offered_qps: jax.Array,
         pace_gap: jax.Array,
+        arrival_qps: jax.Array,
     ) -> SimResults:
+        """``offered_qps`` drives the queueing model (the rate the whole
+        fleet of services sees); ``arrival_qps`` paces this batch's
+        open-loop arrival stream.  They differ only under sharded
+        execution, where each shard generates 1/shards of the stream."""
         H = self.compiled.num_hops
         k_send, k_err, k_wait_u, k_wait_e, k_svc, k_arr = jax.random.split(
             key, 6
@@ -304,7 +313,7 @@ class Simulator:
         # ---- arrivals ----------------------------------------------------
         root_lat = self._root_net + lat_lvls[0][:, 0]
         if kind == OPEN_LOOP:
-            gaps = jax.random.exponential(k_arr, (n,)) / offered_qps
+            gaps = jax.random.exponential(k_arr, (n,)) / arrival_qps
             arrivals = jnp.cumsum(gaps)
         else:
             # closed loop: C workers, serial requests, paced to qps overall.

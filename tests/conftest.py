@@ -3,12 +3,12 @@
 All sharding tests run against ``jax.sharding.Mesh`` over 8 virtual CPU
 devices so multi-chip paths are exercised without TPU hardware (the driver
 separately dry-runs ``__graft_entry__.dryrun_multichip``).
-"""
-import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
-flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (
-        flags + " --xla_force_host_platform_device_count=8"
-    ).strip()
+Note: the ambient environment preimports jax at interpreter startup (the
+axon sitecustomize) with ``JAX_PLATFORMS=axon``, so environment variables
+set here are read too late — only ``jax.config.update`` works.
+"""
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 8)

@@ -1,0 +1,20 @@
+"""Driver entry-point smoke tests (virtual 8-device CPU mesh)."""
+import sys
+import pathlib
+
+import jax
+
+sys.path.insert(0, str(pathlib.Path(__file__).parent.parent))
+
+import __graft_entry__  # noqa: E402
+
+
+def test_entry_jits_single_device():
+    fn, args = __graft_entry__.entry()
+    res = jax.jit(fn)(*args)
+    # 2048 requests x 121 hops, all always sent
+    assert int(res.hop_events) == 2048 * 121
+
+
+def test_dryrun_multichip_8():
+    __graft_entry__.dryrun_multichip(8)
