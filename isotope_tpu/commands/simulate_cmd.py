@@ -1,0 +1,122 @@
+"""``isotope-tpu simulate`` and ``isotope-tpu sweep`` subcommands.
+
+``simulate`` is one labeled run — the counterpart of a single ``fortio
+load`` invocation against a deployed graph (perf/benchmark/runner/
+runner.py:255-268) — printing the Fortio-style JSON (or the flattened
+single-line record) and optionally the Prometheus exposition.
+
+``sweep`` is the full experiment driver: a TOML config (the shape of
+isotope/example-config.toml) crossed over topologies x environments x
+connections x qps, writing results.jsonl / benchmark.csv / per-run JSON
+like the reference's collection pipeline.
+"""
+from __future__ import annotations
+
+import json
+import sys
+
+from isotope_tpu.utils import duration as dur
+
+
+def register(sub) -> None:
+    s = sub.add_parser(
+        "simulate", help="simulate one topology under one load"
+    )
+    s.add_argument("topology", help="path to the service graph YAML")
+    s.add_argument("--qps", default="1000",
+                   help='target QPS, or "max" (fortio -qps max)')
+    s.add_argument("--connections", "-c", type=int, default=64)
+    s.add_argument("--duration", "-t", default="240s",
+                   help='run duration, e.g. "240s" or "5m"')
+    s.add_argument("--load-kind", choices=["open", "closed"],
+                   default="closed",
+                   help="closed = fortio workers; open = Poisson arrivals")
+    s.add_argument("--environment", default="NONE",
+                   help="NONE or ISTIO (adds the sidecar latency tax)")
+    s.add_argument("--max-requests", type=int, default=1_000_000)
+    s.add_argument("--seed", type=int, default=0)
+    s.add_argument("--labels", default="")
+    s.add_argument("--flat", action="store_true",
+                   help="print the flattened single-line record instead "
+                        "of the full Fortio JSON")
+    s.add_argument("--prometheus", metavar="FILE",
+                   help="also write the Prometheus text exposition here")
+    s.set_defaults(func=run_simulate)
+
+    w = sub.add_parser("sweep", help="run a TOML-configured experiment")
+    w.add_argument("config", help="experiment TOML (example-config.toml shape)")
+    w.add_argument("--out", "-o", default="results",
+                   help="output directory (default: ./results)")
+    w.set_defaults(func=run_sweep)
+
+
+def _require_jax() -> None:
+    try:
+        import jax  # noqa: F401
+    except ModuleNotFoundError as e:
+        raise ValueError(
+            "the simulate/sweep commands need jax, which is not installed "
+            "in this environment (the converter commands still work)"
+        ) from e
+
+
+def run_simulate(args) -> int:
+    # jax-dependent imports stay inside the handler so `--help` is instant
+    _require_jax()
+    from isotope_tpu.runner.config import (
+        DEFAULT_ENVIRONMENTS,
+        ExperimentConfig,
+    )
+    from isotope_tpu.runner.run import run_experiment
+
+    if args.environment not in DEFAULT_ENVIRONMENTS:
+        raise ValueError(
+            f"unknown environment {args.environment!r} "
+            f"(expected one of {sorted(DEFAULT_ENVIRONMENTS)})"
+        )
+    qps = None if args.qps == "max" else float(args.qps)
+    config = ExperimentConfig(
+        topology_paths=(args.topology,),
+        environments=(DEFAULT_ENVIRONMENTS[args.environment],),
+        qps=(qps,),
+        connections=(args.connections,),
+        duration_s=dur.parse_duration_seconds(args.duration),
+        load_kind=args.load_kind,
+        num_requests=args.max_requests,
+        seed=args.seed,
+        labels=args.labels,
+    )
+    (result,) = run_experiment(config)
+    doc = result.flat if args.flat else result.fortio_json
+    json.dump(doc, sys.stdout, indent=None if args.flat else 2)
+    sys.stdout.write("\n")
+    if args.prometheus:
+        with open(args.prometheus, "w") as f:
+            f.write(result.prometheus_text)
+    if result.window.discarded:
+        print(
+            f"warning: run would be discarded by the collector: "
+            f"{result.window.discard_reason}",
+            file=sys.stderr,
+        )
+    return 0
+
+
+def run_sweep(args) -> int:
+    _require_jax()
+    from isotope_tpu.runner.config import load_toml
+    from isotope_tpu.runner.run import run_experiment
+
+    config = load_toml(args.config)
+    results = run_experiment(
+        config,
+        out_dir=args.out,
+        progress=lambda label: print(f"running {label}", file=sys.stderr),
+    )
+    discarded = [r.label for r in results if r.window.discarded]
+    print(
+        f"{len(results)} runs -> {args.out}/ "
+        f"({len(discarded)} would be discarded by the collector)",
+        file=sys.stderr,
+    )
+    return 0

@@ -1,0 +1,24 @@
+"""Experiment orchestration.
+
+The TPU-native replacement for the reference's two drivers:
+
+- ``isotope/run_tests.py``: topology x environment pipeline configured by
+  TOML (example-config.toml schema) — here the "cluster" is the local
+  device mesh and "deploying" a topology is compiling it;
+- ``perf/benchmark/runner/runner.py``: the conn x qps sweep grid with
+  labeled runs and CSV/JSONL output.
+"""
+from isotope_tpu.runner.config import (
+    EnvironmentModel,
+    ExperimentConfig,
+    load_toml,
+)
+from isotope_tpu.runner.run import RunResult, run_experiment
+
+__all__ = [
+    "EnvironmentModel",
+    "ExperimentConfig",
+    "load_toml",
+    "RunResult",
+    "run_experiment",
+]

@@ -1,0 +1,159 @@
+"""Experiment configuration.
+
+TOML schema follows the reference's ``isotope/example-config.toml`` where
+it maps onto simulation (topology_paths, environments, client
+qps/duration/num_concurrent_connections); the cluster/istio/image blocks —
+GKE deployment detail — are replaced by a ``[sim]`` block (model
+parameters, seed, mesh shape) and per-environment overlays.
+
+Environments: the reference runs each topology twice, bare ("NONE") and
+meshed ("ISTIO", Envoy sidecars injected around every pod,
+kubernetes.go:150-157).  The simulator models the mesh as extra per-edge
+latency and per-hop proxy CPU — both explicit, overridable knobs.
+"""
+from __future__ import annotations
+
+import dataclasses
+import pathlib
+import tomllib
+from typing import Dict, List, Optional, Tuple
+
+from isotope_tpu.sim.config import LoadModel, NetworkModel, SimParams
+from isotope_tpu.utils import duration as dur
+
+
+@dataclasses.dataclass(frozen=True)
+class EnvironmentModel:
+    """How an environment (service mesh flavor) perturbs the data plane."""
+
+    name: str
+    # extra one-way per-edge latency from traversing client+server sidecars
+    extra_hop_latency_s: float = 0.0
+
+    def apply(self, params: SimParams) -> SimParams:
+        if not self.extra_hop_latency_s:
+            return params
+        net = params.network
+        return dataclasses.replace(
+            params,
+            network=NetworkModel(
+                base_latency_s=net.base_latency_s + self.extra_hop_latency_s,
+                bytes_per_second=net.bytes_per_second,
+            ),
+        )
+
+
+# Default mesh tax: two Envoy passes per edge, ~0.5ms each way — the
+# ballpark the twopods latency benchmarks attribute to the sidecar path
+# (perf/benchmark/README.md's baseline-vs-both comparisons).
+DEFAULT_ENVIRONMENTS = {
+    "NONE": EnvironmentModel(name="NONE"),
+    "ISTIO": EnvironmentModel(name="ISTIO", extra_hop_latency_s=500e-6),
+}
+
+
+@dataclasses.dataclass(frozen=True)
+class ExperimentConfig:
+    topology_paths: Tuple[str, ...]
+    environments: Tuple[EnvironmentModel, ...]
+    qps: Tuple[Optional[float], ...]     # None == "max"
+    connections: Tuple[int, ...]
+    duration_s: float
+    load_kind: str = "closed"            # fortio's default mode
+    num_requests: int = 100_000
+    seed: int = 0
+    cpu_time_s: float = SimParams().cpu_time_s
+    service_time: str = SimParams().service_time
+    mesh_data: int = 0                   # 0 => all devices
+    mesh_svc: int = 1
+    labels: str = ""
+
+    def sim_params(self) -> SimParams:
+        return SimParams(
+            cpu_time_s=self.cpu_time_s, service_time=self.service_time
+        )
+
+    def load_models(self):
+        for conn in self.connections:
+            for qps in self.qps:
+                yield LoadModel(
+                    kind=self.load_kind,
+                    qps=qps,
+                    connections=conn,
+                    duration_s=self.duration_s,
+                )
+
+
+def _parse_qps(value) -> Optional[float]:
+    if value == "max":
+        return None
+    return float(value)
+
+
+def load_toml(path) -> ExperimentConfig:
+    path = pathlib.Path(path)
+    with open(path, "rb") as f:
+        doc = tomllib.load(f)
+    # topology paths resolve relative to the config file, not the cwd
+    base = path.parent
+    doc["topology_paths"] = [
+        str(p if (p := pathlib.Path(raw)).is_absolute() else base / p)
+        for raw in doc.get("topology_paths", ())
+    ]
+
+    envs: List[EnvironmentModel] = []
+    env_overrides: Dict[str, dict] = doc.get("environment", {})
+    for name in doc.get("environments", ["NONE"]):
+        if name in env_overrides:
+            o = env_overrides[name]
+            envs.append(
+                EnvironmentModel(
+                    name=name,
+                    extra_hop_latency_s=dur.parse_duration_seconds(
+                        o.get("extra_hop_latency", "0s")
+                    ),
+                )
+            )
+        elif name in DEFAULT_ENVIRONMENTS:
+            envs.append(DEFAULT_ENVIRONMENTS[name])
+        else:
+            raise ValueError(
+                f"unknown environment {name!r}: define an [environment."
+                f"{name}] block"
+            )
+
+    client = doc.get("client", {})
+    qps_raw = client.get("qps", "max")
+    qps_list = (
+        [_parse_qps(q) for q in qps_raw]
+        if isinstance(qps_raw, list)
+        else [_parse_qps(qps_raw)]
+    )
+    conns_raw = client.get("num_concurrent_connections", 64)
+    conns = (
+        [int(c) for c in conns_raw]
+        if isinstance(conns_raw, list)
+        else [int(conns_raw)]
+    )
+
+    sim = doc.get("sim", {})
+    defaults = SimParams()
+    return ExperimentConfig(
+        topology_paths=tuple(doc.get("topology_paths", ())),
+        environments=tuple(envs),
+        qps=tuple(qps_list),
+        connections=tuple(conns),
+        duration_s=dur.parse_duration_seconds(client.get("duration", "5m")),
+        load_kind=client.get("load_kind", "closed"),
+        num_requests=int(sim.get("num_requests", 100_000)),
+        seed=int(sim.get("seed", 0)),
+        cpu_time_s=(
+            dur.parse_duration_seconds(sim["cpu_time"])
+            if "cpu_time" in sim
+            else defaults.cpu_time_s
+        ),
+        service_time=sim.get("service_time", defaults.service_time),
+        mesh_data=int(sim.get("mesh_data", 0)),
+        mesh_svc=int(sim.get("mesh_svc", 1)),
+        labels=doc.get("labels", ""),
+    )

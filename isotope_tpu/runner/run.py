@@ -1,0 +1,155 @@
+"""The sweep driver: topology x environment x connections x qps.
+
+Mirrors the shape of the reference's drivers (run_tests.py:35-44 outer
+product; runner.py:522-525 conn x qps grid; fortio.py artifact formats)
+with compilation replacing deployment and simulation replacing ``kubectl
+exec fortio load``.
+"""
+from __future__ import annotations
+
+import dataclasses
+import json
+import pathlib
+from typing import List, Optional
+
+import jax
+
+from isotope_tpu.compiler import compile_graph
+from isotope_tpu.metrics.fortio import (
+    DEFAULT_CSV_KEYS,
+    WindowSummary,
+    convert_data,
+    fortio_result,
+    trim_window_summary,
+    write_csv,
+)
+from isotope_tpu.metrics.prometheus import MetricsCollector
+from isotope_tpu.models.graph import ServiceGraph
+from isotope_tpu.parallel import ShardedSimulator, make_mesh
+from isotope_tpu.runner.config import ExperimentConfig
+from isotope_tpu.sim.config import LoadModel
+from isotope_tpu.sim.engine import Simulator
+
+
+@dataclasses.dataclass
+class RunResult:
+    label: str
+    topology: str
+    environment: str
+    flat: dict                    # the reference's single-line schema
+    window: WindowSummary
+    fortio_json: dict
+    prometheus_text: str
+
+
+def _label(topo_path: str, env: str, load: LoadModel, extra: str) -> str:
+    stem = pathlib.Path(topo_path).stem
+    qps = "max" if load.qps is None else f"{load.qps:g}"
+    base = f"{stem}_{env.lower()}_{qps}qps_{load.connections}c"
+    return f"{base}_{extra}" if extra else base
+
+
+def _num_requests(load: LoadModel, capacity: float, cap: int) -> int:
+    """Size the batch so the simulated run spans ``load.duration_s``."""
+    rate = capacity if load.qps is None else min(load.qps, capacity)
+    return max(1, min(int(rate * load.duration_s), cap))
+
+
+def run_experiment(
+    config: ExperimentConfig,
+    out_dir: Optional[str] = None,
+    progress=None,
+) -> List[RunResult]:
+    results: List[RunResult] = []
+    key = jax.random.PRNGKey(config.seed)
+    mesh_svc = max(config.mesh_svc, 1)
+    mesh_data = (
+        config.mesh_data
+        if config.mesh_data > 0
+        else max(jax.device_count() // mesh_svc, 1)
+    )
+    use_mesh = mesh_data * mesh_svc > 1
+
+    for topo_path in config.topology_paths:
+        graph = ServiceGraph.from_yaml_file(topo_path)
+        topo_yaml_entry = graph.entrypoints()
+        entry_resp = (
+            float(int(topo_yaml_entry[0].response_size))
+            if topo_yaml_entry
+            else 0.0
+        )
+        compiled = compile_graph(graph)
+        collector = MetricsCollector(compiled)
+        for env in config.environments:
+            params = env.apply(config.sim_params())
+            sim = Simulator(compiled, params)
+            sharded = (
+                ShardedSimulator(
+                    compiled, make_mesh(mesh_data, mesh_svc), params
+                )
+                if use_mesh
+                else None
+            )
+            for i, load in enumerate(config.load_models()):
+                label = _label(topo_path, env.name, load, config.labels)
+                if progress:
+                    progress(label)
+                run_key = jax.random.fold_in(key, len(results))
+                n = _num_requests(
+                    load, sim.capacity_qps(), config.num_requests
+                )
+                res = sim.run(load, n, run_key)
+                doc = fortio_result(
+                    res, load, labels=label, response_size_bytes=entry_resp
+                )
+                flat = convert_data(doc)
+                window = trim_window_summary(
+                    res,
+                    load,
+                    service_names=compiled.services.names,
+                    replicas=compiled.services.replicas,
+                )
+                flat["windowDiscarded"] = window.discarded
+                flat.update(
+                    {
+                        "cpu_cores_" + name: round(v, 4)
+                        for name, v in window.cpu_cores.items()
+                    }
+                )
+                if sharded is not None:
+                    # large-batch sharded pass for the device-side metrics;
+                    # reuse the fixed point the single-device run solved
+                    summary = sharded.run(
+                        load, n, run_key, offered_qps=res.offered_qps
+                    )
+                    prom_text = collector.to_text(summary.metrics)
+                else:
+                    prom_text = collector.to_text(collector.collect(res))
+                results.append(
+                    RunResult(
+                        label=label,
+                        topology=topo_path,
+                        environment=env.name,
+                        flat=flat,
+                        window=window,
+                        fortio_json=doc,
+                        prometheus_text=prom_text,
+                    )
+                )
+
+    if out_dir is not None:
+        out = pathlib.Path(out_dir)
+        out.mkdir(parents=True, exist_ok=True)
+        with open(out / "results.jsonl", "w") as f:
+            for r in results:
+                f.write(json.dumps(r.flat) + "\n")
+        for r in results:
+            with open(out / f"{r.label}.json", "w") as f:
+                json.dump(r.fortio_json, f, indent=2)
+            (out / f"{r.label}.prom").write_text(r.prometheus_text)
+        write_csv(
+            DEFAULT_CSV_KEYS,
+            [r.flat for r in results],
+            out / "benchmark.csv",
+        )
+    return results

@@ -1,0 +1,139 @@
+"""Runner / sweep driver + CLI tests."""
+import json
+import pathlib
+
+import pytest
+
+from isotope_tpu import cli
+from isotope_tpu.runner import load_toml, run_experiment
+from isotope_tpu.runner.config import DEFAULT_ENVIRONMENTS
+
+TOPO = pathlib.Path(__file__).parent.parent / "examples/topologies/canonical.yaml"
+
+
+def small_toml(tmp_path, **sim_overrides):
+    sim = {"num_requests": 2000, "seed": 7}
+    sim.update(sim_overrides)
+    sim_lines = "\n".join(
+        f'{k} = {json.dumps(v)}' for k, v in sim.items()
+    )
+    cfg = tmp_path / "exp.toml"
+    cfg.write_text(
+        f"""
+topology_paths = ["{TOPO}"]
+environments = ["NONE", "ISTIO"]
+
+[client]
+qps = [500]
+num_concurrent_connections = [8]
+duration = "120s"
+load_kind = "open"
+
+[sim]
+{sim_lines}
+"""
+    )
+    return cfg
+
+
+def test_load_toml_schema(tmp_path):
+    cfg = load_toml(small_toml(tmp_path))
+    assert cfg.topology_paths == (str(TOPO),)
+    assert [e.name for e in cfg.environments] == ["NONE", "ISTIO"]
+    assert cfg.qps == (500.0,)
+    assert cfg.connections == (8,)
+    assert cfg.duration_s == 120.0
+    assert cfg.num_requests == 2000
+    # ISTIO default adds the sidecar latency tax
+    istio = cfg.environments[1]
+    assert istio.extra_hop_latency_s == pytest.approx(500e-6)
+    base = cfg.sim_params()
+    assert istio.apply(base).network.base_latency_s > base.network.base_latency_s
+
+
+def test_load_toml_qps_max_and_env_override(tmp_path):
+    cfg_path = tmp_path / "exp.toml"
+    cfg_path.write_text(
+        f"""
+topology_paths = ["{TOPO}"]
+environments = ["CUSTOM"]
+
+[environment.CUSTOM]
+extra_hop_latency = "2ms"
+
+[client]
+qps = "max"
+"""
+    )
+    cfg = load_toml(cfg_path)
+    assert cfg.qps == (None,)
+    assert cfg.environments[0].extra_hop_latency_s == pytest.approx(0.002)
+
+
+def test_unknown_environment_rejected(tmp_path):
+    cfg_path = tmp_path / "exp.toml"
+    cfg_path.write_text(
+        f'topology_paths = ["{TOPO}"]\nenvironments = ["WAT"]\n'
+    )
+    with pytest.raises(ValueError, match="WAT"):
+        load_toml(cfg_path)
+
+
+def test_run_experiment_grid_and_artifacts(tmp_path):
+    cfg = load_toml(small_toml(tmp_path))
+    out = tmp_path / "results"
+    results = run_experiment(cfg, out_dir=out)
+    # 1 topology x 2 envs x 1 conn x 1 qps
+    assert len(results) == 2
+    labels = [r.label for r in results]
+    assert labels == [
+        "canonical_none_500qps_8c",
+        "canonical_istio_500qps_8c",
+    ]
+    # ISTIO pays the sidecar tax on every hop
+    assert results[1].flat["p50"] > results[0].flat["p50"]
+    # artifacts
+    lines = (out / "results.jsonl").read_text().splitlines()
+    assert len(lines) == 2 and json.loads(lines[0])["Labels"] == labels[0]
+    csv = (out / "benchmark.csv").read_text().splitlines()
+    assert csv[0].startswith("Labels,StartTime")
+    assert len(csv) == 3
+    for r in results:
+        assert (out / f"{r.label}.json").exists()
+        prom = (out / f"{r.label}.prom").read_text()
+        assert "service_request_duration_seconds" in prom
+
+
+def test_cli_simulate_flat(tmp_path, capsys):
+    rc = cli.main(
+        [
+            "simulate",
+            str(TOPO),
+            "--qps", "200",
+            "--duration", "100s",
+            "--load-kind", "open",
+            "--max-requests", "2000",
+            "--flat",
+            "--prometheus", str(tmp_path / "m.prom"),
+        ]
+    )
+    assert rc == 0
+    cap = capsys.readouterr()
+    flat = json.loads(cap.out)
+    assert flat["RequestedQPS"] == 200
+    assert flat["p99"] >= flat["p50"] > 0
+    assert (tmp_path / "m.prom").read_text().count("# TYPE") == 5
+
+
+def test_cli_sweep(tmp_path, capsys):
+    cfg = small_toml(tmp_path)
+    out = tmp_path / "res"
+    rc = cli.main(["sweep", str(cfg), "-o", str(out)])
+    assert rc == 0
+    assert (out / "benchmark.csv").exists()
+
+
+def test_cli_simulate_unknown_environment_errors(capsys):
+    rc = cli.main(["simulate", str(TOPO), "--environment", "NOPE"])
+    assert rc == 1
+    assert "unknown environment" in capsys.readouterr().err
