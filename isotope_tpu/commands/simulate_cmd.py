@@ -34,6 +34,15 @@ def register(sub) -> None:
     s.add_argument("--environment", default="NONE",
                    help="NONE or ISTIO (adds the sidecar latency tax)")
     s.add_argument("--max-requests", type=int, default=1_000_000)
+    s.add_argument("--service-time",
+                   choices=["exponential", "deterministic", "lognormal",
+                            "pareto"],
+                   default="exponential",
+                   help="per-request CPU-time distribution")
+    s.add_argument("--service-time-param", type=float, default=None,
+                   help="lognormal sigma / pareto alpha")
+    s.add_argument("--cpu-time", default=None,
+                   help='per-request CPU demand, e.g. "77us"')
     s.add_argument("--seed", type=int, default=0)
     s.add_argument("--labels", default="")
     s.add_argument("--flat", action="store_true",
@@ -75,6 +84,13 @@ def run_simulate(args) -> int:
             f"(expected one of {sorted(DEFAULT_ENVIRONMENTS)})"
         )
     qps = None if args.qps == "max" else float(args.qps)
+    extra = {}
+    if args.cpu_time is not None:
+        extra["cpu_time_s"] = dur.parse_duration_seconds(args.cpu_time)
+    if args.service_time_param is not None:
+        extra["service_time_param"] = args.service_time_param
+    elif args.service_time == "pareto":
+        extra["service_time_param"] = 1.5  # a sane heavy-tail default
     config = ExperimentConfig(
         topology_paths=(args.topology,),
         environments=(DEFAULT_ENVIRONMENTS[args.environment],),
@@ -85,6 +101,8 @@ def run_simulate(args) -> int:
         num_requests=args.max_requests,
         seed=args.seed,
         labels=args.labels,
+        service_time=args.service_time,
+        **extra,
     )
     (result,) = run_experiment(config)
     doc = result.flat if args.flat else result.fortio_json

@@ -59,6 +59,8 @@ class _Call:
     target: int
     size: float
     send_prob: float
+    timeout: float = float("inf")
+    attempts: int = 1  # retries + 1
 
 
 @dataclasses.dataclass(frozen=True)
@@ -93,6 +95,8 @@ def _lower_call(cmd: RequestCommand, name_to_idx) -> _Call:
         target=name_to_idx[cmd.service_name],
         size=float(int(cmd.size)),
         send_prob=cmd.send_probability,
+        timeout=float("inf") if cmd.timeout is None else cmd.timeout,
+        attempts=cmd.retries + 1,
     )
 
 
@@ -181,6 +185,10 @@ def compile_graph(
         step_base = np.zeros((len(frontier), max_steps), np.float32)
         child_ids: List[int] = []
         child_seg: List[int] = []
+        call_seg: List[int] = []
+        call_step: List[int] = []
+        call_timeout: List[float] = []
+        call_attempt_children: List[List[int]] = []  # local child indices
         next_frontier: List[int] = []
         for local, h in enumerate(frontier):
             prog = programs[hop_service[h]]
@@ -189,21 +197,44 @@ def compile_graph(
                 step_is_real[local, step_idx] = True
                 step_base[local, step_idx] = step.base
                 for call in step.calls:
-                    child = len(hop_service)
-                    if child >= max_hops:
-                        raise HopBudgetExceededError(max_hops)
-                    hop_service.append(call.target)
-                    hop_parent.append(h)
-                    hop_depth.append(hop_depth[h] + 1)
-                    hop_step.append(step_idx)
-                    hop_send_prob.append(call.send_prob)
-                    hop_request_size.append(call.size)
-                    hop_reach.append(
-                        hop_reach[h] * call.send_prob * (1.0 - parent_err)
-                    )
-                    child_ids.append(child)
-                    child_seg.append(local * max_steps + step_idx)
-                    next_frontier.append(child)
+                    # Each retry attempt is its own hop (with its own
+                    # subtree); its static reach discounts by the target's
+                    # error rate — the statically-known part of "previous
+                    # attempt failed" — for offered-load estimation.
+                    target_err = float(table.error_rate[call.target])
+                    call_seg.append(local * max_steps + step_idx)
+                    call_step.append(step_idx)
+                    call_timeout.append(call.timeout)
+                    att_locals: List[int] = []
+                    for a in range(call.attempts):
+                        child = len(hop_service)
+                        if child >= max_hops:
+                            raise HopBudgetExceededError(max_hops)
+                        hop_service.append(call.target)
+                        hop_parent.append(h)
+                        hop_depth.append(hop_depth[h] + 1)
+                        hop_step.append(step_idx)
+                        hop_send_prob.append(call.send_prob)
+                        hop_request_size.append(call.size)
+                        hop_reach.append(
+                            hop_reach[h]
+                            * call.send_prob
+                            * (1.0 - parent_err)
+                            * target_err**a
+                        )
+                        att_locals.append(len(child_ids))
+                        child_ids.append(child)
+                        child_seg.append(local * max_steps + step_idx)
+                        next_frontier.append(child)
+                    call_attempt_children.append(att_locals)
+        max_a = max((len(c) for c in call_attempt_children), default=1)
+        n_calls = len(call_seg)
+        att_child = np.full((max_a, n_calls), len(child_ids), np.int32)
+        att_valid = np.zeros((max_a, n_calls), bool)
+        for k, att_locals in enumerate(call_attempt_children):
+            for a, local_idx in enumerate(att_locals):
+                att_child[a, k] = local_idx
+                att_valid[a, k] = True
         levels.append(
             HopLevel(
                 hop_ids=np.asarray(frontier, np.int32),
@@ -212,6 +243,11 @@ def compile_graph(
                 step_base=step_base,
                 child_ids=np.asarray(child_ids, np.int32),
                 child_seg=np.asarray(child_seg, np.int32),
+                call_seg=np.asarray(call_seg, np.int32),
+                call_step=np.asarray(call_step, np.int32),
+                call_timeout=np.asarray(call_timeout, np.float32),
+                att_child=att_child,
+                att_valid=att_valid,
             )
         )
         frontier = next_frontier

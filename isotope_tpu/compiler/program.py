@@ -55,11 +55,18 @@ class HopLevel:
     which run in parallel with the group's calls,
     srv/executable.go:148-179) or a join over child hops.
 
-    Child arrays describe the hops at depth+1 (in that level's local
-    order): ``child_seg`` maps each child to the flat ``parent_local * Pmax
-    + step`` slot so a scatter-max computes per-step joins — the
-    vectorized form of the reference's WaitGroup join
-    (srv/executable.go:171-175).
+    Child hops (depth+1, in that level's local order) are grouped two
+    ways:
+
+    - per **call**: a call site in a parent's script owns ``retries+1``
+      consecutive attempt hops; ``att_child[a, k]`` is the local child
+      index of call k's attempt a (``att_valid`` masks shorter chains).
+      Attempt durations sum serially; the call's outcome is the last
+      attempt's.
+    - per **step**: ``call_seg`` maps each call to the flat
+      ``parent_local * Pmax + step`` slot so a scatter-max computes the
+      per-step join — the vectorized form of the reference's WaitGroup
+      (srv/executable.go:171-175); sequential steps have one call each.
     """
 
     hop_ids: np.ndarray        # (L,) int32 — global hop ids, level-local order
@@ -68,6 +75,12 @@ class HopLevel:
     step_base: np.ndarray      # (L, Pmax) f32 — sleep seconds (0 for calls)
     child_ids: np.ndarray      # (C,) int32 — global hop ids at depth+1
     child_seg: np.ndarray      # (C,) int32 — parent_local * Pmax + step
+    # -- call tables (K = number of call sites at this level) -------------
+    call_seg: np.ndarray       # (K,) int32 — parent_local * Pmax + step
+    call_step: np.ndarray      # (K,) int32
+    call_timeout: np.ndarray   # (K,) f32 — +inf when none
+    att_child: np.ndarray      # (maxA, K) int32 — local child idx (or C)
+    att_valid: np.ndarray      # (maxA, K) bool
 
     @property
     def num_hops(self) -> int:
@@ -76,6 +89,14 @@ class HopLevel:
     @property
     def num_children(self) -> int:
         return len(self.child_ids)
+
+    @property
+    def num_calls(self) -> int:
+        return len(self.call_seg)
+
+    @property
+    def max_attempts(self) -> int:
+        return self.att_child.shape[0]
 
 
 @dataclasses.dataclass(frozen=True)

@@ -67,11 +67,21 @@ class RequestCommand:
 
     ``probability`` is an int percentage in [0, 100]; 0 means "always send"
     (matching srv/executable.go:84-90's shouldSkipRequest).
+
+    ``timeout`` (seconds) and ``retries`` are extensions beyond the
+    reference's call grammar: the reference delegates both to Istio
+    VirtualService policy outside the topology spec, while the simulator
+    models them at the call site.  ``timeout=None`` means no timeout;
+    ``retries`` counts extra attempts after a failed one (a failure is a
+    5xx response, a connection failure, or a timeout — Envoy's
+    ``retry-on`` defaults).
     """
 
     service_name: str
     size: ByteSize = ByteSize(0)
     probability: int = 0
+    timeout: float | None = None
+    retries: int = 0
 
     @classmethod
     def decode(cls, value, default: "RequestCommand") -> "RequestCommand":
@@ -82,10 +92,14 @@ class RequestCommand:
                 service_name=value,
                 size=default.size,
                 probability=default.probability,
+                timeout=default.timeout,
+                retries=default.retries,
             )
         if not isinstance(value, dict):
             raise InvalidCommandError(f"invalid call command: {value!r}")
-        unknown = set(value) - {"service", "size", "probability"}
+        unknown = set(value) - {
+            "service", "size", "probability", "timeout", "retries",
+        }
         if unknown:
             raise InvalidCommandError(f"unknown call fields: {sorted(unknown)}")
         size = (
@@ -101,16 +115,41 @@ class RequestCommand:
             raise InvalidCommandError(
                 "math: invalid probability, outside range: [0,100]"
             )
+        if "timeout" in value:
+            if not isinstance(value["timeout"], str):
+                raise InvalidCommandError(
+                    f"timeout must be a duration string: {value['timeout']!r}"
+                )
+            timeout = duration.parse_duration_seconds(value["timeout"])
+            if timeout <= 0:
+                raise InvalidCommandError("timeout must be positive")
+        else:
+            timeout = default.timeout
+        retries = value.get("retries", default.retries)
+        if (
+            isinstance(retries, bool)
+            or not isinstance(retries, int)
+            or retries < 0
+        ):
+            raise InvalidCommandError(
+                f"retries must be a non-negative integer: {retries!r}"
+            )
         return cls(
             service_name=value.get("service", default.service_name),
             size=size,
             probability=probability,
+            timeout=timeout,
+            retries=retries,
         )
 
     def encode(self):
         body: dict = {"service": self.service_name, "size": self.size.encode()}
         if self.probability:
             body["probability"] = self.probability
+        if self.timeout is not None:
+            body["timeout"] = duration.format_duration_seconds(self.timeout)
+        if self.retries:
+            body["retries"] = self.retries
         return {REQUEST_COMMAND_KEY: body}
 
     @property

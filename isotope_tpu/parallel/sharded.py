@@ -69,10 +69,11 @@ class ShardedSimulator:
         compiled: CompiledGraph,
         mesh: Mesh,
         params: SimParams = SimParams(),
+        chaos=(),
     ):
         self.compiled = compiled
         self.mesh = mesh
-        self.sim = Simulator(compiled, params)
+        self.sim = Simulator(compiled, params, chaos)
         self.collector = MetricsCollector(compiled)
         self.n_data = mesh.shape[DATA_AXIS]
         self.n_svc = mesh.shape[SVC_AXIS]

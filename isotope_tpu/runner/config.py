@@ -18,7 +18,12 @@ import pathlib
 import tomllib
 from typing import Dict, List, Optional, Tuple
 
-from isotope_tpu.sim.config import LoadModel, NetworkModel, SimParams
+from isotope_tpu.sim.config import (
+    ChaosEvent,
+    LoadModel,
+    NetworkModel,
+    SimParams,
+)
 from isotope_tpu.utils import duration as dur
 
 
@@ -64,13 +69,17 @@ class ExperimentConfig:
     seed: int = 0
     cpu_time_s: float = SimParams().cpu_time_s
     service_time: str = SimParams().service_time
+    service_time_param: float = SimParams().service_time_param
     mesh_data: int = 0                   # 0 => all devices
     mesh_svc: int = 1
     labels: str = ""
+    chaos: Tuple[ChaosEvent, ...] = ()
 
     def sim_params(self) -> SimParams:
         return SimParams(
-            cpu_time_s=self.cpu_time_s, service_time=self.service_time
+            cpu_time_s=self.cpu_time_s,
+            service_time=self.service_time,
+            service_time_param=self.service_time_param,
         )
 
     def load_models(self):
@@ -136,6 +145,18 @@ def load_toml(path) -> ExperimentConfig:
         else [int(conns_raw)]
     )
 
+    chaos: List[ChaosEvent] = []
+    for ev in doc.get("chaos", []):
+        down = ev.get("replicas_down", "all")
+        chaos.append(
+            ChaosEvent(
+                service=ev["service"],
+                start_s=dur.parse_duration_seconds(ev["start"]),
+                end_s=dur.parse_duration_seconds(ev["end"]),
+                replicas_down=None if down == "all" else int(down),
+            )
+        )
+
     sim = doc.get("sim", {})
     defaults = SimParams()
     return ExperimentConfig(
@@ -153,7 +174,11 @@ def load_toml(path) -> ExperimentConfig:
             else defaults.cpu_time_s
         ),
         service_time=sim.get("service_time", defaults.service_time),
+        service_time_param=float(
+            sim.get("service_time_param", defaults.service_time_param)
+        ),
         mesh_data=int(sim.get("mesh_data", 0)),
         mesh_svc=int(sim.get("mesh_svc", 1)),
         labels=doc.get("labels", ""),
+        chaos=tuple(chaos),
     )

@@ -82,10 +82,13 @@ def run_experiment(
         collector = MetricsCollector(compiled)
         for env in config.environments:
             params = env.apply(config.sim_params())
-            sim = Simulator(compiled, params)
+            sim = Simulator(compiled, params, config.chaos)
             sharded = (
                 ShardedSimulator(
-                    compiled, make_mesh(mesh_data, mesh_svc), params
+                    compiled,
+                    make_mesh(mesh_data, mesh_svc),
+                    params,
+                    config.chaos,
                 )
                 if use_mesh
                 else None

@@ -9,6 +9,7 @@ Ds``).  Here they are explicit, reproducible model parameters.
 from __future__ import annotations
 
 import dataclasses
+from typing import Optional
 
 
 # The reference's mock service saturates at 12-14k QPS on one vCPU
@@ -17,6 +18,8 @@ DEFAULT_CPU_TIME_S = 1.0 / 13_000.0
 
 SERVICE_TIME_EXPONENTIAL = "exponential"
 SERVICE_TIME_DETERMINISTIC = "deterministic"
+SERVICE_TIME_LOGNORMAL = "lognormal"
+SERVICE_TIME_PARETO = "pareto"
 
 
 @dataclasses.dataclass(frozen=True)
@@ -43,18 +46,59 @@ class SimParams:
     cpu_time_s: float = DEFAULT_CPU_TIME_S
     # "exponential" matches the M/M/k queue model exactly (closed-form
     # validation); "deterministic" uses the fixed CPU demand (an M/D/k
-    # approximation sampled with M/M/k waits).
+    # approximation sampled with M/M/k waits); "lognormal" / "pareto" are
+    # heavy-tail mixtures (BASELINE.json configs[4]) with the same mean —
+    # ``service_time_param`` is sigma (log-space) resp. the tail index
+    # alpha (> 1).
     service_time: str = SERVICE_TIME_EXPONENTIAL
+    service_time_param: float = 1.0
     network: NetworkModel = NetworkModel()
 
     def __post_init__(self):
         if self.service_time not in (
             SERVICE_TIME_EXPONENTIAL,
             SERVICE_TIME_DETERMINISTIC,
+            SERVICE_TIME_LOGNORMAL,
+            SERVICE_TIME_PARETO,
         ):
             raise ValueError(f"unknown service_time: {self.service_time!r}")
         if self.cpu_time_s <= 0:
             raise ValueError("cpu_time_s must be positive")
+        if self.service_time == SERVICE_TIME_PARETO and (
+            self.service_time_param <= 1.0
+        ):
+            raise ValueError("pareto tail index alpha must be > 1 for a "
+                             "finite mean")
+        if self.service_time == SERVICE_TIME_LOGNORMAL and (
+            self.service_time_param <= 0.0
+        ):
+            raise ValueError("lognormal sigma must be positive")
+
+
+@dataclasses.dataclass(frozen=True)
+class ChaosEvent:
+    """Kill replicas of a service during a time window.
+
+    The simulation analogue of the reference's chaos CronJobs
+    (perf/stability/istio-chaos-partial kills all-but-one replica every
+    interval; istio-chaos-total scales components to zero and restores
+    them after chaosDurationMinutes).  ``replicas_down=None`` means all
+    replicas (total outage: callers get transport errors, which — unlike
+    downstream 500s — DO propagate, srv/handler.go:66-76).
+    """
+
+    service: str
+    start_s: float
+    end_s: float
+    replicas_down: Optional[int] = None  # None == all
+
+    def __post_init__(self):
+        if self.end_s <= self.start_s:
+            raise ValueError("chaos window must have end_s > start_s")
+        if self.start_s < 0:
+            raise ValueError("chaos window must start at t >= 0")
+        if self.replicas_down is not None and self.replicas_down <= 0:
+            raise ValueError("replicas_down must be positive (or None=all)")
 
 
 OPEN_LOOP = "open"

@@ -17,8 +17,15 @@ One jit-compiled tensor program replaces the reference's entire data plane:
 - ``errorRate`` — spec'd but never implemented by the reference runtime
   (SURVEY.md §2.7) — is implemented for real: a hop errors with its
   service's probability, returns a fast 500 (skips its script), and sends
-  nothing downstream.  Matching executable.go:132-143, a downstream error
-  does NOT fail the caller.
+  nothing downstream.  Matching executable.go:132-143, a downstream 500
+  does NOT fail the caller;
+- chaos schedules (the CronJob replica-killers of perf/stability/
+  istio-chaos-{partial,total}) become piecewise-stationary queue phases:
+  a request samples its waits from the phase its arrival falls in, and a
+  fully-down callee produces a *transport* error — which, unlike a 500,
+  DOES fail the caller (handler.go:66-76): the caller stops at the failing
+  step (concurrent siblings in that step still run, executable.go:148-179)
+  and itself returns a 500 upward.
 
 Everything is static-shaped: (num_requests x num_hops) event tensors, depth
 levels unrolled at trace time, RNG via ``jax.random`` keys.
@@ -27,7 +34,7 @@ from __future__ import annotations
 
 import dataclasses
 from functools import partial
-from typing import Dict, List, NamedTuple, Optional, Tuple
+from typing import Dict, List, NamedTuple, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -39,6 +46,9 @@ from isotope_tpu.sim.config import (
     CLOSED_LOOP,
     OPEN_LOOP,
     SERVICE_TIME_DETERMINISTIC,
+    SERVICE_TIME_LOGNORMAL,
+    SERVICE_TIME_PARETO,
+    ChaosEvent,
     LoadModel,
     SimParams,
 )
@@ -57,9 +67,9 @@ class SimResults(NamedTuple):
 
     client_start: jax.Array    # (N,) client send time
     client_latency: jax.Array  # (N,) client-observed round trip
-    client_error: jax.Array    # (N,) bool — entry service injected a 500
-    hop_sent: jax.Array        # (N, H) bool
-    hop_error: jax.Array       # (N, H) bool (only where sent)
+    client_error: jax.Array    # (N,) bool — entry returned a 500
+    hop_sent: jax.Array        # (N, H) bool — hop actually executed
+    hop_error: jax.Array       # (N, H) bool — hop returned 500 (where sent)
     hop_latency: jax.Array     # (N, H) f32
     hop_start: jax.Array       # (N, H) f32
     utilization: jax.Array     # (S,) rho per service at the offered load
@@ -87,28 +97,74 @@ class _Level:
     step_base: jax.Array        # (L, Pmax) f32
     child_seg: jax.Array        # (C,) i32 — parent_local * Pmax + step
     child_parent_local: jax.Array  # (C,) i32
+    child_step: jax.Array       # (C,) i32 — step index within the parent
     child_rtt: jax.Array        # (C,) f32 — request + response wire time
     child_net_out: jax.Array    # (C,) f32 — one-way request wire time
     child_send_prob: jax.Array  # (C,) f32
+    # call tables (see compiler.program.HopLevel)
+    call_seg: jax.Array         # (K,) i32
+    call_step: jax.Array        # (K,) i32
+    call_timeout: jax.Array     # (K,) f32
+    att_child: np.ndarray       # (maxA, K) i32 — static gather indices
+    att_valid: np.ndarray       # (maxA, K) bool — static masks
 
     @property
     def num_children(self) -> int:
         return len(self.child_seg)
 
+    @property
+    def num_calls(self) -> int:
+        return len(self.call_seg)
+
+    @property
+    def max_attempts(self) -> int:
+        return self.att_child.shape[0]
+
 
 class Simulator:
     """Holds a compiled graph's device constants and jitted entry points."""
 
-    def __init__(self, compiled: CompiledGraph, params: SimParams = SimParams()):
+    def __init__(
+        self,
+        compiled: CompiledGraph,
+        params: SimParams = SimParams(),
+        chaos: Sequence[ChaosEvent] = (),
+    ):
         self.compiled = compiled
         self.params = params
         t = compiled.services
         net = params.network
 
-        self._replicas = jnp.asarray(t.replicas)
         self._k_max = int(t.replicas.max())
         self._visits = jnp.asarray(compiled.expected_visits(), jnp.float32)
         self._mu = 1.0 / params.cpu_time_s
+
+        # -- chaos phases: piecewise-constant effective replica counts -----
+        name_to_idx = {n: i for i, n in enumerate(t.names)}
+        for ev in chaos:
+            if ev.service not in name_to_idx:
+                raise ValueError(f"chaos for unknown service: {ev.service!r}")
+        cuts = sorted(
+            {0.0}
+            | {ev.start_s for ev in chaos}
+            | {ev.end_s for ev in chaos}
+        )
+        eff = np.tile(t.replicas.astype(np.int64), (len(cuts), 1))  # (P, S)
+        for ev in chaos:
+            s = name_to_idx[ev.service]
+            for p, start in enumerate(cuts):
+                if ev.start_s <= start < ev.end_s:
+                    down = (
+                        int(t.replicas[s])
+                        if ev.replicas_down is None
+                        else ev.replicas_down
+                    )
+                    eff[p, s] -= down
+        eff = np.maximum(eff, 0)
+        self._phase_starts = jnp.asarray(cuts, jnp.float32)  # (P,)
+        self._svc_down = jnp.asarray(eff == 0)               # (P, S) bool
+        self._eff_replicas = jnp.asarray(np.maximum(eff, 1), jnp.int32)
+        self.has_chaos = bool(chaos)
 
         # Per-hop gathers are resolved at trace time (static indices).
         hs = compiled.hop_service
@@ -135,6 +191,9 @@ class Simulator:
                     child_parent_local=jnp.asarray(
                         lvl.child_seg // compiled.max_steps
                     ),
+                    child_step=jnp.asarray(
+                        lvl.child_seg % compiled.max_steps
+                    ),
                     child_rtt=jnp.asarray(
                         (net_out[cids] + net_back[cids]), jnp.float32
                     ),
@@ -142,6 +201,11 @@ class Simulator:
                     child_send_prob=jnp.asarray(
                         compiled.hop_send_prob[cids]
                     ),
+                    call_seg=jnp.asarray(lvl.call_seg),
+                    call_step=jnp.asarray(lvl.call_step),
+                    call_timeout=jnp.asarray(lvl.call_timeout),
+                    att_child=lvl.att_child,
+                    att_valid=lvl.att_valid,
                 )
             )
             offset += lvl.num_hops
@@ -214,6 +278,29 @@ class Simulator:
             )
         return self._fns[key]
 
+    def _sample_service_time(self, key: jax.Array, shape) -> jax.Array:
+        """Per-hop CPU time draws with mean ``cpu_time_s``.
+
+        Heavy-tail options model the latency mixtures real fleets show
+        (GC pauses, cold caches): lognormal(sigma) and Pareto(alpha),
+        both scaled so the mean stays the configured CPU demand — the
+        queueing waits remain the M/M/k approximation.
+        """
+        mean = self.params.cpu_time_s
+        kind = self.params.service_time
+        p = self.params.service_time_param
+        if kind == SERVICE_TIME_DETERMINISTIC:
+            return jnp.full(shape, mean)
+        if kind == SERVICE_TIME_LOGNORMAL:
+            # E[exp(sigma Z + mu)] = exp(mu + sigma^2/2) == mean
+            z = jax.random.normal(key, shape)
+            return jnp.exp(p * z - 0.5 * p * p) * mean
+        if kind == SERVICE_TIME_PARETO:
+            # standard Pareto (x_m=1): E = alpha/(alpha-1); rescale to mean
+            x = jnp.exp(jax.random.exponential(key, shape) / p)
+            return x * (mean * (p - 1.0) / p)
+        return jax.random.exponential(key, shape) * mean
+
     # -- the tensor program ------------------------------------------------
 
     def _simulate(
@@ -231,6 +318,7 @@ class Simulator:
         open-loop arrival stream.  They differ only under sharded
         execution, where each shard generates 1/shards of the stream."""
         H = self.compiled.num_hops
+        Pmax = self.compiled.max_steps
         k_send, k_err, k_wait_u, k_wait_e, k_svc, k_arr = jax.random.split(
             key, 6
         )
@@ -239,85 +327,192 @@ class Simulator:
         u_wait = jax.random.uniform(k_wait_u, (n, H))
         e_wait = jax.random.exponential(k_wait_e, (n, H))
 
-        # M/M/k parameters at the offered load; gather to hops.
-        qp = queueing.mmk_params(
-            offered_qps * self._visits, self._mu, self._replicas, self._k_max
-        )
-        hop_qp = queueing.QueueParams(
-            p_wait=qp.p_wait[self._hop_service],
-            wait_rate=qp.wait_rate[self._hop_service],
-            utilization=None,
-            unstable=None,
-        )
-        wait = queueing.sample_wait(hop_qp, u_wait, e_wait)  # (N, H)
-        if self.params.service_time == SERVICE_TIME_DETERMINISTIC:
-            svc_time = jnp.full((n, H), self.params.cpu_time_s)
+        # ---- arrival times (open loop exact; closed loop nominal, used
+        # only to place requests into chaos phases) ------------------------
+        if kind == OPEN_LOOP:
+            gaps = jax.random.exponential(k_arr, (n,)) / arrival_qps
+            arrivals = jnp.cumsum(gaps)
+            nominal_arrivals = arrivals
         else:
-            svc_time = (
-                jax.random.exponential(k_svc, (n, H)) * self.params.cpu_time_s
+            c = max(connections, 1)
+            per = n // c
+            nominal = jnp.arange(per, dtype=jnp.float32) * pace_gap
+            nominal_arrivals = jnp.concatenate(
+                [
+                    jnp.broadcast_to(nominal, (c, per)).reshape(-1),
+                    jnp.zeros((n - c * per,)),
+                ]
             )
+            arrivals = None  # closed-loop arrivals derive from latencies
+
+        # ---- queueing parameters, per chaos phase ------------------------
+        # (P, S): offered load is per-service; replicas vary by phase.
+        qp = queueing.mmk_params(
+            offered_qps * self._visits,
+            self._mu,
+            self._eff_replicas,
+            self._k_max,
+        )
+        phase_idx = (
+            jnp.searchsorted(
+                self._phase_starts, nominal_arrivals, side="right"
+            ).astype(jnp.int32)
+            - 1
+        )  # (N,)
+        hop_svc = self._hop_service  # (H,)
+        wait = queueing.sample_wait(
+            queueing.QueueParams(
+                p_wait=qp.p_wait[phase_idx[:, None], hop_svc[None, :]],
+                wait_rate=qp.wait_rate[phase_idx[:, None], hop_svc[None, :]],
+                utilization=None,
+                unstable=None,
+            ),
+            u_wait,
+            e_wait,
+        )  # (N, H)
+        down = self._svc_down[phase_idx[:, None], hop_svc[None, :]]  # (N, H)
+        # a fully-down service does no work: report zero utilization for
+        # those phases instead of the clamped-to-1-replica saturation
+        util_phase = jnp.where(self._svc_down, 0.0, qp.utilization)
+        unstable_phase = jnp.where(self._svc_down, False, qp.unstable)
+
+        svc_time = self._sample_service_time(k_svc, (n, H))
 
         err_coin = u_err < self._hop_err_rate  # (N, H)
 
-        # ---- downward pass 1: which hops actually happen -----------------
-        sent_lvls: List[jax.Array] = [jnp.ones((n, 1), bool)]
-        for lvl in self._levels[:-1]:
-            if lvl.num_children == 0:
-                sent_lvls.append(jnp.zeros((n, 0), bool))
-                continue
-            sl = slice(lvl.offset, lvl.offset + lvl.size)
-            parent_sent = sent_lvls[-1][:, lvl.child_parent_local]
-            parent_err = err_coin[:, sl][:, lvl.child_parent_local]
-            nxt = self._levels[len(sent_lvls)]
-            csl = slice(nxt.offset, nxt.offset + nxt.size)
-            coin = u_send[:, csl] < lvl.child_send_prob
-            sent_lvls.append(parent_sent & ~parent_err & coin)
-
-        # ---- upward pass: server-side durations --------------------------
+        # ---- upward pass: outcomes + server-side durations ---------------
+        # Processed deepest-first so every call site sees its callees'
+        # (hypothetical) latency and status.  Per level it derives:
+        #   - per-call duration (serial retry attempts sum; each attempt is
+        #     capped by the call's timeout; a down callee costs ~0),
+        #   - the call's final outcome: ok / http-500 / transport (down or
+        #     timeout on the LAST attempt) — transport fails the caller at
+        #     that step (fail_step), a 500 does not (executable.go:132-143),
+        #   - which attempt hops would actually run (``used``), and each
+        #     attempt's time offset inside its step (for start times).
         lat_lvls: List[Optional[jax.Array]] = [None] * len(self._levels)
+        err_lvls: List[Optional[jax.Array]] = [None] * len(self._levels)
+        fail_lvls: List[Optional[jax.Array]] = [None] * len(self._levels)
+        used_lvls: List[Optional[jax.Array]] = [None] * len(self._levels)
         off_lvls: List[Optional[jax.Array]] = [None] * len(self._levels)
         for d in reversed(range(len(self._levels))):
             lvl = self._levels[d]
             sl = slice(lvl.offset, lvl.offset + lvl.size)
             if lvl.num_children > 0:
-                contrib = jnp.where(
-                    sent_lvls[d + 1],
-                    lvl.child_rtt + lat_lvls[d + 1],
-                    0.0,
-                )
+                nxt = self._levels[d + 1]
+                csl = slice(nxt.offset, nxt.offset + nxt.size)
+                C = lvl.num_children
+                # dummy column C absorbs invalid attempt slots
+                pad = lambda x: jnp.pad(x, ((0, 0), (0, 1)))  # noqa: E731
+                lat_child = pad(lat_lvls[d + 1])
+                err_child = pad(err_lvls[d + 1].astype(jnp.float32)) > 0
+                down_child = pad(down[:, csl].astype(jnp.float32)) > 0
+                rtt_child = jnp.pad(lvl.child_rtt, (0, 1))
+
+                a0 = lvl.att_child[0]  # (K,) attempt-0 local child index
+                coin = (
+                    u_send[:, csl][:, a0] < lvl.child_send_prob[a0]
+                )  # (N, K)
+                dur_call = jnp.zeros((n, lvl.num_calls))
+                final_transport = jnp.zeros((n, lvl.num_calls), bool)
+                used = jnp.zeros((n, C + 1), bool)
+                att_off = jnp.zeros((n, C + 1))
+                used_a = coin
+                for a in range(lvl.max_attempts):
+                    idx = lvl.att_child[a]       # (K,) in [0, C]
+                    valid = lvl.att_valid[a]     # (K,) static
+                    use = used_a & valid
+                    t = rtt_child[idx] + lat_child[:, idx]
+                    timed_out = t > lvl.call_timeout
+                    transport_a = down_child[:, idx] | timed_out
+                    failed_a = transport_a | err_child[:, idx]
+                    dur_a = jnp.where(
+                        down_child[:, idx],
+                        0.0,
+                        jnp.minimum(t, lvl.call_timeout),
+                    )
+                    att_off = att_off.at[:, idx].set(
+                        jnp.where(use, dur_call, 0.0)
+                    )
+                    used = used.at[:, idx].set(use)
+                    dur_call = dur_call + jnp.where(use, dur_a, 0.0)
+                    final_transport = jnp.where(
+                        use, transport_a, final_transport
+                    )
+                    used_a = use & failed_a
+                used_lvls[d] = used[:, :C]
+
                 agg = (
-                    jnp.zeros((n, lvl.size * lvl.pmax))
-                    .at[:, lvl.child_seg]
-                    .max(contrib)
-                    .reshape(n, lvl.size, lvl.pmax)
+                    jnp.zeros((n, lvl.size * Pmax))
+                    .at[:, lvl.call_seg]
+                    .max(dur_call)
+                    .reshape(n, lvl.size, Pmax)
                 )
                 step_dur = jnp.maximum(lvl.step_base, agg) * lvl.step_mask
+                # the call's coin gates the failure too: an unsent call
+                # cannot fail anything (used_a starts from coin)
+                fail_contrib = jnp.where(
+                    final_transport, lvl.call_step, Pmax
+                ).astype(jnp.int32)
+                fail_step = (
+                    jnp.full((n, lvl.size), Pmax, jnp.int32)
+                    .at[:, lvl.call_seg // Pmax]
+                    .min(fail_contrib)
+                )
             else:
                 step_dur = (
-                    jnp.broadcast_to(
-                        lvl.step_base, (n, lvl.size, lvl.pmax)
-                    )
+                    jnp.broadcast_to(lvl.step_base, (n, lvl.size, Pmax))
                     * lvl.step_mask
                 )
+                fail_step = jnp.full((n, lvl.size), Pmax, jnp.int32)
+            fail_lvls[d] = fail_step
+            # executed-step mask: errorRate 500s skip the whole script;
+            # transport errors truncate it after the failing step
+            executed = (
+                jnp.arange(Pmax, dtype=jnp.int32) <= fail_step[:, :, None]
+            ) & ~err_coin[:, sl][:, :, None]
+            step_dur = step_dur * executed
             busy = step_dur.sum(-1)
-            errored = err_coin[:, sl]
-            lat_lvls[d] = (
-                wait[:, sl]
-                + svc_time[:, sl]
-                + jnp.where(errored, 0.0, busy)
-            )
+            lat_lvls[d] = wait[:, sl] + svc_time[:, sl] + busy
+            # this hop's own response status: 500 iff errorRate coin or a
+            # transport-failed step
+            err_lvls[d] = err_coin[:, sl] | (fail_step < Pmax)
             if lvl.num_children > 0:
                 prefix = jnp.cumsum(step_dur, axis=-1) - step_dur
-                off_lvls[d] = prefix.reshape(n, -1)[:, lvl.child_seg]
+                off_lvls[d] = (
+                    prefix.reshape(n, -1)[:, lvl.child_seg]
+                    + used_lvls[d] * att_off[:, : lvl.num_children]
+                )
 
-        # ---- arrivals ----------------------------------------------------
-        root_lat = self._root_net + lat_lvls[0][:, 0]
-        if kind == OPEN_LOOP:
-            gaps = jax.random.exponential(k_arr, (n,)) / arrival_qps
-            arrivals = jnp.cumsum(gaps)
-        else:
-            # closed loop: C workers, serial requests, paced to qps overall.
-            c = connections
+        # ---- downward pass: which hops actually execute ------------------
+        # a down ENTRY service refuses the client's connection itself
+        root_down = down[:, 0]
+        sent_lvls: List[jax.Array] = [~root_down[:, None]]
+        for d, lvl in enumerate(self._levels[:-1]):
+            sl = slice(lvl.offset, lvl.offset + lvl.size)
+            nxt = self._levels[d + 1]
+            csl = slice(nxt.offset, nxt.offset + nxt.size)
+            parent_sent = sent_lvls[d][:, lvl.child_parent_local]
+            parent_err = err_coin[:, sl][:, lvl.child_parent_local]
+            parent_fail = fail_lvls[d][:, lvl.child_parent_local]
+            sent_lvls.append(
+                parent_sent
+                & ~parent_err
+                & (lvl.child_step <= parent_fail)
+                & used_lvls[d]
+                & ~down[:, csl]
+            )
+        err_hop_lvls = err_lvls
+
+        # ---- closed-loop arrivals (need latencies) -----------------------
+        # a refused connection to the entry costs one wire round trip
+        root_lat = jnp.where(
+            root_down,
+            2 * self.params.network.one_way(0.0),
+            self._root_net + lat_lvls[0][:, 0],
+        )
+        if kind == CLOSED_LOOP:
+            c = max(connections, 1)
             per = n // c
             lat_conn = root_lat[: c * per].reshape(c, per)
             spent = jnp.maximum(lat_conn, pace_gap)
@@ -336,9 +531,6 @@ class Simulator:
         ]
         for d in range(len(self._levels) - 1):
             lvl = self._levels[d]
-            if lvl.num_children == 0:
-                start_lvls.append(jnp.zeros((n, 0)))
-                continue
             sl = slice(lvl.offset, lvl.offset + lvl.size)
             base = (start_lvls[d] + wait[:, sl])[:, lvl.child_parent_local]
             start_lvls.append(base + off_lvls[d] + lvl.child_net_out)
@@ -346,16 +538,17 @@ class Simulator:
         hop_sent = jnp.concatenate(sent_lvls, axis=1)
         hop_lat = jnp.concatenate(lat_lvls, axis=1)
         hop_start = jnp.concatenate(start_lvls, axis=1)
+        err_hop = jnp.concatenate(err_hop_lvls, axis=1)
         return SimResults(
             client_start=arrivals,
             client_latency=root_lat,
-            client_error=err_coin[:, 0],
+            client_error=err_hop[:, 0] | root_down,
             hop_sent=hop_sent,
-            hop_error=err_coin & hop_sent,
+            hop_error=err_hop & hop_sent,
             hop_latency=hop_lat,
             hop_start=hop_start,
-            utilization=qp.utilization,
-            unstable=qp.unstable,
+            utilization=util_phase.max(axis=0),
+            unstable=unstable_phase.any(axis=0),
             offered_qps=offered_qps,
         )
 
@@ -366,6 +559,7 @@ def simulate(
     num_requests: int,
     key: jax.Array,
     params: SimParams = SimParams(),
+    chaos: Sequence[ChaosEvent] = (),
 ) -> SimResults:
     """One-shot convenience wrapper around :class:`Simulator`."""
-    return Simulator(compiled, params).run(load, num_requests, key)
+    return Simulator(compiled, params, chaos).run(load, num_requests, key)

@@ -1,0 +1,186 @@
+"""Chaos-schedule tests: replica kills, outages, failure propagation.
+
+Semantics under test (mirroring the reference's behavior when its chaos
+CronJobs kill components): a fully-down callee is a *transport* error, so
+the caller stops at the failing step and returns 500 upward
+(srv/handler.go:66-76) — while plain downstream 500s do not propagate
+(executable.go:132-143); concurrent siblings of a failing call still run
+(executable.go:148-179, goroutines are all launched before the join).
+"""
+import jax
+import numpy as np
+import pytest
+
+from isotope_tpu.compiler import compile_graph
+from isotope_tpu.models.graph import ServiceGraph
+from isotope_tpu.sim import LoadModel, SimParams, Simulator
+from isotope_tpu.sim.config import ChaosEvent
+
+KEY = jax.random.PRNGKey(5)
+DET = SimParams(service_time="deterministic")
+CPU = DET.cpu_time_s
+RTT1 = 2 * DET.network.base_latency_s
+
+CHAIN = """
+services:
+- name: entry
+  isEntrypoint: true
+  script:
+  - sleep: 10ms
+  - call: mid
+  - sleep: 50ms
+- name: mid
+"""
+
+
+def run_chain(chaos, n=4000, qps=20.0, yaml=CHAIN):
+    compiled = compile_graph(ServiceGraph.from_yaml(yaml))
+    sim = Simulator(compiled, DET, chaos)
+    return sim.run(LoadModel(kind="open", qps=qps), n, KEY)
+
+
+def test_outage_window_errors_propagate_to_client():
+    # ~200s of traffic; mid fully down in [50, 100) => ~25% client errors
+    res = run_chain([ChaosEvent("mid", 50.0, 100.0)])
+    starts = np.asarray(res.client_start)
+    err = np.asarray(res.client_error)
+    in_window = (starts >= 50.0) & (starts < 100.0)
+    assert err[in_window].all()
+    assert not err[~in_window].any()
+    # down callee is never executed in the window
+    sent_mid = np.asarray(res.hop_sent[:, 1])
+    assert not sent_mid[in_window].any()
+    assert sent_mid[~in_window].all()
+
+
+def test_failure_truncates_script_at_failing_step():
+    res = run_chain([ChaosEvent("mid", 50.0, 100.0)])
+    starts = np.asarray(res.client_start)
+    lat = np.asarray(res.client_latency)
+    in_window = (starts >= 50.0) & (starts < 100.0)
+    # healthy: 10ms + (rtt + cpu) + 50ms; failed: the 10ms sleep ran, the
+    # failing call cost ~nothing, the trailing 50ms sleep was skipped.
+    # (medians: rare queueing waits perturb a fraction of samples)
+    healthy = RTT1 + CPU + 0.010 + (RTT1 + CPU) + 0.050
+    failed = RTT1 + CPU + 0.010
+    assert np.median(lat[~in_window]) == pytest.approx(healthy, rel=1e-4)
+    assert np.median(lat[in_window]) == pytest.approx(failed, rel=1e-4)
+
+
+def test_concurrent_sibling_of_failing_call_still_runs():
+    yaml = """
+services:
+- name: entry
+  isEntrypoint: true
+  script:
+  - - call: down
+    - call: slow
+- name: down
+- name: slow
+  script:
+  - sleep: 30ms
+"""
+    res = run_chain([ChaosEvent("down", 0.0, 1e6)], yaml=yaml)
+    # every request fails (down is always down) but the slow sibling runs
+    assert np.asarray(res.client_error).all()
+    assert np.asarray(res.hop_sent[:, 2]).all()  # slow
+    assert not np.asarray(res.hop_sent[:, 1]).any()  # down
+    want = RTT1 + CPU + (RTT1 + CPU + 0.030)
+    assert np.median(res.client_latency) == pytest.approx(want, rel=1e-4)
+
+
+def test_transport_error_propagates_only_one_level():
+    # grandparent -> parent -> down: parent 500s (transport), but parent's
+    # 500 is a valid HTTP response, so grandparent succeeds.
+    yaml = """
+services:
+- name: top
+  isEntrypoint: true
+  script:
+  - call: parent
+- name: parent
+  script:
+  - call: dead
+- name: dead
+"""
+    res = run_chain([ChaosEvent("dead", 0.0, 1e6)], yaml=yaml)
+    assert not np.asarray(res.client_error).any()
+    assert np.asarray(res.hop_error[:, 1]).all()  # parent 500s
+
+
+def test_partial_replica_kill_raises_tail_latency():
+    yaml = """
+services:
+- name: solo
+  isEntrypoint: true
+  numReplicas: 4
+"""
+    compiled = compile_graph(ServiceGraph.from_yaml(yaml))
+    # losing 3 of 4 replicas pushes the survivor to rho=0.9 in-window
+    qps = 0.9 / SimParams().cpu_time_s
+    sim = Simulator(
+        compiled,
+        SimParams(service_time="exponential"),
+        [ChaosEvent("solo", 20.0, 40.0, replicas_down=3)],
+    )
+    # enough requests that the stream spans well past the [20, 40) window
+    res = sim.run(LoadModel(kind="open", qps=qps), 700_000, KEY)
+    starts = np.asarray(res.client_start)
+    lat = np.asarray(res.client_latency)
+    inside = lat[(starts >= 20.0) & (starts < 40.0)]
+    outside = lat[(starts < 20.0) | (starts >= 40.0)]
+    assert not np.asarray(res.client_error).any()  # degraded, not down
+    assert np.quantile(inside, 0.99) > 3 * np.quantile(outside, 0.99)
+    # utilization reports the worst phase
+    assert float(res.utilization[0]) == pytest.approx(0.9, rel=1e-3)
+    assert not bool(res.unstable[0])
+
+
+def test_chaos_validation():
+    with pytest.raises(ValueError):
+        ChaosEvent("x", 10.0, 10.0)
+    with pytest.raises(ValueError):
+        ChaosEvent("x", -1.0, 10.0)
+    with pytest.raises(ValueError):
+        ChaosEvent("x", 0.0, 10.0, replicas_down=0)
+    compiled = compile_graph(
+        ServiceGraph.from_yaml("services:\n- name: a\n  isEntrypoint: true\n")
+    )
+    with pytest.raises(ValueError, match="unknown service"):
+        Simulator(compiled, chaos=[ChaosEvent("ghost", 0.0, 1.0)])
+
+
+def test_no_chaos_unchanged_semantics():
+    res = run_chain([])
+    assert not np.asarray(res.client_error).any()
+    want = RTT1 + CPU + 0.010 + (RTT1 + CPU) + 0.050
+    assert np.median(res.client_latency) == pytest.approx(want, rel=1e-4)
+
+
+def test_entry_outage_refuses_client_connections():
+    # chaos on the entrypoint itself: the client's connection is refused —
+    # client errors, nothing executes, latency is one wire round trip.
+    yaml = "services:\n- name: entry\n  isEntrypoint: true\n  script:\n  - sleep: 20ms\n"
+    res = run_chain([ChaosEvent("entry", 50.0, 100.0)], yaml=yaml)
+    starts = np.asarray(res.client_start)
+    err = np.asarray(res.client_error)
+    sent = np.asarray(res.hop_sent[:, 0])
+    in_window = (starts >= 50.0) & (starts < 100.0)
+    assert err[in_window].all() and not err[~in_window].any()
+    assert not sent[in_window].any() and sent[~in_window].all()
+    lat = np.asarray(res.client_latency)
+    assert np.median(lat[in_window]) == pytest.approx(RTT1, rel=1e-3)
+    assert np.median(lat[~in_window]) == pytest.approx(
+        RTT1 + CPU + 0.020, rel=1e-3
+    )
+
+
+def test_down_service_reports_zero_utilization():
+    # numReplicas=4 at rho=0.5; total outage must NOT report saturation
+    yaml = "services:\n- name: solo\n  isEntrypoint: true\n  numReplicas: 4\n"
+    compiled = compile_graph(ServiceGraph.from_yaml(yaml))
+    qps = 2.0 / SimParams().cpu_time_s  # rho = 0.5 across 4 replicas
+    sim = Simulator(compiled, DET, [ChaosEvent("solo", 10.0, 20.0)])
+    res = sim.run(LoadModel(kind="open", qps=qps), 10_000, KEY)
+    assert float(res.utilization[0]) == pytest.approx(0.5, rel=1e-3)
+    assert not bool(res.unstable[0])

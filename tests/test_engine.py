@@ -241,3 +241,40 @@ def test_closed_loop_paced_by_qps():
     # each of 10 workers paces to 10 rps => gaps of 100ms >> latency
     starts = np.asarray(res.client_start).reshape(10, 100)
     np.testing.assert_allclose(np.diff(starts, axis=1), 0.1, rtol=1e-4)
+
+
+def test_heavy_tail_service_times():
+    """Lognormal/Pareto mixtures keep the mean but fatten the tail."""
+    import numpy as _np
+
+    base = run(
+        "services:\n- name: a\n  isEntrypoint: true\n",
+        n=100_000,
+        params=SimParams(service_time="exponential"),
+    )
+    logn = run(
+        "services:\n- name: a\n  isEntrypoint: true\n",
+        n=100_000,
+        params=SimParams(service_time="lognormal", service_time_param=2.0),
+    )
+    par = run(
+        "services:\n- name: a\n  isEntrypoint: true\n",
+        n=100_000,
+        params=SimParams(service_time="pareto", service_time_param=1.5),
+    )
+    for res in (logn, par):
+        svc = _np.asarray(res.client_latency) - RTT1
+        bsvc = _np.asarray(base.client_latency) - RTT1
+        # same mean (within MC noise; pareto alpha=1.5 converges slowly)
+        assert svc.mean() == pytest.approx(bsvc.mean(), rel=0.25)
+        # much fatter p999
+        assert _np.quantile(svc, 0.999) > 3 * _np.quantile(bsvc, 0.999)
+
+
+def test_service_time_param_validation():
+    with pytest.raises(ValueError):
+        SimParams(service_time="pareto", service_time_param=1.0)
+    with pytest.raises(ValueError):
+        SimParams(service_time="lognormal", service_time_param=0.0)
+    with pytest.raises(ValueError):
+        SimParams(service_time="weibull")

@@ -1,0 +1,191 @@
+"""Retry / timeout extension tests.
+
+These knobs extend the reference's call grammar (which defers both to
+Istio VirtualService policy): an attempt fails on a 5xx response, a
+connection failure (down service), or a timeout; failed attempts retry up
+to ``retries`` times; an exhausted call whose last attempt was a
+transport-class failure fails the caller (like handler.go:66-76), while an
+exhausted 5xx does not (executable.go:132-143).
+"""
+import jax
+import numpy as np
+import pytest
+
+from isotope_tpu.compiler import compile_graph
+from isotope_tpu.models.graph import ServiceGraph
+from isotope_tpu.models.script import InvalidCommandError, RequestCommand
+from isotope_tpu.sim import LoadModel, SimParams, Simulator
+from isotope_tpu.sim.config import ChaosEvent
+
+KEY = jax.random.PRNGKey(9)
+DET = SimParams(service_time="deterministic")
+CPU = DET.cpu_time_s
+RTT1 = 2 * DET.network.base_latency_s
+QUIET = LoadModel(kind="open", qps=10.0)
+
+
+def run(yaml, n=4000, chaos=(), load=QUIET):
+    compiled = compile_graph(ServiceGraph.from_yaml(yaml))
+    return compiled, Simulator(compiled, DET, chaos).run(load, n, KEY)
+
+
+# -- IR ---------------------------------------------------------------------
+
+def test_decode_encode_roundtrip():
+    cmd = RequestCommand.decode(
+        {"service": "b", "timeout": "250ms", "retries": 2},
+        RequestCommand(service_name=""),
+    )
+    assert cmd.timeout == pytest.approx(0.25)
+    assert cmd.retries == 2
+    enc = cmd.encode()["call"]
+    assert enc["timeout"] == "250ms" and enc["retries"] == 2
+    again = RequestCommand.decode(enc, RequestCommand(service_name=""))
+    assert again == cmd
+
+
+def test_decode_validation():
+    default = RequestCommand(service_name="")
+    with pytest.raises(InvalidCommandError):
+        RequestCommand.decode({"service": "b", "timeout": 5}, default)
+    with pytest.raises(InvalidCommandError):
+        RequestCommand.decode({"service": "b", "timeout": "-1s"}, default)
+    with pytest.raises(InvalidCommandError):
+        RequestCommand.decode({"service": "b", "retries": -1}, default)
+    with pytest.raises(InvalidCommandError):
+        RequestCommand.decode({"service": "b", "retries": True}, default)
+
+
+# -- compiler ---------------------------------------------------------------
+
+def test_attempts_unrolled_as_sibling_hops():
+    c = compile_graph(
+        ServiceGraph.from_yaml(
+            """
+services:
+- name: entry
+  isEntrypoint: true
+  script:
+  - call: {service: flaky, retries: 2}
+- name: flaky
+  errorRate: 50%
+"""
+        )
+    )
+    assert c.num_hops == 4  # entry + 3 attempts
+    root = c.levels[0]
+    assert root.num_calls == 1
+    assert root.att_child.shape == (3, 1)
+    assert root.att_valid.all()
+    # static reach discounts attempts by the target's error rate
+    np.testing.assert_allclose(c.hop_reach, [1.0, 1.0, 0.5, 0.25])
+    visits = c.expected_visits()
+    assert visits[c.services.index_of("flaky")] == pytest.approx(1.75)
+
+
+# -- engine -----------------------------------------------------------------
+
+def test_timeout_caps_call_and_fails_caller():
+    _, res = run(
+        """
+services:
+- name: entry
+  isEntrypoint: true
+  script:
+  - call: {service: slow, timeout: 20ms}
+  - sleep: 500ms
+- name: slow
+  script:
+  - sleep: 100ms
+"""
+    )
+    # every call times out: entry 500s, trailing sleep skipped, the slow
+    # callee itself still ran (and is a hop event)
+    assert np.asarray(res.client_error).all()
+    assert np.asarray(res.hop_sent[:, 1]).all()
+    want = RTT1 + CPU + 0.020
+    assert np.median(res.client_latency) == pytest.approx(want, rel=1e-3)
+
+
+def test_retries_recover_from_downstream_500s():
+    compiled, res = run(
+        """
+services:
+- name: entry
+  isEntrypoint: true
+  script:
+  - call: {service: flaky, retries: 2}
+- name: flaky
+  errorRate: 50%
+""",
+        n=20000,
+    )
+    # 500s never propagate: client clean either way
+    assert not np.asarray(res.client_error).any()
+    sent = np.asarray(res.hop_sent)
+    # attempt chain: 1 + 0.5 + 0.25 expected executions per request
+    attempts_per_req = sent[:, 1:].sum(1)
+    assert attempts_per_req.mean() == pytest.approx(1.75, rel=0.03)
+    # ~87.5% of requests end with a 200 from flaky on some attempt
+    err = np.asarray(res.hop_error)
+    last_ok = (sent[:, 1:] & ~err[:, 1:]).any(axis=1)
+    assert last_ok.mean() == pytest.approx(1 - 0.5**3, abs=0.02)
+
+
+def test_retries_against_down_service_fail_transport():
+    _, res = run(
+        """
+services:
+- name: entry
+  isEntrypoint: true
+  script:
+  - call: {service: dead, retries: 3}
+- name: dead
+""",
+        chaos=[ChaosEvent("dead", 0.0, 1e6)],
+    )
+    assert np.asarray(res.client_error).all()
+    # connection-refused attempts never execute on the dead service
+    assert int(np.asarray(res.hop_sent)[:, 1:].sum()) == 0
+    # and they cost ~nothing
+    want = RTT1 + CPU
+    assert np.median(res.client_latency) == pytest.approx(want, rel=1e-3)
+
+
+def test_retry_after_timeout_adds_serial_attempt_durations():
+    _, res = run(
+        """
+services:
+- name: entry
+  isEntrypoint: true
+  script:
+  - call: {service: slow, timeout: 10ms, retries: 1}
+- name: slow
+  script:
+  - sleep: 30ms
+"""
+    )
+    # both attempts time out at 10ms each, serially
+    assert np.asarray(res.client_error).all()
+    want = RTT1 + CPU + 0.010 + 0.010
+    assert np.median(res.client_latency) == pytest.approx(want, rel=1e-3)
+    # both attempts executed on the slow service
+    assert np.asarray(res.hop_sent)[:, 1:].all()
+
+
+def test_generous_timeout_is_a_noop():
+    _, res = run(
+        """
+services:
+- name: entry
+  isEntrypoint: true
+  script:
+  - call: {service: leaf, timeout: 10s, retries: 2}
+- name: leaf
+"""
+    )
+    assert not np.asarray(res.client_error).any()
+    sent = np.asarray(res.hop_sent)
+    assert sent[:, 1].all() and not sent[:, 2:].any()  # no retries needed
+    want = RTT1 + CPU + (RTT1 + CPU)
+    assert np.median(res.client_latency) == pytest.approx(want, rel=1e-3)

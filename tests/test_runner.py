@@ -137,3 +137,18 @@ def test_cli_simulate_unknown_environment_errors(capsys):
     rc = cli.main(["simulate", str(TOPO), "--environment", "NOPE"])
     assert rc == 1
     assert "unknown environment" in capsys.readouterr().err
+
+
+def test_heavy_tail_toml_plumbing(tmp_path):
+    cfg = load_toml(
+        small_toml(tmp_path, service_time="pareto", service_time_param=1.5)
+    )
+    params = cfg.sim_params()
+    assert params.service_time == "pareto"
+    assert params.service_time_param == 1.5
+    # and it actually runs
+    results = run_experiment(
+        load_toml(small_toml(tmp_path, service_time="lognormal",
+                             service_time_param=2.0, num_requests=500))
+    )
+    assert results and results[0].flat["p50"] > 0
