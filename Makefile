@@ -1,0 +1,53 @@
+# Developer drivers — the shape of the reference's isotope/Makefile
+# (generate topology -> convert/deploy -> drive load), with simulation
+# replacing kubectl apply.
+
+PY ?= python
+QPS ?= 1000
+DURATION ?= 120s
+
+.PHONY: test bench examples canonical tree star multitier \
+	auxiliary-services star-auxiliary latency cpu_mem dot clean
+
+test:
+	$(PY) -m pytest tests/ -x -q
+
+bench:
+	$(PY) bench.py
+
+examples:
+	$(PY) tools/gen_examples.py
+
+# -- single-topology runs (reference Makefile:30-72 targets) -------------
+
+canonical:
+	isotope-tpu simulate examples/topologies/canonical.yaml \
+		--qps $(QPS) --duration $(DURATION) --load-kind open
+
+tree:
+	isotope-tpu generate tree --levels 4 --branches 3 -o /tmp/tree.yaml
+	isotope-tpu simulate /tmp/tree.yaml --qps $(QPS) --duration $(DURATION) \
+		--load-kind open
+
+star multitier auxiliary-services star-auxiliary:
+	isotope-tpu generate realistic --services 50 --type $@ -o /tmp/$@.yaml
+	isotope-tpu simulate /tmp/$@.yaml --qps $(QPS) --duration $(DURATION) \
+		--load-kind open
+
+# -- benchmark sweeps (perf/benchmark/configs shapes) --------------------
+
+latency:
+	isotope-tpu sweep configs/latency.toml -o results/latency
+	isotope-tpu plot results/latency/benchmark.csv --x conn \
+		-o results/latency/latency.png
+
+cpu_mem:
+	isotope-tpu sweep configs/cpu_mem.toml -o results/cpu_mem
+	isotope-tpu plot results/cpu_mem/benchmark.csv --x qps \
+		--metrics p50,p99 -o results/cpu_mem/latency.png
+
+dot:
+	isotope-tpu graphviz examples/topologies/canonical.yaml canonical.dot
+
+clean:
+	rm -rf results canonical.dot /tmp/tree.yaml

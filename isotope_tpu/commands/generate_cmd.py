@@ -27,6 +27,10 @@ def register(sub) -> None:
     tree.add_argument(
         "--sleep", default=None, help='per-service sleep, e.g. "10ms"'
     )
+    tree.add_argument(
+        "--num-services", type=int, default=None,
+        help="cap the tree at exactly this many services",
+    )
     tree.add_argument("-o", "--output", default=None)
     tree.set_defaults(func=run_tree)
 
@@ -67,6 +71,7 @@ def run_tree(args) -> int:
             response_size=args.response_size,
             num_replicas=args.num_replicas,
             sleep=args.sleep,
+            num_services=args.num_services,
         ),
         args.output,
     )

@@ -52,11 +52,45 @@ def register(sub) -> None:
                    help="also write the Prometheus text exposition here")
     s.set_defaults(func=run_simulate)
 
+    k = sub.add_parser(
+        "check",
+        help="simulate a topology and evaluate the stability alarm suite",
+    )
+    k.add_argument("topology")
+    k.add_argument("--qps", default="1000")
+    k.add_argument("--connections", "-c", type=int, default=64)
+    k.add_argument("--duration", "-t", default="240s")
+    k.add_argument("--load-kind", choices=["open", "closed"], default="open")
+    k.add_argument("--max-requests", type=int, default=200_000)
+    k.add_argument("--seed", type=int, default=0)
+    k.add_argument("--cpu-limit", type=float, default=50.0,
+                   help="per-service CPU alarm threshold, milli-cores "
+                        "(the reference's load-test override is 250)")
+    k.add_argument("--mem-limit", type=float, default=64.0,
+                   help="per-service memory alarm threshold, MiB")
+    k.add_argument("--debug", action="store_true",
+                   help="print every query result")
+    k.set_defaults(func=run_check)
+
     w = sub.add_parser("sweep", help="run a TOML-configured experiment")
     w.add_argument("config", help="experiment TOML (example-config.toml shape)")
     w.add_argument("--out", "-o", default="results",
                    help="output directory (default: ./results)")
     w.set_defaults(func=run_sweep)
+
+    p = sub.add_parser(
+        "plot", help="plot latency/CPU curves from a sweep's benchmark.csv"
+    )
+    p.add_argument("csv", help="benchmark.csv from a sweep")
+    p.add_argument("--x", choices=["conn", "qps"], default="conn")
+    p.add_argument("--metrics", default="p50,p90,p99",
+                   help="comma-separated columns (latency in us, or e.g. "
+                        "cpu_cores_<service>)")
+    p.add_argument("--series", default=None,
+                   help="comma-separated series (default: all)")
+    p.add_argument("--title", default=None)
+    p.add_argument("-o", "--output", default="benchmark.png")
+    p.set_defaults(func=run_plot)
 
 
 def _require_jax() -> None:
@@ -117,6 +151,71 @@ def run_simulate(args) -> int:
             f"{result.window.discard_reason}",
             file=sys.stderr,
         )
+    return 0
+
+
+def run_check(args) -> int:
+    _require_jax()
+    import pathlib
+
+    import jax
+
+    from isotope_tpu.compiler import compile_graph
+    from isotope_tpu.metrics.alarms import (
+        RunSource,
+        requests_sanity,
+        run_queries,
+        standard_queries,
+    )
+    from isotope_tpu.models.graph import ServiceGraph
+    from isotope_tpu.sim.config import LoadModel
+    from isotope_tpu.sim.engine import Simulator
+
+    compiled = compile_graph(ServiceGraph.from_yaml_file(args.topology))
+    qps = None if args.qps == "max" else float(args.qps)
+    load = LoadModel(
+        kind=args.load_kind,
+        qps=qps,
+        connections=args.connections,
+        duration_s=dur.parse_duration_seconds(args.duration),
+    )
+    sim = Simulator(compiled)
+    rate = qps if qps is not None else sim.capacity_qps()
+    n = max(1, min(int(rate * load.duration_s), args.max_requests))
+    res = sim.run(load, n, jax.random.PRNGKey(args.seed))
+    label = pathlib.Path(args.topology).stem
+    queries = standard_queries(
+        label, cpu_lim=args.cpu_limit, mem_lim=args.mem_limit
+    ) + [requests_sanity(label)]
+    errors = run_queries(
+        queries, RunSource(compiled, res), debug=args.debug,
+        log=lambda m: print(m, file=sys.stderr),
+    )
+    for e in errors:
+        print(f"ALARM: {e}", file=sys.stderr)
+    print(
+        f"{len(queries) - len(errors)}/{len(queries)} checks passed",
+        file=sys.stderr,
+    )
+    return 1 if errors else 0
+
+
+def run_plot(args) -> int:
+    from isotope_tpu.plotting import plot_benchmark
+
+    plotted = plot_benchmark(
+        args.csv,
+        args.output,
+        x_axis=args.x,
+        metrics=[m.strip() for m in args.metrics.split(",") if m.strip()],
+        series=(
+            [s.strip() for s in args.series.split(",")]
+            if args.series
+            else None
+        ),
+        title=args.title,
+    )
+    print(f"plotted {len(plotted)} series -> {args.output}", file=sys.stderr)
     return 0
 
 

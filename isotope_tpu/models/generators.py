@@ -35,13 +35,18 @@ def tree_topology(
     response_size: int = 128,
     num_replicas: int = 1,
     sleep: Optional[str] = None,
+    num_services: Optional[int] = None,
 ) -> dict:
     """Complete tree; each parent calls all children in one concurrent step.
 
     Service naming follows the reference's path scheme: root "svc-0",
     children "svc-0-0", "svc-0-1", ... (create_tree_topology.py:47-57).
+    ``num_services`` caps the BFS at an exact count (the shape of the
+    reference's N-svc_M-end example topologies); default is the complete
+    tree.
     """
-    num_services = sum(num_branches**i for i in range(num_levels))
+    if num_services is None:
+        num_services = sum(num_branches**i for i in range(num_levels))
     services: List[dict] = []
     queue: List[tuple] = [({"name": "svc-0", "isEntrypoint": True}, ["0"])]
     while queue and len(services) < num_services:

@@ -1,0 +1,94 @@
+"""Alarm-suite tests (check_metrics.py / metrics/prometheus.py parity)."""
+import jax
+import pytest
+
+from isotope_tpu import cli
+from isotope_tpu.compiler import compile_graph
+from isotope_tpu.metrics.alarms import (
+    Alarm,
+    Query,
+    RunSource,
+    requests_sanity,
+    run_queries,
+    standard_queries,
+)
+from isotope_tpu.models.graph import ServiceGraph
+from isotope_tpu.sim import LoadModel, SimParams, Simulator
+
+KEY = jax.random.PRNGKey(2)
+
+
+def source(yaml, qps=100.0, n=5000, **simkw):
+    compiled = compile_graph(ServiceGraph.from_yaml(yaml))
+    res = Simulator(compiled, SimParams(**simkw)).run(
+        LoadModel(kind="open", qps=qps), n, KEY
+    )
+    return RunSource(compiled, res)
+
+
+CLEAN = "services:\n- name: a\n  isEntrypoint: true\n  responseSize: 1KiB\n"
+
+
+def test_clean_run_passes_standard_queries():
+    s = source(CLEAN)
+    errors = run_queries(standard_queries() + [requests_sanity()], s)
+    assert errors == []
+
+
+def test_5xx_alarm_fires_on_error_rate():
+    s = source(
+        "services:\n- name: a\n  isEntrypoint: true\n  errorRate: 10%\n"
+    )
+    errors = run_queries(standard_queries(), s)
+    assert any("5xx" in e for e in errors)
+
+
+def test_cpu_alarm_fires_under_heavy_load():
+    # one replica near saturation: ~0.9 cores >> the 50m default limit
+    s = source(CLEAN, qps=0.9 / SimParams().cpu_time_s, n=20000)
+    errors = run_queries(standard_queries(), s)
+    assert any("CPU" in e for e in errors)
+    # the load-test override (250m) still fires at 900m
+    errors = run_queries(standard_queries(cpu_lim=250), s)
+    assert any("CPU" in e for e in errors)
+    # a generous limit does not
+    errors = run_queries(standard_queries(cpu_lim=2000, mem_lim=1000), s)
+    assert errors == []
+
+
+def test_memory_estimate_positive_and_bounded():
+    s = source(CLEAN)
+    mem = s.max_memory_bytes()
+    assert 0 < mem < 1e6  # a few in-flight 1KiB payloads
+
+
+def test_running_query_gate_skips():
+    s = source(CLEAN)
+    q = Query(
+        "gated", lambda _: 1.0,
+        Alarm(lambda v: True, "should be skipped"),
+        lambda _: False,
+    )
+    assert run_queries([q], s) == []
+
+
+def test_check_cli(tmp_path, capsys):
+    topo = tmp_path / "t.yaml"
+    topo.write_text(CLEAN)
+    rc = cli.main(
+        ["check", str(topo), "--qps", "50", "--duration", "60s",
+         "--max-requests", "3000"]
+    )
+    assert rc == 0
+    assert "4/4 checks passed" in capsys.readouterr().err
+
+    bad = tmp_path / "bad.yaml"
+    bad.write_text(
+        "services:\n- name: a\n  isEntrypoint: true\n  errorRate: 5%\n"
+    )
+    rc = cli.main(
+        ["check", str(bad), "--qps", "50", "--duration", "60s",
+         "--max-requests", "3000"]
+    )
+    assert rc == 1
+    assert "ALARM" in capsys.readouterr().err

@@ -1,0 +1,77 @@
+"""Plotter + example-topology + preset-config tests."""
+import pathlib
+
+import pytest
+
+from isotope_tpu import cli
+from isotope_tpu.compiler import compile_graph
+from isotope_tpu.models.graph import ServiceGraph
+from isotope_tpu.plotting import plot_benchmark
+from isotope_tpu.runner import load_toml
+
+ROOT = pathlib.Path(__file__).parent.parent
+
+CSV = """Labels,StartTime,RequestedQPS,ActualQPS,NumThreads,min,max,p50,p75,p90,p99,p999,errorPercent
+canonical_none_1000qps_2c,t,1000,998,2,2500,4000,2800,2900,3000,3400,3800,0.0
+canonical_none_1000qps_16c,t,1000,997,16,2500,4100,2850,2950,3100,3500,3900,0.0
+canonical_istio_1000qps_2c,t,1000,998,2,4500,6000,4800,4900,5000,5400,5800,0.0
+canonical_istio_1000qps_16c,t,1000,996,16,4500,6100,4850,4950,5100,5500,5900,0.0
+"""
+
+
+def test_plot_benchmark(tmp_path):
+    csv = tmp_path / "benchmark.csv"
+    csv.write_text(CSV)
+    out = tmp_path / "plot.png"
+    series = plot_benchmark(csv, out, x_axis="conn", metrics=["p50", "p99"])
+    assert series == ["canonical_istio", "canonical_none"]
+    assert out.stat().st_size > 1000  # a real PNG
+
+
+def test_plot_unknown_metric(tmp_path):
+    csv = tmp_path / "benchmark.csv"
+    csv.write_text(CSV)
+    with pytest.raises(ValueError, match="p12345"):
+        plot_benchmark(csv, tmp_path / "x.png", metrics=["p12345"])
+
+
+def test_plot_cli(tmp_path, capsys):
+    csv = tmp_path / "benchmark.csv"
+    csv.write_text(CSV)
+    out = tmp_path / "o.png"
+    rc = cli.main(["plot", str(csv), "--x", "conn", "-o", str(out)])
+    assert rc == 0 and out.exists()
+
+
+@pytest.mark.parametrize(
+    "name",
+    [p.name for p in sorted((ROOT / "examples/topologies").glob("*.yaml"))],
+)
+def test_example_topologies_compile(name):
+    graph = ServiceGraph.from_yaml_file(ROOT / "examples/topologies" / name)
+    compiled = compile_graph(
+        graph, entry=None if graph.entrypoints() else graph.services[0].name
+    )
+    assert compiled.num_hops >= len(graph)
+
+
+def test_preset_configs_load():
+    for preset in ("latency.toml", "cpu_mem.toml"):
+        cfg = load_toml(ROOT / "configs" / preset)
+        assert cfg.topology_paths
+        for path in cfg.topology_paths:
+            assert pathlib.Path(path).exists(), path
+        assert cfg.duration_s == 240.0
+
+
+def test_fanout_examples_have_expected_scale():
+    g = ServiceGraph.from_yaml_file(
+        ROOT / "examples/topologies/10-svc_10000-end.yaml"
+    )
+    assert len(g) == 10
+    assert sum(s.num_replicas for s in g.services) == 10_000
+    g = ServiceGraph.from_yaml_file(
+        ROOT / "examples/topologies/1000-svc_2000-end.yaml"
+    )
+    assert len(g) == 1000
+    assert sum(s.num_replicas for s in g.services) == 2000
