@@ -20,12 +20,25 @@ _LO, _HI = 1e-6, 10.0  # seconds
 EDGES = np.concatenate(
     [[0.0], np.geomspace(_LO, _HI, NUM_BUCKETS - 1), [np.inf]]
 )
-_JEDGES = jnp.asarray(EDGES[1:-1], jnp.float32)
+# The edges are geometric, so the bucket index is arithmetic:
+# idx = floor(log(x / LO) / log(r)) + 1 — a searchsorted would binary-search
+# with log2(B) rounds of element gathers, which run at ~2 GiB/s on TPU.
+_LOG_LO = float(np.log(_LO))
+_INV_LOG_R = float((NUM_BUCKETS - 2) / np.log(_HI / _LO))
+
+
+def bucket_index(latencies: jax.Array) -> jax.Array:
+    """Bucket index per latency — pure elementwise math, no gathers."""
+    t = (jnp.log(latencies) - _LOG_LO) * _INV_LOG_R
+    t = jnp.clip(t, -1.0, NUM_BUCKETS - 2)  # catches 0 / -inf
+    idx = jnp.floor(t).astype(jnp.int32) + 1
+    # NaN survives clip; keep searchsorted's behavior (overflow bucket)
+    return jnp.where(jnp.isnan(t), NUM_BUCKETS - 1, idx)
 
 
 def latency_histogram(latencies: jax.Array, weights=None) -> jax.Array:
     """Scatter-add latencies (seconds) into the fine log-spaced buckets."""
-    idx = jnp.searchsorted(_JEDGES, latencies, side="right").astype(jnp.int32)
+    idx = bucket_index(latencies)
     w = weights if weights is not None else jnp.ones_like(latencies)
     return jnp.zeros(NUM_BUCKETS, jnp.float32).at[idx].add(w)
 

@@ -128,11 +128,14 @@ class MetricsCollector:
         )
 
         # duration histogram: scatter every sent hop into (svc, code, bucket)
-        dbuckets = jnp.searchsorted(
-            jnp.asarray(DURATION_BUCKETS, jnp.float32),
-            res.hop_latency,
-            side="left",
-        ).astype(jnp.int32)
+        # bucket index by counting edges below x — 32 fused compares beat a
+        # binary-search gather (element gathers run ~2 GiB/s on TPU)
+        edges = jnp.asarray(DURATION_BUCKETS, jnp.float32)
+        dbuckets = (
+            (res.hop_latency[..., None] > edges)
+            .sum(-1)
+            .astype(jnp.int32)
+        )
         svc = jnp.broadcast_to(self._hop_service, sent.shape)
         dur_hist = (
             jnp.zeros((S, 2, _NB))

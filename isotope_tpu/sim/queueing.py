@@ -106,6 +106,28 @@ def sample_wait(
     return jnp.where(uniform < params.p_wait, wait, 0.0)
 
 
+def sample_wait_conditional(
+    p_wait: jax.Array,
+    wait_rate: jax.Array,
+    uniform: jax.Array,
+) -> jax.Array:
+    """Single-tensor wait draw via the conditional-uniform trick.
+
+    Given U ~ U[0,1), conditional on U < p the ratio U/p is again U[0,1),
+    so one uniform yields both the Erlang-C delay coin and the conditional
+    Exp(wait_rate) wait — halving the RNG tensors the engine materializes.
+    Distributionally identical to :func:`sample_wait`.
+    """
+    ratio = uniform / jnp.maximum(p_wait, 1e-30)
+    # floor must stay in f32 normal range: subnormals (e.g. 1e-38) are
+    # flushed to zero on TPU/CPU XLA, which would let u == 0 produce inf
+    return jnp.where(
+        uniform < p_wait,
+        -jnp.log(jnp.maximum(ratio, 1e-20)) / wait_rate,
+        0.0,
+    )
+
+
 # -- closed forms (test oracles) ------------------------------------------
 
 

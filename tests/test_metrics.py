@@ -192,3 +192,26 @@ def test_write_csv(tmp_path, run):
     lines = path.read_text().splitlines()
     assert lines[0] == "Labels,p50,nothere"
     assert lines[1].startswith("x,") and lines[1].endswith(",-")
+
+
+def test_bucket_index_matches_searchsorted_edges():
+    import numpy as np
+    import jax.numpy as jnp
+    from isotope_tpu.metrics.histogram import (
+        EDGES, NUM_BUCKETS, bucket_index,
+    )
+
+    rng = np.random.default_rng(0)
+    x = np.concatenate([
+        [0.0, 1e-9, 9.99e-7, 1e-6, 5e-6, 9.9, 10.0, 11.0, 1e3],
+        rng.uniform(1e-6, 10.0, 2000),
+        np.exp(rng.uniform(np.log(1e-6), np.log(10.0), 2000)),
+    ]).astype(np.float32)
+    want = np.searchsorted(EDGES[1:-1], x, side="right")
+    got = np.asarray(bucket_index(jnp.asarray(x)))
+    # float32 log math may land exactly-on-edge values one bucket off
+    assert (np.abs(got - want) <= 1).all()
+    assert (got[np.abs(got - want) == 1].size / got.size) < 0.01
+    # NaN keeps searchsorted's overflow-bucket behavior
+    nan_idx = np.asarray(bucket_index(jnp.asarray([np.nan])))
+    assert nan_idx[0] == NUM_BUCKETS - 1

@@ -61,3 +61,29 @@ def test_mm1_sojourn_quantile():
     # mu - lambda = 200 => p50 = ln(2)/200
     q = queueing.mm1_sojourn_quantile(0.5, 800.0, 1000.0)
     assert float(q) == pytest.approx(np.log(2) / 200.0, rel=1e-5)
+
+def test_conditional_wait_matches_two_tensor_sampler():
+    # Same marginal as sample_wait: P(W=0) = 1 - p_wait, and conditional
+    # on waiting the wait is Exp(wait_rate).
+    lam, mu, k = 800.0, 1000.0, jnp.asarray([1])
+    p = queueing.mmk_params(lam, mu, k, k_max=1)
+    key = jax.random.PRNGKey(7)
+    n = 200_000
+    u = jax.random.uniform(key, (n,))
+    waits = queueing.sample_wait_conditional(p.p_wait, p.wait_rate, u)
+    frac_wait = float((waits > 0).mean())
+    assert frac_wait == pytest.approx(float(p.p_wait[0]), abs=0.01)
+    expected_mean = float(queueing.mmk_mean_wait(lam, mu, k, k_max=1)[0])
+    assert float(waits.mean()) == pytest.approx(expected_mean, rel=0.02)
+    # conditional mean given waiting = 1 / wait_rate
+    cond = waits[waits > 0]
+    assert float(cond.mean()) == pytest.approx(
+        1.0 / float(p.wait_rate[0]), rel=0.02
+    )
+
+
+def test_conditional_wait_zero_p_wait_is_zero():
+    w = queueing.sample_wait_conditional(
+        jnp.asarray([0.0]), jnp.asarray([100.0]), jnp.asarray([0.5])
+    )
+    assert float(w[0]) == 0.0

@@ -1,0 +1,45 @@
+"""Capture a jax.profiler trace of the bench step and dump HLO op stats."""
+import glob
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+
+from __graft_entry__ import _flagship
+from isotope_tpu.metrics.histogram import latency_histogram
+from isotope_tpu.sim.config import OPEN_LOOP
+from isotope_tpu.sim.engine import Simulator
+
+OUT = "/tmp/jaxprof"
+
+
+def main():
+    compiled = _flagship()
+    sim = Simulator(compiled)
+    n = 65_536
+    qps = jnp.float32(100_000.0)
+
+    @jax.jit
+    def step(key):
+        res = sim._simulate(n, OPEN_LOOP, 0, key, qps, jnp.float32(0.0), qps)
+        return res.hop_events, latency_histogram(res.client_latency)
+
+    key = jax.random.PRNGKey(0)
+    jax.block_until_ready(step(key))
+
+    with jax.profiler.trace(OUT):
+        out = None
+        for i in range(3):
+            out = step(jax.random.fold_in(key, i))
+        jax.block_until_ready(out)
+
+    xplanes = glob.glob(os.path.join(OUT, "**", "*.xplane.pb"),
+                        recursive=True)
+    print("xplane files:", xplanes)
+
+
+if __name__ == "__main__":
+    main()
