@@ -1,15 +1,23 @@
 """Benchmark: simulated hop-events per second on one chip.
 
-Workload: the ~120-service complete tree (BASELINE.json configs[1]) under
-open-loop load — every request executes all 121 hops, so one batch of N
-requests is N x 121 hop-events.  The timed step is the full jitted
-simulation (RNG, queue sampling, both tree sweeps, arrival stream) plus
-the fine latency-histogram reduction; only scalars/histograms leave the
-device.
+Four workloads, all through the microbatched (lax.scan) summary path —
+HBM holds one request block, counters/histograms accumulate on device:
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
-vs_baseline is measured against the north-star per-chip rate of the
-BASELINE.json target (1e9 hop-events/s on a v5e-8 => 1.25e8 per chip).
+- ``tree121``   (headline): the ~120-service complete tree
+  (BASELINE.json configs[1]) under open-loop load — every request
+  executes all 121 hops.
+- ``svc1000``: the vendored 1000-svc_2000-end.yaml fan-out
+  (BASELINE.json configs[2]) — 1000 hops per request.
+- ``realistic50``: a skewed Barabasi-Albert multitier topology with
+  sequential calls — the unfavorable shape (long scripts, sparse hop
+  execution).
+- ``closed64``: the tree under 64-connection closed-loop load (Fortio's
+  default mode) including the fixed-point rate solve.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", "extra"}.
+``value`` is the headline tree121 rate; vs_baseline measures it against
+the north-star per-chip rate from BASELINE.json (1e9 hop-events/s on a
+v5e-8 => 1.25e8 per chip).
 """
 from __future__ import annotations
 
@@ -17,59 +25,79 @@ import json
 import time
 
 import jax
-import jax.numpy as jnp
 
 NORTH_STAR_PER_CHIP = 1e9 / 8.0
 
 
+def _rate(sim, load, num_requests, block_size, *, warm=10, iters=5):
+    """Steady-state hop-events/s of run_summary on the current device."""
+    key = jax.random.PRNGKey(0)
+
+    def once(k):
+        return sim.run_summary(load, num_requests, k, block_size=block_size)
+
+    s = once(key)
+    jax.block_until_ready(s.count)
+    hops = float(s.hop_events)
+    for i in range(warm):
+        s = once(jax.random.fold_in(key, 1000 + i))
+    jax.block_until_ready(s.count)
+    t0 = time.perf_counter()
+    for i in range(iters):
+        s = once(jax.random.fold_in(key, i))
+    jax.block_until_ready(s.count)
+    dt = time.perf_counter() - t0
+    return hops * iters / dt
+
+
 def main() -> None:
+    import yaml
+
     from __graft_entry__ import _flagship
-    from isotope_tpu.metrics.histogram import latency_histogram
-    from isotope_tpu.sim.config import OPEN_LOOP
+    from isotope_tpu.compiler import compile_graph
+    from isotope_tpu.models.generators import realistic_topology
+    from isotope_tpu.models.graph import ServiceGraph
+    from isotope_tpu.sim.config import LoadModel
     from isotope_tpu.sim.engine import Simulator
 
-    compiled = _flagship()  # 121 services / 121 hops per request
-    sim = Simulator(compiled)
-    platform = jax.devices()[0].platform
-    n = 65_536 if platform != "cpu" else 4_096
-    qps = jnp.float32(100_000.0)
+    on_tpu = jax.devices()[0].platform != "cpu"
+    blk = 65_536 if on_tpu else 4_096
+    blocks = 8 if on_tpu else 2
+    open_load = LoadModel(kind="open", qps=100_000.0)
 
-    @jax.jit
-    def step(key):
-        res = sim._simulate(n, OPEN_LOOP, 0, key, qps, jnp.float32(0.0), qps)
-        return res.hop_events, latency_histogram(res.client_latency)
+    tree = Simulator(_flagship())
+    tree121 = _rate(tree, open_load, blk * blocks, blk)
 
-    key = jax.random.PRNGKey(0)
-    hops, hist = step(key)  # compile + warmup
-    jax.block_until_ready((hops, hist))
-    hops_per_batch = float(hops)
+    extra = {}
+    if on_tpu:
+        doc = yaml.safe_load(
+            open("examples/topologies/1000-svc_2000-end.yaml")
+        )
+        svc1000 = Simulator(compile_graph(ServiceGraph.decode(doc)))
+        extra["svc1000"] = _rate(
+            svc1000, LoadModel(kind="open", qps=10_000.0), 131_072, 8_192
+        )
 
-    # The remote-TPU tunnel lazily uploads program state: the first ~10
-    # executions after compile run an order of magnitude slower than steady
-    # state.  Run a full untimed round first so the timed round measures
-    # the device, not the tunnel warm-up.
-    warm = 10 if platform != "cpu" else 1
-    out = None
-    for i in range(warm):
-        out = step(jax.random.fold_in(key, 1000 + i))
-    jax.block_until_ready(out)
+        real = Simulator(
+            compile_graph(
+                ServiceGraph.decode(
+                    realistic_topology(50, archetype="multitier", seed=0)
+                )
+            )
+        )
+        extra["realistic50"] = _rate(real, open_load, blk * 4, blk)
 
-    iters = 30 if platform != "cpu" else 3
-    t0 = time.perf_counter()
-    out = None
-    for i in range(iters):
-        out = step(jax.random.fold_in(key, i))
-    jax.block_until_ready(out)
-    dt = time.perf_counter() - t0
+        closed = LoadModel(kind="closed", qps=None, connections=64)
+        extra["closed64"] = _rate(tree, closed, blk * blocks, blk)
 
-    rate = hops_per_batch * iters / dt
     print(
         json.dumps(
             {
                 "metric": "simulated hop-events/sec/chip",
-                "value": rate,
+                "value": tree121,
                 "unit": "hop-events/s",
-                "vs_baseline": rate / NORTH_STAR_PER_CHIP,
+                "vs_baseline": tree121 / NORTH_STAR_PER_CHIP,
+                "extra": {k: round(v) for k, v in extra.items()},
             }
         )
     )

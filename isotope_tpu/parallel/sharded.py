@@ -1,8 +1,9 @@
 """shard_map'd simulation with collective-merged metrics.
 
 Every device simulates a disjoint slice of the request stream (the event
-tensor's leading axis is the ``data`` x ``svc`` mesh), then results merge
-with XLA collectives riding ICI:
+tensor's leading axis is the ``data`` x ``svc`` mesh) in HBM-bounded
+blocks under ``lax.scan`` (see sim/summary.py), then block summaries
+merge with XLA collectives riding ICI:
 
 - scalar counters / the fine latency histogram: ``psum`` over both axes;
 - per-service duration histograms: ``psum`` over ``data``, then
@@ -19,46 +20,22 @@ the only communication is the metric reduction — the design that makes
 from __future__ import annotations
 
 from functools import partial
-from typing import Dict, NamedTuple, Tuple
+from typing import Dict, Tuple
 
 import jax
 import jax.numpy as jnp
-import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
 
 from isotope_tpu.compiler.program import CompiledGraph
-from isotope_tpu.metrics.histogram import (
-    NUM_BUCKETS,
-    latency_histogram,
-    quantile_from_histogram,
-)
 from isotope_tpu.metrics.prometheus import MetricsCollector, ServiceMetrics
 from isotope_tpu.parallel.mesh import DATA_AXIS, SVC_AXIS
 from isotope_tpu.sim.config import CLOSED_LOOP, OPEN_LOOP, LoadModel, SimParams
 from isotope_tpu.sim.engine import Simulator
+from isotope_tpu.sim.summary import RunSummary, reduce_stacked, summarize
 
-
-class ShardedSummary(NamedTuple):
-    """Globally-reduced run summary (small; per-request tensors stay
-    device-local and are never materialized on host)."""
-
-    count: jax.Array          # scalar — requests simulated
-    error_count: jax.Array    # scalar — client-visible 500s
-    hop_events: jax.Array     # scalar — executed hops (the benchmark unit)
-    latency_sum: jax.Array    # scalar
-    latency_min: jax.Array
-    latency_max: jax.Array
-    latency_hist: jax.Array   # (NUM_BUCKETS,) fine log-spaced
-    metrics: ServiceMetrics   # duration/response hists sharded over svc
-    utilization: jax.Array    # (S,)
-    unstable: jax.Array       # (S,) bool
-
-    def quantiles_s(self, qs=(0.5, 0.75, 0.9, 0.99, 0.999)) -> np.ndarray:
-        return quantile_from_histogram(np.asarray(self.latency_hist), qs)
-
-    @property
-    def mean_latency_s(self) -> float:
-        return float(self.latency_sum) / max(float(self.count), 1.0)
+# back-compat alias: the sharded path now returns the same summary type
+# the single-device scan path produces
+ShardedSummary = RunSummary
 
 
 class ShardedSimulator:
@@ -81,7 +58,7 @@ class ShardedSimulator:
         # services padded so psum_scatter can tile over the svc axis
         s = compiled.num_services
         self.s_pad = -(-s // self.n_svc) * self.n_svc
-        self._fns: Dict[Tuple[int, str, int], object] = {}
+        self._fns: Dict[Tuple[int, int, str, int], object] = {}
 
     def run(
         self,
@@ -89,8 +66,10 @@ class ShardedSimulator:
         num_requests: int,
         key: jax.Array,
         offered_qps=None,
-    ) -> ShardedSummary:
-        """Simulate >= ``num_requests`` (rounded up to fill all shards).
+        block_size: int = 65_536,
+    ) -> RunSummary:
+        """Simulate >= ``num_requests`` (rounded up to fill all shards),
+        scanning blocks of at most ``block_size`` requests per device.
 
         For closed-loop load the offered rate is latency-dependent; pass
         ``offered_qps`` (e.g. ``SimResults.offered_qps`` from a prior
@@ -100,6 +79,9 @@ class ShardedSimulator:
         if load.kind == OPEN_LOOP:
             offered = jnp.float32(load.qps)
             gap = jnp.float32(0.0)
+            nominal_gap = jnp.float32(0.0)
+            conns_local = 0
+            block = max(1, min(block_size, n_local))
         else:
             if load.connections % self.n_shards:
                 raise ValueError(
@@ -107,31 +89,37 @@ class ShardedSimulator:
                     f"divide evenly over {self.n_shards} shards"
                 )
             if offered_qps is None:
-                # fixed point on a single-device pilot, then fan out
-                offered_qps = self.sim.run(
+                offered_qps = self.sim.solve_closed_rate(
                     load, min(num_requests, 2048), key
-                ).offered_qps
+                )
             offered = jnp.float32(offered_qps)
             gap = (
                 jnp.float32(load.connections / load.qps)
                 if load.qps is not None
                 else jnp.float32(0.0)
             )
-        return self._get(n_local, load.kind, load.connections)(
-            key, offered, gap
+            nominal_gap = jnp.float32(load.connections / float(offered_qps))
+            conns_local = max(load.connections // self.n_shards, 1)
+            # floor so the block honors the block_size HBM bound
+            per = max(1, min(block_size, n_local) // conns_local)
+            block = per * conns_local
+        num_blocks = max(1, -(-n_local // block))
+        return self._get(block, num_blocks, load.kind, conns_local)(
+            key, offered, gap, nominal_gap
         )
 
     # ------------------------------------------------------------------
 
-    def _get(self, n_local: int, kind: str, connections: int):
-        cache_key = (n_local, kind, connections)
+    def _get(self, block: int, num_blocks: int, kind: str,
+             conns_local: int):
+        cache_key = (block, num_blocks, kind, conns_local)
         if cache_key not in self._fns:
-            body = partial(self._body, n_local, kind, connections)
+            body = partial(self._body, block, num_blocks, kind, conns_local)
             mapped = jax.shard_map(
                 body,
                 mesh=self.mesh,
-                in_specs=(P(), P(), P()),
-                out_specs=ShardedSummary(
+                in_specs=(P(), P(), P(), P()),
+                out_specs=RunSummary(
                     count=P(),
                     error_count=P(),
                     hop_events=P(),
@@ -159,31 +147,54 @@ class ShardedSimulator:
 
     def _body(
         self,
-        n_local: int,
+        block: int,
+        num_blocks: int,
         kind: str,
-        connections: int,
+        conns_local: int,
         key: jax.Array,
         offered_qps: jax.Array,
         pace_gap: jax.Array,
-    ) -> ShardedSummary:
+        nominal_gap: jax.Array,
+    ) -> RunSummary:
         both = (DATA_AXIS, SVC_AXIS)
         shard = (
             jax.lax.axis_index(DATA_AXIS) * self.n_svc
             + jax.lax.axis_index(SVC_AXIS)
         )
-        local_key = jax.random.fold_in(key, shard)
-        conns_local = max(connections // self.n_shards, 1)
-        res = self.sim._simulate(
-            n_local,
-            kind,
-            conns_local,
-            local_key,
-            offered_qps,
-            pace_gap,
-            # each shard generates 1/shards of the open-loop stream
-            offered_qps / self.n_shards,
+        # disjoint fold domains: the rate solver's pilots consumed
+        # fold_in(key, 0..iters) on the same base key
+        local_key = jax.random.fold_in(key, 500_000 + shard)
+        c = max(conns_local, 1)
+        per = block // c
+
+        def block_body(carry, b):
+            t0, conn_t0, req_off = carry
+            kb = jax.random.fold_in(local_key, 1_000_000 + b)
+            res, t_end, conn_end = self.sim._simulate_core(
+                block,
+                kind,
+                conns_local,
+                kb,
+                offered_qps,
+                pace_gap,
+                # each shard generates 1/shards of the open-loop stream
+                offered_qps / self.n_shards,
+                nominal_gap,
+                t0,
+                conn_t0,
+                req_off,
+            )
+            return (t_end, conn_end, req_off + per), summarize(
+                res, self.collector
+            )
+
+        carry0 = (
+            jnp.float32(0.0),
+            jnp.zeros((c,), jnp.float32),
+            jnp.float32(0.0),
         )
-        m = self.collector.collect(res)
+        _, parts = jax.lax.scan(block_body, carry0, jnp.arange(num_blocks))
+        local = reduce_stacked(parts)
 
         def allsum(x):
             return jax.lax.psum(x, both)
@@ -198,6 +209,7 @@ class ShardedSimulator:
                 x, SVC_AXIS, scatter_dimension=0, tiled=True
             )
 
+        m = local.metrics
         metrics = ServiceMetrics(
             incoming_total=allsum(m.incoming_total),
             outgoing_total=allsum(m.outgoing_total),
@@ -208,15 +220,15 @@ class ShardedSimulator:
             response_size_hist=scatter_svc(m.response_size_hist),
             response_size_sum=allsum(m.response_size_sum),
         )
-        return ShardedSummary(
-            count=allsum(jnp.float32(n_local)),
-            error_count=allsum(res.client_error.sum().astype(jnp.float32)),
-            hop_events=allsum(res.hop_events.astype(jnp.float32)),
-            latency_sum=allsum(res.client_latency.sum()),
-            latency_min=jax.lax.pmin(res.client_latency.min(), both),
-            latency_max=jax.lax.pmax(res.client_latency.max(), both),
-            latency_hist=allsum(latency_histogram(res.client_latency)),
+        return RunSummary(
+            count=allsum(local.count),
+            error_count=allsum(local.error_count),
+            hop_events=allsum(local.hop_events),
+            latency_sum=allsum(local.latency_sum),
+            latency_min=jax.lax.pmin(local.latency_min, both),
+            latency_max=jax.lax.pmax(local.latency_max, both),
+            latency_hist=allsum(local.latency_hist),
             metrics=metrics,
-            utilization=res.utilization,
-            unstable=res.unstable,
+            utilization=local.utilization,
+            unstable=local.unstable,
         )

@@ -211,6 +211,7 @@ class Simulator:
             offset += lvl.num_hops
         self._levels: Tuple[_Level, ...] = tuple(levels)
         self._fns: Dict[Tuple[int, str, bool], "jax.stages.Wrapped"] = {}
+        self._summary_fns: Dict[tuple, "jax.stages.Wrapped"] = {}
 
     # -- public entry points ----------------------------------------------
 
@@ -231,8 +232,33 @@ class Simulator:
         if load.kind == OPEN_LOOP:
             return self._get(num_requests, OPEN_LOOP)(
                 key, jnp.float32(load.qps), jnp.float32(0.0),
-                jnp.float32(load.qps),
+                jnp.float32(load.qps), jnp.float32(0.0),
             )
+        lam = self.solve_closed_rate(load, num_requests, key,
+                                     fixed_point_iters)
+        gap = (
+            jnp.float32(load.connections / load.qps)
+            if load.qps is not None
+            else jnp.float32(0.0)
+        )
+        # Nominal pacing (chaos-phase placement) always reflects the real
+        # rate: with ``qps=None`` (Fortio's -qps max) the workers still
+        # issue at the solved throughput, so placing every request at t=0
+        # would silently skip chaos phases.
+        nominal_gap = jnp.float32(load.connections / lam)
+        return self._get(num_requests, CLOSED_LOOP, load.connections)(
+            key, jnp.float32(lam), gap, jnp.float32(lam), nominal_gap
+        )
+
+    def solve_closed_rate(
+        self,
+        load: LoadModel,
+        num_requests: int,
+        key: jax.Array,
+        fixed_point_iters: int = 3,
+    ) -> float:
+        """Fixed point of ``lam = min(qps, C / E[latency(lam)], capacity)``
+        via short pilot runs — Fortio's closed-loop self-throttling."""
         cap = 0.999 * self.capacity_qps()
         lam = min(load.qps, cap) if load.qps is not None else cap
         pilot_n = min(num_requests, 2048)
@@ -245,15 +271,56 @@ class Simulator:
         for i in range(fixed_point_iters):
             res = pilot(
                 jax.random.fold_in(key, i), jnp.float32(lam), gap,
-                jnp.float32(lam),
+                jnp.float32(lam), jnp.float32(load.connections / lam),
             )
             mean_lat = float(res.client_latency.mean())
             implied = load.connections / max(mean_lat, 1e-9)
             lam = min(implied, cap)
             if load.qps is not None:
                 lam = min(lam, load.qps)
-        return self._get(num_requests, CLOSED_LOOP, load.connections)(
-            key, jnp.float32(lam), gap, jnp.float32(lam)
+        return lam
+
+    def run_summary(
+        self,
+        load: LoadModel,
+        num_requests: int,
+        key: jax.Array,
+        *,
+        block_size: int = 65_536,
+        collector=None,
+        fixed_point_iters: int = 3,
+    ):
+        """Simulate >= ``num_requests`` in HBM-bounded blocks.
+
+        A ``lax.scan`` over request blocks accumulates an O(buckets)
+        :class:`~isotope_tpu.sim.summary.RunSummary` on device — the
+        request count is unbounded by memory (the reference's analogue:
+        Fortio streams requests and keeps only histograms,
+        perf/benchmark/runner/fortio.py:38-75).  Arrival clocks carry
+        across blocks, so chaos phases and closed-loop pacing see one
+        continuous timeline.
+        """
+        if load.kind == OPEN_LOOP:
+            offered = float(load.qps)
+            pace = 0.0
+            nominal = 0.0
+            conns = 0
+            block = max(1, min(block_size, num_requests))
+        else:
+            conns = load.connections
+            offered = self.solve_closed_rate(load, num_requests, key,
+                                             fixed_point_iters)
+            pace = conns / load.qps if load.qps is not None else 0.0
+            nominal = conns / offered
+            # floor so the block honors the block_size HBM bound
+            per = max(1, min(block_size, num_requests) // conns)
+            block = per * conns
+        num_blocks = max(1, -(-num_requests // block))
+        fn = self._get_summary(block, num_blocks, load.kind, conns,
+                               collector)
+        return fn(
+            key, jnp.float32(offered), jnp.float32(pace),
+            jnp.float32(offered), jnp.float32(nominal),
         )
 
     def capacity_qps(self) -> float:
@@ -277,6 +344,45 @@ class Simulator:
                 partial(self._simulate, n, kind, connections)
             )
         return self._fns[key]
+
+    def _get_summary(self, block: int, num_blocks: int, kind: str,
+                     connections: int, collector):
+        """Jitted scan-over-blocks program producing a RunSummary."""
+        from isotope_tpu.sim import summary as summary_mod
+
+        cache_key = (block, num_blocks, kind, connections,
+                     collector is not None)
+        if cache_key not in self._summary_fns:
+            c = max(connections, 1)
+            per = block // c
+
+            def scanfn(key, offered_qps, pace_gap, arrival_qps,
+                       nominal_gap):
+                def body(carry, b):
+                    t0, conn_t0, req_off = carry
+                    # disjoint fold domain: the closed-loop rate solver's
+                    # pilots already consumed fold_in(key, 0..iters)
+                    kb = jax.random.fold_in(key, 1_000_000 + b)
+                    res, t_end, conn_end = self._simulate_core(
+                        block, kind, connections, kb, offered_qps,
+                        pace_gap, arrival_qps, nominal_gap, t0, conn_t0,
+                        req_off,
+                    )
+                    s = summary_mod.summarize(res, collector)
+                    return (t_end, conn_end, req_off + per), s
+
+                carry0 = (
+                    jnp.float32(0.0),
+                    jnp.zeros((c,), jnp.float32),
+                    jnp.float32(0.0),
+                )
+                _, parts = jax.lax.scan(
+                    body, carry0, jnp.arange(num_blocks)
+                )
+                return summary_mod.reduce_stacked(parts)
+
+            self._summary_fns[cache_key] = jax.jit(scanfn)
+        return self._summary_fns[cache_key]
 
     def _sample_service_time(self, key: jax.Array, shape) -> jax.Array:
         """Per-hop CPU time draws with mean ``cpu_time_s``.
@@ -312,11 +418,44 @@ class Simulator:
         offered_qps: jax.Array,
         pace_gap: jax.Array,
         arrival_qps: jax.Array,
+        nominal_gap: Optional[jax.Array] = None,
     ) -> SimResults:
+        """One self-contained block starting at t=0 (see _simulate_core)."""
+        if nominal_gap is None:
+            nominal_gap = pace_gap
+        c = max(connections, 1)
+        res, _, _ = self._simulate_core(
+            n, kind, connections, key, offered_qps, pace_gap, arrival_qps,
+            nominal_gap, jnp.float32(0.0), jnp.zeros((c,), jnp.float32),
+            jnp.float32(0.0),
+        )
+        return res
+
+    def _simulate_core(
+        self,
+        n: int,
+        kind: str,
+        connections: int,
+        key: jax.Array,
+        offered_qps: jax.Array,
+        pace_gap: jax.Array,
+        arrival_qps: jax.Array,
+        nominal_gap: jax.Array,
+        t0: jax.Array,
+        conn_t0: jax.Array,
+        req_offset: jax.Array,
+    ) -> Tuple[SimResults, jax.Array, jax.Array]:
         """``offered_qps`` drives the queueing model (the rate the whole
         fleet of services sees); ``arrival_qps`` paces this batch's
         open-loop arrival stream.  They differ only under sharded
-        execution, where each shard generates 1/shards of the stream."""
+        execution, where each shard generates 1/shards of the stream.
+
+        ``nominal_gap`` is the closed-loop per-connection pacing used for
+        chaos-phase placement (the real throughput's gap even when
+        ``pace_gap`` is 0, i.e. ``-qps max``).  ``t0`` / ``conn_t0`` /
+        ``req_offset`` are the block's starting clocks so scanned blocks
+        form one continuous timeline; returns ``(results, t_end,
+        conn_end)`` for the next block's carry."""
         H = self.compiled.num_hops
         Pmax = self.compiled.max_steps
         k_send, k_err, k_wait_u, k_svc, k_arr = jax.random.split(key, 5)
@@ -328,12 +467,14 @@ class Simulator:
         # only to place requests into chaos phases) ------------------------
         if kind == OPEN_LOOP:
             gaps = jax.random.exponential(k_arr, (n,)) / arrival_qps
-            arrivals = jnp.cumsum(gaps)
+            arrivals = t0 + jnp.cumsum(gaps)
             nominal_arrivals = arrivals
         else:
             c = max(connections, 1)
             per = n // c
-            nominal = jnp.arange(per, dtype=jnp.float32) * pace_gap
+            nominal = (
+                req_offset + jnp.arange(per, dtype=jnp.float32)
+            ) * nominal_gap
             nominal_arrivals = jnp.concatenate(
                 [
                     jnp.broadcast_to(nominal, (c, per)).reshape(-1),
@@ -372,9 +513,15 @@ class Simulator:
                 - 1
             )  # (N,)
             oh = jax.nn.one_hot(phase_idx, num_phases, dtype=jnp.float32)
-            p_wait_nh = oh @ p_wait_ph
-            wait_rate_nh = oh @ wait_rate_ph
-            down = (oh @ down_ph.astype(jnp.float32)) > 0.5
+            # HIGHEST keeps the f32 tables exact (default TPU matmul
+            # precision rounds operands through bfloat16)
+            hi = jax.lax.Precision.HIGHEST
+            p_wait_nh = jnp.matmul(oh, p_wait_ph, precision=hi)
+            wait_rate_nh = jnp.matmul(oh, wait_rate_ph, precision=hi)
+            down = (
+                jnp.matmul(oh, down_ph.astype(jnp.float32), precision=hi)
+                > 0.5
+            )
         wait = queueing.sample_wait_conditional(
             p_wait_nh, wait_rate_nh, u_wait
         )  # (N, H)
@@ -523,7 +670,8 @@ class Simulator:
             per = n // c
             lat_conn = root_lat[: c * per].reshape(c, per)
             spent = jnp.maximum(lat_conn, pace_gap)
-            starts = jnp.cumsum(spent, axis=-1) - spent
+            starts = conn_t0[:, None] + jnp.cumsum(spent, axis=-1) - spent
+            conn_end = conn_t0 + spent.sum(-1)
             arrivals = jnp.concatenate(
                 [
                     starts.reshape(-1),
@@ -531,6 +679,8 @@ class Simulator:
                     jnp.zeros((n - c * per,)),
                 ]
             )
+        else:
+            conn_end = conn_t0
 
         # ---- downward pass 2: absolute start times -----------------------
         start_lvls: List[jax.Array] = [
@@ -546,7 +696,7 @@ class Simulator:
         hop_lat = jnp.concatenate(lat_lvls, axis=1)
         hop_start = jnp.concatenate(start_lvls, axis=1)
         err_hop = jnp.concatenate(err_hop_lvls, axis=1)
-        return SimResults(
+        res = SimResults(
             client_start=arrivals,
             client_latency=root_lat,
             client_error=err_hop[:, 0] | root_down,
@@ -558,6 +708,8 @@ class Simulator:
             unstable=unstable_phase.any(axis=0),
             offered_qps=offered_qps,
         )
+        t_end = conn_end.max() if kind == CLOSED_LOOP else arrivals[-1]
+        return res, t_end, conn_end
 
 
 def simulate(

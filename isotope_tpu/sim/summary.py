@@ -1,0 +1,86 @@
+"""Run summaries: the small, device-reducible view of a simulation.
+
+The reference never ships per-request records off the cluster either —
+Fortio reduces to duration histograms + counters in the client pod
+(perf/benchmark/runner/fortio.py:38-75) and the services expose Prometheus
+counters/histograms (srv/prometheus/handler.go:27-69).  ``RunSummary`` is
+that same contract on device: everything in it is O(buckets), never O(N),
+so request blocks of any count can accumulate into one summary under
+``lax.scan`` (microbatching — HBM holds one block, not the whole run) and
+shards can merge theirs with ``psum`` over the mesh.
+"""
+from __future__ import annotations
+
+from typing import NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from isotope_tpu.metrics.histogram import (
+    latency_histogram,
+    quantile_from_histogram,
+)
+from isotope_tpu.metrics.prometheus import MetricsCollector, ServiceMetrics
+from isotope_tpu.sim.engine import SimResults
+
+
+class RunSummary(NamedTuple):
+    """Globally-reduced run summary (small; per-request tensors stay
+    device-local and are never materialized on host)."""
+
+    count: jax.Array          # scalar — requests simulated
+    error_count: jax.Array    # scalar — client-visible 500s
+    hop_events: jax.Array     # scalar — executed hops (the benchmark unit)
+    latency_sum: jax.Array    # scalar
+    latency_min: jax.Array
+    latency_max: jax.Array
+    latency_hist: jax.Array   # (NUM_BUCKETS,) fine log-spaced
+    metrics: Optional[ServiceMetrics]  # per-service series (None = skipped)
+    utilization: jax.Array    # (S,)
+    unstable: jax.Array       # (S,) bool
+
+    def quantiles_s(self, qs=(0.5, 0.75, 0.9, 0.99, 0.999)) -> np.ndarray:
+        return quantile_from_histogram(np.asarray(self.latency_hist), qs)
+
+    @property
+    def mean_latency_s(self) -> float:
+        return float(self.latency_sum) / max(float(self.count), 1.0)
+
+
+def summarize(
+    res: SimResults, collector: Optional[MetricsCollector] = None
+) -> RunSummary:
+    """Reduce one block's SimResults to a RunSummary (jit-friendly)."""
+    return RunSummary(
+        count=jnp.float32(res.client_latency.shape[0]),
+        error_count=res.client_error.sum().astype(jnp.float32),
+        hop_events=res.hop_events.astype(jnp.float32),
+        latency_sum=res.client_latency.sum(),
+        latency_min=res.client_latency.min(),
+        latency_max=res.client_latency.max(),
+        latency_hist=latency_histogram(res.client_latency),
+        metrics=collector.collect(res) if collector is not None else None,
+        utilization=res.utilization,
+        unstable=res.unstable,
+    )
+
+
+def reduce_stacked(parts: RunSummary) -> RunSummary:
+    """Reduce a summary whose leaves carry a leading block axis (the
+    stacked output of ``lax.scan``) to a single RunSummary."""
+    metrics = None
+    if parts.metrics is not None:
+        metrics = jax.tree.map(lambda x: x.sum(0), parts.metrics)
+    return RunSummary(
+        count=parts.count.sum(0),
+        error_count=parts.error_count.sum(0),
+        hop_events=parts.hop_events.sum(0),
+        latency_sum=parts.latency_sum.sum(0),
+        latency_min=parts.latency_min.min(0),
+        latency_max=parts.latency_max.max(0),
+        latency_hist=parts.latency_hist.sum(0),
+        metrics=metrics,
+        utilization=parts.utilization.max(0),
+        unstable=parts.unstable.any(0),
+    )
