@@ -70,9 +70,8 @@ def main() -> None:
 
     extra = {}
     if on_tpu:
-        doc = yaml.safe_load(
-            open("examples/topologies/1000-svc_2000-end.yaml")
-        )
+        with open("examples/topologies/1000-svc_2000-end.yaml") as f:
+            doc = yaml.safe_load(f)
         svc1000 = Simulator(compile_graph(ServiceGraph.decode(doc)))
         extra["svc1000"] = _rate(
             svc1000, LoadModel(kind="open", qps=10_000.0), 131_072, 8_192

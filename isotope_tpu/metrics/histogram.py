@@ -16,7 +16,10 @@ import numpy as np
 NUM_BUCKETS = 2048
 _LO, _HI = 1e-6, 10.0  # seconds
 
-# bucket i covers [EDGES[i], EDGES[i+1]); underflow in 0, overflow in last
+# bucket i covers [EDGES[i], EDGES[i+1]); underflow in 0, overflow in last.
+# NOTE: bucket_index computes membership via float32 log arithmetic, so a
+# value lying exactly on an edge may land in the adjacent bucket — EDGES is
+# the nominal layout for quantile recovery, not an exact membership oracle.
 EDGES = np.concatenate(
     [[0.0], np.geomspace(_LO, _HI, NUM_BUCKETS - 1), [np.inf]]
 )

@@ -100,7 +100,8 @@ class ShardedSimulator:
             )
             nominal_gap = jnp.float32(load.connections / float(offered_qps))
             conns_local = max(load.connections // self.n_shards, 1)
-            # floor so the block honors the block_size HBM bound
+            # block_size is a soft HBM bound: when per-shard connections
+            # exceed it the block grows to ``conns_local`` requests
             per = max(1, min(block_size, n_local) // conns_local)
             block = per * conns_local
         num_blocks = max(1, -(-n_local // block))

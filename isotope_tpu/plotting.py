@@ -20,8 +20,11 @@ import pandas as pd  # noqa: E402
 LATENCY_METRICS = ("p50", "p75", "p90", "p99", "p999")
 X_AXES = ("conn", "qps")
 
-# our sweep labels: <topology>_<env>_<qps>qps_<conn>c[_extra]
-_LABEL_RE = re.compile(r"^(?P<series>.+?)_(?P<qps>[0-9.]+|max)qps_\d+c")
+# our sweep labels: <topology>_<env>_<qps>qps_<conn>c[_extra]; the qps is
+# rendered with {:g}, which switches to exponent form above 1e6 ("1e+06")
+_LABEL_RE = re.compile(
+    r"^(?P<series>.+?)_(?P<qps>[0-9.]+(?:e[+-]?[0-9]+)?|max)qps_\d+c"
+)
 
 
 def _series_of(label: str) -> str:
@@ -59,15 +62,23 @@ def plot_benchmark(
         rows = df[df["series"] == s].sort_values(xcol)
         if rows.empty:
             continue
+        drew = False
         for metric in metrics:
             if metric not in rows.columns:
                 raise ValueError(f"no column {metric!r} in {csv_path}")
-            y = rows[metric].astype(float)
+            # record-dependent columns (cpu_cores_<svc>) are "-"-padded on
+            # rows from topologies without that service — skip those rows
+            y = pd.to_numeric(rows[metric], errors="coerce")
+            keep = y.notna()
+            if not keep.any():
+                continue
             label = f"{s} {metric}"
             if metric in LATENCY_METRICS:
                 y = y / 1000.0  # us -> ms
-            plt.plot(rows[xcol], y, marker="o", label=label)
-        plotted.append(s)
+            plt.plot(rows[xcol][keep], y[keep], marker="o", label=label)
+            drew = True
+        if drew:
+            plotted.append(s)
     if not plotted:
         raise ValueError(f"no matching series in {csv_path}")
     plt.xlabel(

@@ -150,8 +150,16 @@ def run_experiment(
             with open(out / f"{r.label}.json", "w") as f:
                 json.dump(r.fortio_json, f, indent=2)
             (out / f"{r.label}.prom").write_text(r.prometheus_text)
+        # the per-service cpu_cores_<svc> columns are record-dependent;
+        # append them so `plot --metrics cpu_cores_<svc>` works off this CSV
+        extra_keys = sorted(
+            {k for r in results for k in r.flat if k.startswith("cpu_cores_")}
+        )
+        keys = DEFAULT_CSV_KEYS
+        if extra_keys:
+            keys = keys + "," + ",".join(extra_keys)
         write_csv(
-            DEFAULT_CSV_KEYS,
+            keys,
             [r.flat for r in results],
             out / "benchmark.csv",
         )

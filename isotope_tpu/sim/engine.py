@@ -312,7 +312,9 @@ class Simulator:
                                              fixed_point_iters)
             pace = conns / load.qps if load.qps is not None else 0.0
             nominal = conns / offered
-            # floor so the block honors the block_size HBM bound
+            # block_size is a soft HBM bound: each connection needs at
+            # least one request per block, so when connections > block_size
+            # the block grows to ``connections`` requests
             per = max(1, min(block_size, num_requests) // conns)
             block = per * conns
         num_blocks = max(1, -(-num_requests // block))
@@ -478,7 +480,9 @@ class Simulator:
             nominal_arrivals = jnp.concatenate(
                 [
                     jnp.broadcast_to(nominal, (c, per)).reshape(-1),
-                    jnp.zeros((n - c * per,)),
+                    # remainder requests nominally follow the per-connection
+                    # stream (chaos-phase placement only)
+                    jnp.full((n - c * per,), (req_offset + per) * nominal_gap),
                 ]
             )
             arrivals = None  # closed-loop arrivals derive from latencies
@@ -668,17 +672,21 @@ class Simulator:
         if kind == CLOSED_LOOP:
             c = max(connections, 1)
             per = n // c
+            rem = n - c * per
             lat_conn = root_lat[: c * per].reshape(c, per)
             spent = jnp.maximum(lat_conn, pace_gap)
             starts = conn_t0[:, None] + jnp.cumsum(spent, axis=-1) - spent
             conn_end = conn_t0 + spent.sum(-1)
-            arrivals = jnp.concatenate(
-                [
-                    starts.reshape(-1),
-                    # remainder requests (n % c) start at t=0 on fresh conns
-                    jnp.zeros((n - c * per,)),
-                ]
-            )
+            if rem:
+                # remainder requests (n % c) continue on the first ``rem``
+                # connections — each starts when its connection frees up
+                arrivals = jnp.concatenate(
+                    [starts.reshape(-1), conn_end[:rem]]
+                )
+                spent_rem = jnp.maximum(root_lat[c * per:], pace_gap)
+                conn_end = conn_end.at[:rem].add(spent_rem)
+            else:
+                arrivals = starts.reshape(-1)
         else:
             conn_end = conn_t0
 

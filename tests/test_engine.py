@@ -278,3 +278,20 @@ def test_service_time_param_validation():
         SimParams(service_time="lognormal", service_time_param=0.0)
     with pytest.raises(ValueError):
         SimParams(service_time="weibull")
+
+
+def test_closed_loop_remainder_requests_paced():
+    """Remainder requests (n % connections) continue on existing
+    connections — they must not all start at t=0 (round-1 finding #9)."""
+    res = run(
+        "services:\n- name: a\n  isEntrypoint: true\n",
+        n=1003,  # 10 conns x 100 + 3 remainder
+        load=LoadModel(kind="closed", qps=100.0, connections=10),
+    )
+    starts = np.asarray(res.client_start)
+    rem = starts[1000:]
+    # each remainder request starts when its connection frees up (~10s in)
+    assert (rem > 9.0).all(), rem
+    # ActualQPS over the whole run stays within 2% of the pacing target
+    total = float(np.asarray(res.client_end).max())
+    assert 1003 / total == pytest.approx(100.0, rel=0.02)

@@ -75,3 +75,63 @@ def test_fanout_examples_have_expected_scale():
     )
     assert len(g) == 1000
     assert sum(s.num_replicas for s in g.services) == 2000
+
+
+def test_series_label_exponent_qps():
+    from isotope_tpu.plotting import _series_of
+
+    # {:g} renders 1e6 qps as "1e+06" — the series split must still work
+    assert _series_of("canonical_none_1e+06qps_8c") == "canonical_none"
+    assert _series_of("canonical_none_maxqps_8c") == "canonical_none"
+    assert _series_of("canonical_none_500qps_8c") == "canonical_none"
+
+
+def test_plot_cpu_cores_from_sweep_csv(tmp_path):
+    """End-to-end: sweep CSV carries cpu_cores_<svc> columns and the
+    plotter can chart them (round-1 advisor finding (a))."""
+    import json as _json
+
+    from isotope_tpu.runner import load_toml, run_experiment
+
+    topo = ROOT / "examples/topologies/canonical.yaml"
+    cfg = tmp_path / "exp.toml"
+    cfg.write_text(
+        f"""
+topology_paths = ["{topo}"]
+environments = ["NONE"]
+
+[client]
+qps = [500]
+num_concurrent_connections = [2, 8]
+duration = "120s"
+load_kind = "open"
+
+[sim]
+num_requests = 1000
+"""
+    )
+    out = tmp_path / "results"
+    run_experiment(load_toml(cfg), out_dir=out)
+    header = (out / "benchmark.csv").read_text().splitlines()[0]
+    assert "cpu_cores_a" in header
+    png = tmp_path / "cpu.png"
+    series = plot_benchmark(
+        out / "benchmark.csv", png, metrics=["cpu_cores_a"]
+    )
+    assert series and png.stat().st_size > 1000
+
+
+def test_plot_tolerates_gap_cells(tmp_path):
+    """Record-dependent columns are '-'-padded for rows from other
+    topologies; the plotter must skip those rows, not crash."""
+    csv = tmp_path / "benchmark.csv"
+    csv.write_text(
+        "Labels,StartTime,RequestedQPS,ActualQPS,NumThreads,p50,"
+        "cpu_cores_a\n"
+        "canonical_none_500qps_2c,t,500,499,2,2800,0.02\n"
+        "other_none_500qps_2c,t,500,499,2,2600,-\n"
+    )
+    out = tmp_path / "p.png"
+    series = plot_benchmark(csv, out, metrics=["cpu_cores_a"])
+    assert series == ["canonical_none"]  # the '-'-only series is skipped
+    assert out.stat().st_size > 1000
