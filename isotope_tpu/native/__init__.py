@@ -1,0 +1,50 @@
+"""Native (C++) components, loaded via ctypes.
+
+The reference keeps its inner loop in a compiled language (Go —
+isotope/service/pkg/srv/executable.go); here the TPU compute path is
+JAX/XLA and the host-side hot paths are C++.  Libraries are compiled
+on first use with the system toolchain and cached next to the source,
+keyed by a source hash, so test environments never need a build step.
+"""
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import pathlib
+import subprocess
+import threading
+
+_DIR = pathlib.Path(__file__).parent
+_lock = threading.Lock()
+_cache: dict = {}
+
+
+class NativeBuildError(RuntimeError):
+    pass
+
+
+def load_library(name: str) -> ctypes.CDLL:
+    """Compile (if needed) and load ``<name>.cpp`` from this directory."""
+    with _lock:
+        if name in _cache:
+            return _cache[name]
+        src = _DIR / f"{name}.cpp"
+        code = src.read_bytes()
+        tag = hashlib.sha256(code).hexdigest()[:16]
+        out = _DIR / "_build" / f"{name}-{tag}.so"
+        if not out.exists():
+            out.parent.mkdir(exist_ok=True)
+            tmp = out.with_suffix(".so.tmp")
+            cmd = [
+                "g++", "-O2", "-std=c++17", "-shared", "-fPIC",
+                str(src), "-o", str(tmp),
+            ]
+            proc = subprocess.run(cmd, capture_output=True, text=True)
+            if proc.returncode != 0:
+                raise NativeBuildError(
+                    f"building {src.name} failed:\n{proc.stderr}"
+                )
+            tmp.replace(out)  # atomic: parallel builders race safely
+        lib = ctypes.CDLL(str(out))
+        _cache[name] = lib
+        return lib

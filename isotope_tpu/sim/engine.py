@@ -210,8 +210,38 @@ class Simulator:
             )
             offset += lvl.num_hops
         self._levels: Tuple[_Level, ...] = tuple(levels)
+
+        # -- sibling copula: static hop -> group id map ---------------------
+        # Concurrent sibling hops (children spawned by the same parent
+        # step, retry attempts included) share correlated wait draws.
+        # Group normals are drawn as (n, G) — G is the number of groups
+        # with >1 member, typically << H (a 1000-way fan-out is ONE
+        # group) — and expanded by a static column gather; hops outside
+        # any group get their own independent slot.  See
+        # SimParams.sibling_copula_r.
+        group = np.zeros(compiled.num_hops, np.int64)
+        n_multi = 0
+        off = 1  # hop 0 is the root; level d's children follow in order
+        gid = {("root",): 0}
+        for d, lvl in enumerate(compiled.levels):
+            segs = np.asarray(lvl.child_seg)
+            counts: Dict[int, int] = {}
+            for seg in segs:
+                counts[int(seg)] = counts.get(int(seg), 0) + 1
+            for local, seg in enumerate(segs):
+                key = (d, int(seg))
+                if key not in gid:
+                    gid[key] = len(gid)
+                    if counts[int(seg)] > 1:
+                        n_multi += 1
+                group[off + local] = gid[key]
+            off += lvl.num_children
+        self._sib_group = group.astype(np.int32)
+        self._num_sib_groups = len(gid)
+        self._copula_active = n_multi > 0 and params.sibling_copula_r > 0.0
         self._fns: Dict[Tuple[int, str, bool], "jax.stages.Wrapped"] = {}
         self._summary_fns: Dict[tuple, "jax.stages.Wrapped"] = {}
+        self._rate_cache: Dict[tuple, float] = {}
 
     # -- public entry points ----------------------------------------------
 
@@ -257,10 +287,26 @@ class Simulator:
         key: jax.Array,
         fixed_point_iters: int = 3,
     ) -> float:
-        """Fixed point of ``lam = min(qps, C / E[latency(lam)], capacity)``
-        via short pilot runs — Fortio's closed-loop self-throttling."""
+        """Equilibrium offered rate of Fortio's closed loop.
+
+        The workers' aggregate throughput satisfies ``lam = min(qps,
+        C / E[latency(lam)])`` with ``E[latency]`` increasing in ``lam``,
+        so ``g(lam) = min(qps, C / E[lat(lam)]) - lam`` is strictly
+        decreasing and has one root — found by bisection over short pilot
+        runs.  (Picard iteration ``lam <- implied(lam)`` diverges near
+        saturation, where the latency curve is steep: starting at the
+        capacity it ping-pongs between ~0 and the cap.  Validated against
+        the DES oracle's measured closed-loop throughput, test_oracle.py.)
+
+        The solved rate is a physical property of (load, topology), not of
+        the RNG key, so it is memoized per load shape.
+        """
+        cache_key = (load.qps, load.connections, min(num_requests, 2048),
+                     fixed_point_iters)
+        if cache_key in self._rate_cache:
+            return self._rate_cache[cache_key]
         cap = 0.999 * self.capacity_qps()
-        lam = min(load.qps, cap) if load.qps is not None else cap
+        hi = min(load.qps, cap) if load.qps is not None else cap
         pilot_n = min(num_requests, 2048)
         pilot = self._get(pilot_n, CLOSED_LOOP, load.connections)
         gap = (
@@ -268,16 +314,31 @@ class Simulator:
             if load.qps is not None
             else jnp.float32(0.0)
         )
-        for i in range(fixed_point_iters):
+
+        def implied(lam: float, i: int) -> float:
             res = pilot(
                 jax.random.fold_in(key, i), jnp.float32(lam), gap,
                 jnp.float32(lam), jnp.float32(load.connections / lam),
             )
             mean_lat = float(res.client_latency.mean())
-            implied = load.connections / max(mean_lat, 1e-9)
-            lam = min(implied, cap)
-            if load.qps is not None:
-                lam = min(lam, load.qps)
+            out = load.connections / max(mean_lat, 1e-9)
+            return min(out, load.qps) if load.qps is not None else out
+
+        if implied(hi, 0) >= hi:
+            # pacing (or capacity) binds before self-throttling
+            self._rate_cache[cache_key] = hi
+            return hi
+        lo = 0.0
+        for i in range(1, max(4 * fixed_point_iters, 10)):
+            mid = 0.5 * (lo + hi)
+            if implied(mid, i) >= mid:
+                lo = mid
+            else:
+                hi = mid
+            if hi - lo < 1e-3 * hi:
+                break
+        lam = 0.5 * (lo + hi)
+        self._rate_cache[cache_key] = lam
         return lam
 
     def run_summary(
@@ -460,10 +521,29 @@ class Simulator:
         conn_end)`` for the next block's carry."""
         H = self.compiled.num_hops
         Pmax = self.compiled.max_steps
-        k_send, k_err, k_wait_u, k_svc, k_arr = jax.random.split(key, 5)
+        if self._copula_active:
+            (k_send, k_err, k_wait_u, k_svc, k_arr,
+             k_wait2) = jax.random.split(key, 6)
+        else:
+            k_send, k_err, k_wait_u, k_svc, k_arr = jax.random.split(key, 5)
         u_send = jax.random.uniform(k_send, (n, H))
         u_err = jax.random.uniform(k_err, (n, H))
-        u_wait = jax.random.uniform(k_wait_u, (n, H))
+        if self._copula_active:
+            # Gaussian copula over sibling groups: exact U(0,1) marginals
+            # (the M/M/k wait law is untouched), pairwise correlation r
+            # within a concurrent group — matching the measured backlog
+            # correlation of parallel stations fed by common arrivals.
+            r = self.params.sibling_copula_r
+            z_h = jax.random.normal(k_wait_u, (n, H))
+            z_small = jax.random.normal(
+                k_wait2, (n, self._num_sib_groups)
+            )
+            z_g = z_small[:, self._sib_group]
+            u_wait = jax.scipy.special.ndtr(
+                np.sqrt(r) * z_g + np.sqrt(1.0 - r) * z_h
+            )
+        else:
+            u_wait = jax.random.uniform(k_wait_u, (n, H))
 
         # ---- arrival times (open loop exact; closed loop nominal, used
         # only to place requests into chaos phases) ------------------------

@@ -1,0 +1,328 @@
+"""DES-oracle tests: the fidelity axis of the north star.
+
+Three layers (SURVEY.md §4's "validate distributions" strategy):
+
+1. **Interpreter parity** — under deterministic service times and quiet
+   load both the analytic engine and the DES oracle are exact, so their
+   latencies must agree to float precision.  This pins the two
+   *independent* implementations of the executable.go semantics
+   (sleep/call/concurrent/probability/errorRate/retries/timeouts)
+   against each other.
+2. **Station physics** — the oracle's FIFO k-replica station must
+   reproduce the M/M/1 closed forms it makes no direct use of.
+3. **Fidelity** — the engine's p50/p99 must track the oracle's ground
+   truth within 5% on chain, tree, and star at rho 0.3 and 0.7, open
+   and closed loop (the north-star tolerance; BASELINE.json).  Known
+   out-of-envelope regimes are documented in ORACLE.md.
+"""
+import jax
+import numpy as np
+import pytest
+
+from isotope_tpu.compiler import compile_graph
+from isotope_tpu.models.graph import ServiceGraph
+from isotope_tpu.sim import LoadModel, SimParams, Simulator
+from isotope_tpu.sim.config import ChaosEvent
+from isotope_tpu.sim.oracle import OracleSimulator
+
+KEY = jax.random.PRNGKey(3)
+DET = SimParams(service_time="deterministic")
+QUIET = LoadModel(kind="open", qps=0.001, duration_s=1.0)
+
+CHAIN3 = """
+services:
+- name: a
+  isEntrypoint: true
+  script: [{call: b}]
+- name: b
+  script: [{call: c}]
+- name: c
+"""
+
+TREE13 = """
+services:
+- name: entry
+  isEntrypoint: true
+  script:
+  - [{call: c0}, {call: c1}, {call: c2}]
+- name: c0
+  script: [[{call: l00}, {call: l01}, {call: l02}]]
+- name: c1
+  script: [[{call: l10}, {call: l11}, {call: l12}]]
+- name: c2
+  script: [[{call: l20}, {call: l21}, {call: l22}]]
+- name: l00
+- name: l01
+- name: l02
+- name: l10
+- name: l11
+- name: l12
+- name: l20
+- name: l21
+- name: l22
+"""
+
+STAR9 = """
+services:
+- name: entry
+  isEntrypoint: true
+  script:
+  - [{call: s0}, {call: s1}, {call: s2}, {call: s3},
+     {call: s4}, {call: s5}, {call: s6}, {call: s7}]
+- name: s0
+- name: s1
+- name: s2
+- name: s3
+- name: s4
+- name: s5
+- name: s6
+- name: s7
+"""
+
+MU = 1.0 / SimParams().cpu_time_s
+
+
+def both(yaml_text, load, n_engine, n_oracle, params=SimParams(), seed=0):
+    graph = ServiceGraph.from_yaml(yaml_text)
+    engine = Simulator(compile_graph(graph), params)
+    res_e = engine.run(load, n_engine, jax.random.fold_in(KEY, seed))
+    oracle = OracleSimulator(graph, params)
+    res_o = oracle.run(load, n_oracle, seed=seed)
+    return res_e, res_o
+
+
+# -- 1. interpreter parity (deterministic => exact agreement) -------------
+
+
+def parity_case(yaml_text, **kwargs):
+    res_e, res_o = both(yaml_text, QUIET, 32, 32, params=DET, **kwargs)
+    lat_e = np.asarray(res_e.client_latency, np.float64)
+    np.testing.assert_allclose(
+        res_o.client_latency, lat_e, rtol=1e-5
+    )
+    np.testing.assert_array_equal(
+        res_o.client_error, np.asarray(res_e.client_error)
+    )
+    assert res_o.hop_events == int(res_e.hop_events)
+
+
+def test_parity_sequential_sleeps_and_calls():
+    parity_case(
+        """
+services:
+- name: entry
+  isEntrypoint: true
+  script:
+  - sleep: 10ms
+  - call: leaf
+  - sleep: 5ms
+- name: leaf
+"""
+    )
+
+
+def test_parity_concurrent_join_with_sleep():
+    parity_case(
+        """
+services:
+- name: entry
+  isEntrypoint: true
+  script:
+  - [{sleep: 30ms}, {call: fast}, {call: slow}]
+- name: fast
+- name: slow
+  script: [{sleep: 50ms}]
+"""
+    )
+
+
+def test_parity_error_rate_fast_500_skips_script():
+    # errorRate 1.0 => child always 500s without running its script; a
+    # downstream 500 does NOT fail the caller (executable.go:132-143)
+    parity_case(
+        """
+services:
+- name: entry
+  isEntrypoint: true
+  script: [{call: flaky}]
+- name: flaky
+  errorRate: 100%
+  script: [{sleep: 80ms}]
+"""
+    )
+
+
+def test_parity_retries_exhausted_by_500s():
+    # 3 serial attempts, each a fast 500; final 500 still not transport
+    parity_case(
+        """
+services:
+- name: entry
+  isEntrypoint: true
+  script:
+  - call: {service: flaky, retries: 2}
+- name: flaky
+  errorRate: 100%
+"""
+    )
+
+
+def test_parity_timeout_is_transport_and_truncates():
+    # timeout < child sleep: attempt capped at the timeout, transport
+    # error fails the caller at that step; the trailing sleep never runs
+    parity_case(
+        """
+services:
+- name: entry
+  isEntrypoint: true
+  script:
+  - call: {service: slow, timeout: 10ms}
+  - sleep: 40ms
+- name: slow
+  script: [{sleep: 60ms}]
+"""
+    )
+
+
+def test_parity_chaos_total_outage():
+    graph = ServiceGraph.from_yaml(CHAIN3)
+    chaos = (ChaosEvent(service="b", start_s=0.0, end_s=1e9),)
+    engine = Simulator(compile_graph(graph), DET, chaos)
+    res_e = engine.run(QUIET, 32, KEY)
+    oracle = OracleSimulator(graph, DET, chaos)
+    res_o = oracle.run(QUIET, 32, seed=0)
+    assert res_o.client_error.all()
+    assert np.asarray(res_e.client_error).all()
+    np.testing.assert_allclose(
+        res_o.client_latency,
+        np.asarray(res_e.client_latency, np.float64),
+        rtol=1e-5,
+    )
+
+
+def test_oracle_deterministic_per_seed():
+    g = ServiceGraph.from_yaml(CHAIN3)
+    o = OracleSimulator(g)
+    a = o.run(LoadModel(kind="open", qps=5000.0), 2000, seed=42)
+    b = o.run(LoadModel(kind="open", qps=5000.0), 2000, seed=42)
+    c = o.run(LoadModel(kind="open", qps=5000.0), 2000, seed=43)
+    np.testing.assert_array_equal(a.client_latency, b.client_latency)
+    assert not np.array_equal(a.client_latency, c.client_latency)
+
+
+# -- 2. station physics ----------------------------------------------------
+
+
+def test_oracle_matches_mm1_closed_form():
+    p = SimParams()
+    sim = OracleSimulator(
+        ServiceGraph.from_yaml("services:\n- name: a\n  isEntrypoint: true\n"),
+        p,
+    )
+    lam = 0.7 * MU
+    res = sim.run(LoadModel(kind="open", qps=lam), 1_000_000, seed=1)
+    root_net = p.network.one_way(0) + p.network.one_way(0)
+    soj = res.client_latency[res.client_start > 0.5] - root_net
+    rate = MU - lam
+    # M/M/1 FIFO sojourn ~ Exp(mu - lambda)
+    assert np.quantile(soj, 0.5) == pytest.approx(np.log(2) / rate, rel=0.03)
+    assert np.quantile(soj, 0.99) == pytest.approx(
+        -np.log(0.01) / rate, rel=0.04
+    )
+    # measured utilization == offered rho
+    dur = float(res.client_end.max())
+    assert res.utilization(dur, sim.replicas)[0] == pytest.approx(
+        0.7, rel=0.02
+    )
+
+
+# -- 3. fidelity: engine vs oracle ----------------------------------------
+
+
+def fidelity_case(yaml_text, load, tol_p50, tol_p99, seed=0,
+                  n_engine=200_000, n_oracle=1_000_000, warmup=0.5):
+    res_e, res_o = both(yaml_text, load, n_engine, n_oracle, seed=seed)
+    lat_e = np.asarray(res_e.client_latency, np.float64)
+    lat_o = res_o.client_latency[res_o.client_start >= warmup]
+    for q, tol in ((0.5, tol_p50), (0.99, tol_p99)):
+        e, o = np.quantile(lat_e, q), np.quantile(lat_o, q)
+        assert e == pytest.approx(o, rel=tol), (
+            f"p{int(q * 100)}: engine={e * 1e3:.4f}ms "
+            f"oracle={o * 1e3:.4f}ms err={(e / o - 1) * 100:+.2f}%"
+        )
+    return res_e, res_o
+
+
+@pytest.mark.parametrize("rho", [0.3, 0.7])
+@pytest.mark.parametrize(
+    "name,yaml_text",
+    [("chain3", CHAIN3), ("tree13", TREE13), ("star9", STAR9)],
+)
+def test_open_loop_fidelity(name, yaml_text, rho):
+    load = LoadModel(kind="open", qps=rho * MU)
+    fidelity_case(yaml_text, load, tol_p50=0.05, tol_p99=0.05)
+
+
+def test_closed_loop_paced_fidelity():
+    # fortio's latency-benchmark mode: finite qps, many connections
+    load = LoadModel(kind="closed", qps=0.5 * MU, connections=64)
+    res_e, res_o = fidelity_case(
+        CHAIN3, load, tol_p50=0.05, tol_p99=0.05,
+        n_engine=128_000, n_oracle=512_000,
+    )
+    thr_o = len(res_o.client_latency) / float(res_o.client_end.max())
+    assert float(res_e.offered_qps) == pytest.approx(thr_o, rel=0.02)
+
+
+def test_closed_loop_saturated_throughput():
+    # -qps max: the solver's equilibrium rate must match the oracle's
+    # measured throughput.  (The latency *tail* at saturation is a
+    # documented out-of-envelope regime — see ORACLE.md: the open-loop
+    # wait model cannot represent the closed population bound.)
+    load = LoadModel(kind="closed", qps=None, connections=64)
+    res_e, res_o = both(CHAIN3, load, 128_000, 512_000)
+    thr_o = len(res_o.client_latency) / float(res_o.client_end.max())
+    assert float(res_e.offered_qps) == pytest.approx(thr_o, rel=0.05)
+    # means agree by construction of the fixed point
+    lat_e = np.asarray(res_e.client_latency, np.float64)
+    assert lat_e.mean() == pytest.approx(
+        res_o.client_latency.mean(), rel=0.08
+    )
+
+
+def test_error_rate_fidelity():
+    # client-visible error fraction: entry 500s with its own rate;
+    # downstream 500s do not propagate
+    yaml_text = """
+services:
+- name: entry
+  isEntrypoint: true
+  errorRate: 10%
+  script: [{call: leaf}]
+- name: leaf
+  errorRate: 50%
+"""
+    load = LoadModel(kind="open", qps=0.3 * MU)
+    res_e, res_o = both(yaml_text, load, 100_000, 200_000)
+    frac_e = float(np.asarray(res_e.client_error).mean())
+    frac_o = float(res_o.client_error.mean())
+    assert frac_e == pytest.approx(0.10, abs=0.01)
+    assert frac_o == pytest.approx(0.10, abs=0.01)
+
+
+def test_call_probability_fidelity():
+    yaml_text = """
+services:
+- name: entry
+  isEntrypoint: true
+  script:
+  - call: {service: maybe, probability: 50}
+- name: maybe
+  script: [{sleep: 20ms}]
+"""
+    res_e, res_o = both(yaml_text, QUIET, 4000, 4000, params=DET)
+    # ~half the requests pay the 20ms call
+    long_e = (np.asarray(res_e.client_latency) > 0.02).mean()
+    long_o = (res_o.client_latency > 0.02).mean()
+    assert long_e == pytest.approx(0.5, abs=0.03)
+    assert long_o == pytest.approx(0.5, abs=0.03)

@@ -1,0 +1,144 @@
+"""Engine-vs-oracle fidelity sweep (generates the ORACLE.md table).
+
+Runs the analytic TPU engine and the exact DES oracle on the same
+topologies and loads, and prints the relative error of the engine's
+p50/p99 against the oracle's ground truth.
+
+Usage: JAX_PLATFORMS=cpu python tools/fidelity_check.py
+"""
+from __future__ import annotations
+
+import argparse
+
+import jax
+import numpy as np
+
+from isotope_tpu.compiler import compile_graph
+from isotope_tpu.models.graph import ServiceGraph
+from isotope_tpu.sim.config import LoadModel, SimParams
+from isotope_tpu.sim.engine import Simulator
+from isotope_tpu.sim.oracle import OracleSimulator
+
+CHAIN3 = """
+services:
+- name: a
+  isEntrypoint: true
+  script: [{call: b}]
+- name: b
+  script: [{call: c}]
+- name: c
+"""
+
+TREE13 = """
+defaults: {responseSize: 1 KiB, requestSize: 1 KiB}
+services:
+- name: entry
+  isEntrypoint: true
+  script:
+  - [{call: c0}, {call: c1}, {call: c2}]
+- name: c0
+  script: [[{call: l00}, {call: l01}, {call: l02}]]
+- name: c1
+  script: [[{call: l10}, {call: l11}, {call: l12}]]
+- name: c2
+  script: [[{call: l20}, {call: l21}, {call: l22}]]
+- name: l00
+- name: l01
+- name: l02
+- name: l10
+- name: l11
+- name: l12
+- name: l20
+- name: l21
+- name: l22
+"""
+
+STAR9 = """
+services:
+- name: entry
+  isEntrypoint: true
+  script:
+  - [{call: s0}, {call: s1}, {call: s2}, {call: s3},
+     {call: s4}, {call: s5}, {call: s6}, {call: s7}]
+- name: s0
+- name: s1
+- name: s2
+- name: s3
+- name: s4
+- name: s5
+- name: s6
+- name: s7
+"""
+
+
+def compare(
+    name: str,
+    yaml_text: str,
+    load: LoadModel,
+    n_engine: int,
+    n_oracle: int,
+    params: SimParams = SimParams(),
+    warmup_s: float = 0.5,
+    seed: int = 0,
+):
+    graph = ServiceGraph.from_yaml(yaml_text)
+    engine = Simulator(compile_graph(graph), params)
+    res_e = engine.run(load, n_engine, jax.random.PRNGKey(seed))
+    lat_e = np.asarray(res_e.client_latency, np.float64)
+
+    oracle = OracleSimulator(graph, params)
+    res_o = oracle.run(load, n_oracle, seed=seed)
+    mask = res_o.client_start >= warmup_s
+    lat_o = res_o.client_latency[mask]
+
+    qs = (0.5, 0.99)
+    qe = np.quantile(lat_e, qs)
+    qo = np.quantile(lat_o, qs)
+    rows = []
+    for q, e, o in zip(qs, qe, qo):
+        rows.append((name, q, e, o, e / o - 1.0))
+    # throughput check for closed loop
+    thr_e = float(res_e.offered_qps)
+    dur_o = float(res_o.client_end.max())
+    thr_o = len(res_o.client_latency) / dur_o if dur_o > 0 else 0.0
+    return rows, (thr_e, thr_o)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--n-engine", type=int, default=400_000)
+    ap.add_argument("--n-oracle", type=int, default=2_000_000)
+    args = ap.parse_args()
+
+    params = SimParams()
+    mu = 1.0 / params.cpu_time_s
+    print(f"{'case':<28}{'q':>6}{'engine':>12}{'oracle':>12}{'rel_err':>9}")
+    for name, yaml_text in (
+        ("chain3", CHAIN3), ("tree13", TREE13), ("star9", STAR9)
+    ):
+        for rho in (0.3, 0.7):
+            load = LoadModel(kind="open", qps=rho * mu)
+            rows, _ = compare(
+                f"{name}/open rho={rho}", yaml_text, load,
+                args.n_engine, args.n_oracle,
+            )
+            for r in rows:
+                print(f"{r[0]:<28}{r[1]:>6}{r[2]*1e3:>10.4f}ms"
+                      f"{r[3]*1e3:>10.4f}ms{r[4]*100:>8.2f}%")
+    # closed loop: 64 connections, qps None (max) and paced
+    for name, yaml_text in (("chain3", CHAIN3),):
+        for qps, tag in ((None, "max"), (0.5 * mu, "half")):
+            load = LoadModel(kind="closed", qps=qps, connections=64)
+            rows, (te, to) = compare(
+                f"{name}/closed64 {tag}", yaml_text, load,
+                256_000, 1_024_000,
+            )
+            for r in rows:
+                print(f"{r[0]:<28}{r[1]:>6}{r[2]*1e3:>10.4f}ms"
+                      f"{r[3]*1e3:>10.4f}ms{r[4]*100:>8.2f}%")
+            print(f"{'  throughput':<28}{'':>6}{te:>10.0f}/s"
+                  f"{to:>10.0f}/s{(te/to-1)*100:>8.2f}%")
+
+
+if __name__ == "__main__":
+    main()
