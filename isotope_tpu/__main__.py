@@ -1,0 +1,7 @@
+"""``python -m isotope_tpu`` == the ``isotope-tpu`` console script."""
+import sys
+
+from isotope_tpu.cli import main
+
+if __name__ == "__main__":
+    sys.exit(main())

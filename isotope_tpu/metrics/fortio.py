@@ -3,17 +3,19 @@
 Produces the same artifacts the reference's collection pipeline scrapes and
 flattens (perf/benchmark/runner/fortio.py):
 
-- ``fortio_result``: a Fortio-style result JSON (the schema ``fortio load
-  -json`` writes and ``convert_data`` consumes: DurationHistogram with
-  Min/Max/Avg/StdDev/Percentiles, RetCodes, Sizes, ActualQPS...);
+- ``fortio_result`` / ``fortio_result_from_summary``: a Fortio-style
+  result JSON (the schema ``fortio load -json`` writes and
+  ``convert_data`` consumes: DurationHistogram with Min/Max/Avg/StdDev/
+  Percentiles, RetCodes, Sizes, ActualQPS...) — from dense per-request
+  SimResults resp. from the scan path's O(buckets) RunSummary;
 - ``convert_data``: the reference's single-line flattening
   (fortio.py:38-75) — integer microsecond percentiles, errorPercent,
   Payload — reimplemented so downstream CSV/BigQuery consumers are
   drop-in;
-- ``trim_window_summary``: the reference's Prometheus-join window
-  semantics (fortio.py:116-121, 175-186): skip the first 62s and last
-  30s, summarize at most 180s, and flag runs with >10% errors as
-  discarded;
+- ``trim_window_summary`` / ``window_summary_from_summary``: the
+  reference's Prometheus-join window semantics (fortio.py:116-121,
+  175-186): skip the first 62s and last 30s, summarize at most 180s, and
+  flag runs with >10% errors as discarded;
 - ``write_csv``: fortio.py:215-232's key-list CSV writer.
 """
 from __future__ import annotations
@@ -75,26 +77,30 @@ def _histogram_data(lat: np.ndarray) -> List[dict]:
     return data
 
 
-def fortio_result(
-    res: SimResults,
+def _fortio_doc(
     load: LoadModel,
-    labels: str = "",
-    start_time: Optional[datetime] = None,
-    response_size_bytes: float = 0.0,
+    labels: str,
+    start_time: Optional[datetime],
+    response_size_bytes: float,
+    *,
+    n: int,
+    errors: int,
+    actual_duration_s: float,
+    lat_min: float,
+    lat_max: float,
+    lat_sum: float,
+    lat_avg: float,
+    lat_std: float,
+    data: List[dict],
+    percentiles: List[dict],
 ) -> dict:
-    """Render a run as a Fortio result JSON document."""
-    lat = np.asarray(res.client_latency, np.float64)
-    err = np.asarray(res.client_error)
-    n = len(lat)
-    end = np.asarray(res.client_end, np.float64)
-    actual_duration_s = float(end.max()) if n else 0.0
+    """The shared Fortio result-JSON scaffolding for both derivations."""
     start_time = start_time or datetime.now(timezone.utc)
     ret_codes: Dict[str, int] = {}
-    n_ok = int((~err).sum())
-    if n_ok:
-        ret_codes["200"] = n_ok
-    if n - n_ok:
-        ret_codes["500"] = int(n - n_ok)
+    if n - errors:
+        ret_codes["200"] = n - errors
+    if errors:
+        ret_codes["500"] = errors
     return {
         "RunType": "HTTP",
         "Labels": labels,
@@ -106,18 +112,111 @@ def fortio_result(
         "NumThreads": load.connections,
         "DurationHistogram": {
             "Count": n,
-            "Min": float(lat.min()) if n else 0.0,
-            "Max": float(lat.max()) if n else 0.0,
-            "Sum": float(lat.sum()),
-            "Avg": float(lat.mean()) if n else 0.0,
-            "StdDev": float(lat.std()) if n else 0.0,
-            "Data": _histogram_data(lat),
-            "Percentiles": _percentile_list(lat),
+            "Min": lat_min if n else 0.0,
+            "Max": lat_max if n else 0.0,
+            "Sum": lat_sum,
+            "Avg": lat_avg if n else 0.0,
+            "StdDev": lat_std if n else 0.0,
+            "Data": data,
+            "Percentiles": percentiles,
         },
         "RetCodes": ret_codes,
         # the payload the client receives: the entrypoint's responseSize
         "Sizes": {"Count": n, "Avg": float(response_size_bytes)},
     }
+
+
+def fortio_result(
+    res: SimResults,
+    load: LoadModel,
+    labels: str = "",
+    start_time: Optional[datetime] = None,
+    response_size_bytes: float = 0.0,
+) -> dict:
+    """Render a dense per-request run as a Fortio result JSON document."""
+    lat = np.asarray(res.client_latency, np.float64)
+    err = np.asarray(res.client_error)
+    n = len(lat)
+    end = np.asarray(res.client_end, np.float64)
+    return _fortio_doc(
+        load, labels, start_time, response_size_bytes,
+        n=n,
+        errors=int(err.sum()),
+        actual_duration_s=float(end.max()) if n else 0.0,
+        lat_min=float(lat.min()) if n else 0.0,
+        lat_max=float(lat.max()) if n else 0.0,
+        lat_sum=float(lat.sum()),
+        lat_avg=float(lat.mean()) if n else 0.0,
+        lat_std=float(lat.std()) if n else 0.0,
+        data=_histogram_data(lat),
+        percentiles=_percentile_list(lat),
+    )
+
+
+def fortio_result_from_summary(
+    summary,
+    load: LoadModel,
+    labels: str = "",
+    start_time: Optional[datetime] = None,
+    response_size_bytes: float = 0.0,
+) -> dict:
+    """Render a :class:`~isotope_tpu.sim.summary.RunSummary` as a Fortio
+    result JSON — the scan-path counterpart of :func:`fortio_result`.
+
+    Exact where Fortio is exact (Count, Min, Max, Sum, Avg, StdDev,
+    RetCodes, ActualQPS); Percentiles and the bucket rows come from the
+    fine log-spaced device histogram (~0.6% relative bucket width), the
+    same reduction Fortio itself applies at 1ms resolution
+    (runner.py:136-137).
+    """
+    from isotope_tpu.metrics.histogram import (
+        bucket_centers,
+        quantile_from_histogram,
+    )
+
+    n = int(summary.count)
+    hist = np.asarray(summary.latency_hist, np.float64)
+    qs = quantile_from_histogram(hist, [p / 100.0 for p in PERCENTILES])
+    percentiles = [
+        {"Percentile": p, "Value": float(v)} for p, v in zip(PERCENTILES, qs)
+    ]
+
+    # re-bucket the fine histogram into Fortio's 1ms rows
+    data: List[dict] = []
+    if n:
+        res_s = HISTOGRAM_RESOLUTION_S
+        lat_max = float(summary.latency_max)
+        hi = max(min(int(np.ceil(lat_max / res_s)), 1000), 1)
+        bins = np.minimum(
+            (bucket_centers() / res_s).astype(np.int64), hi - 1
+        )
+        counts = np.zeros(hi)
+        np.add.at(counts, bins, hist)
+        for i, c in enumerate(counts):
+            if c == 0:
+                continue
+            data.append(
+                {
+                    "Start": float(i * res_s),
+                    "End": float((i + 1) * res_s),
+                    "Percent": float(100.0 * c / n),
+                    "Count": int(round(c)),
+                }
+            )
+
+    return _fortio_doc(
+        load, labels, start_time, response_size_bytes,
+        n=n,
+        errors=int(summary.error_count),
+        actual_duration_s=float(summary.end_max) if n else 0.0,
+        lat_min=float(summary.latency_min),
+        lat_max=float(summary.latency_max),
+        lat_sum=float(summary.latency_sum),
+        lat_avg=summary.mean_latency_s,
+        lat_std=summary.stddev_latency_s,
+        data=data,
+        percentiles=percentiles,
+    )
 
 
 def convert_data(data: dict) -> Optional[dict]:
@@ -158,6 +257,18 @@ def convert_data(data: dict) -> Optional[dict]:
     return obj
 
 
+def trim_window_bounds(
+    num_requests: int, offered_qps: float
+) -> "tuple[float, float]":
+    """The ``[lo, hi)`` client-start interval of the collector's trim
+    window, placed from the run's expected duration (fortio.py:116-121)."""
+    d_exp = num_requests / max(float(offered_qps), 1e-12)
+    min_dur = METRICS_START_SKIP_DURATION + METRICS_END_SKIP_DURATION
+    w_len = min(max(d_exp - min_dur, 0.0), METRICS_SUMMARY_DURATION)
+    lo = float(METRICS_START_SKIP_DURATION)
+    return lo, lo + w_len
+
+
 @dataclasses.dataclass(frozen=True)
 class WindowSummary:
     """Steady-state window statistics (the sim's stand-in for the
@@ -176,20 +287,23 @@ class WindowSummary:
     cpu_cores: Dict[str, float]
 
 
-def trim_window_summary(
-    res: SimResults,
-    load: LoadModel,
-    service_names=(),
-    replicas=None,
+def _window_summary(
+    *,
+    count: int,
+    error_count: float,
+    actual_duration: float,
+    w_start: float,
+    w_len: float,
+    wcount: int,
+    werr: float,
+    percentiles: Dict[str, int],
+    utilization: np.ndarray,
+    service_names,
+    replicas,
 ) -> WindowSummary:
-    lat = np.asarray(res.client_latency, np.float64)
-    starts = np.asarray(res.client_start, np.float64)
-    err = np.asarray(res.client_error)
-    actual_duration = float(np.asarray(res.client_end).max()) if len(lat) else 0.0
-
+    """Shared discard logic + shaping for both window derivations."""
     min_duration = METRICS_START_SKIP_DURATION + METRICS_END_SKIP_DURATION
-    count = len(lat)
-    error_percent = 100.0 * float(err.sum()) / count if count else 0.0
+    error_percent = 100.0 * float(error_count) / count if count else 0.0
 
     discarded, reason = False, ""
     if error_percent > MAX_ERROR_PERCENT:
@@ -201,22 +315,7 @@ def trim_window_summary(
             f"{min_duration}s",
         )
 
-    w_start = float(METRICS_START_SKIP_DURATION)
-    w_len = min(
-        max(actual_duration - min_duration, 0.0), METRICS_SUMMARY_DURATION
-    )
-    mask = (starts >= w_start) & (starts < w_start + w_len)
-    wlat = lat[mask]
-    werr = err[mask]
-    wcount = int(mask.sum())
-    percentiles = {}
-    if wcount:
-        qs = np.quantile(wlat, [p / 100.0 for p in PERCENTILES])
-        percentiles = {
-            "p" + str(p).replace(".", ""): int(v * 1e6)
-            for p, v in zip(PERCENTILES, qs)
-        }
-    util = np.asarray(res.utilization, np.float64)
+    util = np.asarray(utilization, np.float64)
     reps = (
         np.asarray(replicas, np.float64)
         if replicas is not None
@@ -232,12 +331,106 @@ def trim_window_summary(
         count=wcount,
         qps=(wcount / w_len) if w_len > 0 else 0.0,
         error_percent=(
-            100.0 * float(werr.sum()) / wcount if wcount else error_percent
+            100.0 * float(werr) / wcount if wcount else error_percent
         ),
         discarded=discarded,
         discard_reason=reason,
         percentiles_us=percentiles,
         cpu_cores=cpu,
+    )
+
+
+def trim_window_summary(
+    res: SimResults,
+    load: LoadModel,
+    service_names=(),
+    replicas=None,
+) -> WindowSummary:
+    lat = np.asarray(res.client_latency, np.float64)
+    starts = np.asarray(res.client_start, np.float64)
+    err = np.asarray(res.client_error)
+    actual_duration = (
+        float(np.asarray(res.client_end).max()) if len(lat) else 0.0
+    )
+
+    w_start = float(METRICS_START_SKIP_DURATION)
+    min_duration = METRICS_START_SKIP_DURATION + METRICS_END_SKIP_DURATION
+    w_len = min(
+        max(actual_duration - min_duration, 0.0), METRICS_SUMMARY_DURATION
+    )
+    mask = (starts >= w_start) & (starts < w_start + w_len)
+    wlat = lat[mask]
+    wcount = int(mask.sum())
+    percentiles = {}
+    if wcount:
+        qs = np.quantile(wlat, [p / 100.0 for p in PERCENTILES])
+        percentiles = {
+            "p" + str(p).replace(".", ""): int(v * 1e6)
+            for p, v in zip(PERCENTILES, qs)
+        }
+    return _window_summary(
+        count=len(lat),
+        error_count=float(err.sum()),
+        actual_duration=actual_duration,
+        w_start=w_start,
+        w_len=w_len,
+        wcount=wcount,
+        werr=float(err[mask].sum()),
+        percentiles=percentiles,
+        utilization=res.utilization,
+        service_names=service_names,
+        replicas=replicas,
+    )
+
+
+def window_summary_from_summary(
+    summary,
+    service_names=(),
+    replicas=None,
+) -> WindowSummary:
+    """Trim-window statistics from a RunSummary's on-device ``win_*``
+    accumulators (the scan-path counterpart of
+    :func:`trim_window_summary`).
+
+    The reported window is the one the device actually accumulated
+    (``summary.win_lo``/``win_hi``, placed from the expected duration) —
+    never a recomputed one, so windowed QPS stays consistent with
+    ``win_count``.  Produced with ``trim=False`` the window covers the
+    whole run and the length falls back to the actual duration.
+    """
+    from isotope_tpu.metrics.histogram import quantile_from_histogram
+
+    count = int(summary.count)
+    actual_duration = float(summary.end_max) if count else 0.0
+    win_lo = float(summary.win_lo)
+    win_hi = float(summary.win_hi)
+    if np.isfinite(win_hi):
+        w_start, w_len = win_lo, win_hi - win_lo
+    else:  # trim was off: the "window" is the whole run
+        w_start, w_len = 0.0, actual_duration
+    wcount = int(summary.win_count)
+    percentiles = {}
+    if wcount:
+        qs = quantile_from_histogram(
+            np.asarray(summary.win_latency_hist),
+            [p / 100.0 for p in PERCENTILES],
+        )
+        percentiles = {
+            "p" + str(p).replace(".", ""): int(v * 1e6)
+            for p, v in zip(PERCENTILES, qs)
+        }
+    return _window_summary(
+        count=count,
+        error_count=float(summary.error_count),
+        actual_duration=actual_duration,
+        w_start=w_start,
+        w_len=w_len,
+        wcount=wcount,
+        werr=float(summary.win_error_count),
+        percentiles=percentiles,
+        utilization=summary.utilization,
+        service_names=service_names,
+        replicas=replicas,
     )
 
 

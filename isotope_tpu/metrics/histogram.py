@@ -46,6 +46,15 @@ def latency_histogram(latencies: jax.Array, weights=None) -> jax.Array:
     return jnp.zeros(NUM_BUCKETS, jnp.float32).at[idx].add(w)
 
 
+def bucket_centers() -> np.ndarray:
+    """Representative value per bucket (geometric mean of its edges)."""
+    centers = np.empty(NUM_BUCKETS)
+    centers[0] = EDGES[1] / 2
+    centers[1:-1] = np.sqrt(EDGES[1:-2] * EDGES[2:-1])
+    centers[-1] = EDGES[-2]
+    return centers
+
+
 def quantile_from_histogram(hist: np.ndarray, qs) -> np.ndarray:
     """Recover quantiles from bucket counts (geometric-mean bucket value)."""
     hist = np.asarray(hist, np.float64)
@@ -53,9 +62,5 @@ def quantile_from_histogram(hist: np.ndarray, qs) -> np.ndarray:
     if total == 0:
         return np.zeros(len(qs))
     cum = np.cumsum(hist)
-    centers = np.empty(NUM_BUCKETS)
-    centers[0] = EDGES[1] / 2
-    centers[1:-1] = np.sqrt(EDGES[1:-2] * EDGES[2:-1])
-    centers[-1] = EDGES[-2]
     idx = np.searchsorted(cum, np.asarray(qs) * total, side="left")
-    return centers[np.minimum(idx, NUM_BUCKETS - 1)]
+    return bucket_centers()[np.minimum(idx, NUM_BUCKETS - 1)]

@@ -67,6 +67,7 @@ class ShardedSimulator:
         key: jax.Array,
         offered_qps=None,
         block_size: int = 65_536,
+        trim: bool = False,
     ) -> RunSummary:
         """Simulate >= ``num_requests`` (rounded up to fill all shards),
         scanning blocks of at most ``block_size`` requests per device.
@@ -74,6 +75,8 @@ class ShardedSimulator:
         For closed-loop load the offered rate is latency-dependent; pass
         ``offered_qps`` (e.g. ``SimResults.offered_qps`` from a prior
         single-device run of the same load) to skip the pilot fixed point.
+        ``trim=True`` accumulates the collector's steady-state window
+        into the summary's ``win_*`` fields (see Simulator.run_summary).
         """
         n_local = -(-num_requests // self.n_shards)
         if load.kind == OPEN_LOOP:
@@ -105,29 +108,46 @@ class ShardedSimulator:
             per = max(1, min(block_size, n_local) // conns_local)
             block = per * conns_local
         num_blocks = max(1, -(-n_local // block))
-        return self._get(block, num_blocks, load.kind, conns_local)(
-            key, offered, gap, nominal_gap
+        if trim:
+            from isotope_tpu.metrics.fortio import trim_window_bounds
+
+            window = trim_window_bounds(
+                num_blocks * block * self.n_shards, float(offered)
+            )
+        else:
+            window = (0.0, float("inf"))
+        return self._get(block, num_blocks, load.kind, conns_local, trim)(
+            key, offered, gap, nominal_gap,
+            jnp.float32(window[0]), jnp.float32(window[1]),
         )
 
     # ------------------------------------------------------------------
 
     def _get(self, block: int, num_blocks: int, kind: str,
-             conns_local: int):
-        cache_key = (block, num_blocks, kind, conns_local)
+             conns_local: int, trim: bool = False):
+        cache_key = (block, num_blocks, kind, conns_local, trim)
         if cache_key not in self._fns:
-            body = partial(self._body, block, num_blocks, kind, conns_local)
+            body = partial(self._body, block, num_blocks, kind, conns_local,
+                           trim)
             mapped = jax.shard_map(
                 body,
                 mesh=self.mesh,
-                in_specs=(P(), P(), P(), P()),
+                in_specs=(P(), P(), P(), P(), P(), P()),
                 out_specs=RunSummary(
                     count=P(),
                     error_count=P(),
                     hop_events=P(),
                     latency_sum=P(),
+                    latency_m2=P(),
                     latency_min=P(),
                     latency_max=P(),
                     latency_hist=P(),
+                    end_max=P(),
+                    win_lo=P(),
+                    win_hi=P(),
+                    win_count=P(),
+                    win_error_count=P(),
+                    win_latency_hist=P(),
                     metrics=ServiceMetrics(
                         incoming_total=P(),
                         outgoing_total=P(),
@@ -152,10 +172,13 @@ class ShardedSimulator:
         num_blocks: int,
         kind: str,
         conns_local: int,
+        trim: bool,
         key: jax.Array,
         offered_qps: jax.Array,
         pace_gap: jax.Array,
         nominal_gap: jax.Array,
+        win_lo: jax.Array,
+        win_hi: jax.Array,
     ) -> RunSummary:
         both = (DATA_AXIS, SVC_AXIS)
         shard = (
@@ -186,7 +209,8 @@ class ShardedSimulator:
                 req_off,
             )
             return (t_end, conn_end, req_off + per), summarize(
-                res, self.collector
+                res, self.collector,
+                window=(win_lo, win_hi) if trim else None,
             )
 
         carry0 = (
@@ -221,14 +245,30 @@ class ShardedSimulator:
             response_size_hist=scatter_svc(m.response_size_hist),
             response_size_sum=allsum(m.response_size_sum),
         )
+        # Chan/Welford merge of per-shard centered second moments
+        n_tot = allsum(local.count)
+        s_tot = allsum(local.latency_sum)
+        mean_local = local.latency_sum / jnp.maximum(local.count, 1.0)
+        mean_tot = s_tot / jnp.maximum(n_tot, 1.0)
+        m2_tot = allsum(
+            local.latency_m2
+            + local.count * (mean_local - mean_tot) ** 2
+        )
         return RunSummary(
-            count=allsum(local.count),
+            count=n_tot,
             error_count=allsum(local.error_count),
             hop_events=allsum(local.hop_events),
-            latency_sum=allsum(local.latency_sum),
+            latency_sum=s_tot,
+            latency_m2=m2_tot,
             latency_min=jax.lax.pmin(local.latency_min, both),
             latency_max=jax.lax.pmax(local.latency_max, both),
             latency_hist=allsum(local.latency_hist),
+            end_max=jax.lax.pmax(local.end_max, both),
+            win_lo=local.win_lo,   # identical on every shard
+            win_hi=local.win_hi,
+            win_count=allsum(local.win_count),
+            win_error_count=allsum(local.win_error_count),
+            win_latency_hist=allsum(local.win_latency_hist),
             metrics=metrics,
             utilization=local.utilization,
             unstable=local.unstable,

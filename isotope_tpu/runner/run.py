@@ -19,15 +19,15 @@ from isotope_tpu.metrics.fortio import (
     DEFAULT_CSV_KEYS,
     WindowSummary,
     convert_data,
-    fortio_result,
-    trim_window_summary,
+    fortio_result_from_summary,
+    window_summary_from_summary,
     write_csv,
 )
 from isotope_tpu.metrics.prometheus import MetricsCollector
 from isotope_tpu.models.graph import ServiceGraph
 from isotope_tpu.parallel import ShardedSimulator, make_mesh
 from isotope_tpu.runner.config import ExperimentConfig
-from isotope_tpu.sim.config import LoadModel
+from isotope_tpu.sim.config import OPEN_LOOP, LoadModel
 from isotope_tpu.sim.engine import Simulator
 
 
@@ -101,14 +101,30 @@ def run_experiment(
                 n = _num_requests(
                     load, sim.capacity_qps(), config.num_requests
                 )
-                res = sim.run(load, n, run_key)
-                doc = fortio_result(
-                    res, load, labels=label, response_size_bytes=entry_resp
+                # the scan path is the product path: requests stream
+                # through HBM-bounded blocks, metrics and the trim window
+                # accumulate on device — 1M-request runs fit on one chip
+                block = sim.default_block_size()
+                use_sharded = sharded is not None and (
+                    load.kind == OPEN_LOOP
+                    or load.connections % sharded.n_shards == 0
+                )
+                if use_sharded:
+                    summary = sharded.run(
+                        load, n, run_key, block_size=block, trim=True
+                    )
+                else:
+                    summary = sim.run_summary(
+                        load, n, run_key, block_size=block,
+                        collector=collector, trim=True,
+                    )
+                doc = fortio_result_from_summary(
+                    summary, load, labels=label,
+                    response_size_bytes=entry_resp,
                 )
                 flat = convert_data(doc)
-                window = trim_window_summary(
-                    res,
-                    load,
+                window = window_summary_from_summary(
+                    summary,
                     service_names=compiled.services.names,
                     replicas=compiled.services.replicas,
                 )
@@ -119,15 +135,7 @@ def run_experiment(
                         for name, v in window.cpu_cores.items()
                     }
                 )
-                if sharded is not None:
-                    # large-batch sharded pass for the device-side metrics;
-                    # reuse the fixed point the single-device run solved
-                    summary = sharded.run(
-                        load, n, run_key, offered_qps=res.offered_qps
-                    )
-                    prom_text = collector.to_text(summary.metrics)
-                else:
-                    prom_text = collector.to_text(collector.collect(res))
+                prom_text = collector.to_text(summary.metrics)
                 results.append(
                     RunResult(
                         label=label,

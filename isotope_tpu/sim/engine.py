@@ -350,6 +350,7 @@ class Simulator:
         block_size: int = 65_536,
         collector=None,
         fixed_point_iters: int = 3,
+        trim: bool = False,
     ):
         """Simulate >= ``num_requests`` in HBM-bounded blocks.
 
@@ -360,6 +361,13 @@ class Simulator:
         perf/benchmark/runner/fortio.py:38-75).  Arrival clocks carry
         across blocks, so chaos phases and closed-loop pacing see one
         continuous timeline.
+
+        ``trim=True`` also accumulates the reference collector's
+        steady-state window (fortio.py:116-121: skip 62s, cap 180s) into
+        the summary's ``win_*`` fields.  The window is placed from the
+        run's *expected* duration (simulated count / offered rate) since
+        the actual end isn't known until the scan finishes; the relative
+        error is O(1/sqrt(N)) of the arrival process.
         """
         if load.kind == OPEN_LOOP:
             offered = float(load.qps)
@@ -379,12 +387,28 @@ class Simulator:
             per = max(1, min(block_size, num_requests) // conns)
             block = per * conns
         num_blocks = max(1, -(-num_requests // block))
+        if trim:
+            # lazy: metrics.fortio imports this module for its types
+            from isotope_tpu.metrics.fortio import trim_window_bounds
+
+            window = trim_window_bounds(num_blocks * block, offered)
+        else:
+            window = (0.0, np.inf)
         fn = self._get_summary(block, num_blocks, load.kind, conns,
-                               collector)
+                               collector, trim)
         return fn(
             key, jnp.float32(offered), jnp.float32(pace),
             jnp.float32(offered), jnp.float32(nominal),
+            jnp.float32(window[0]), jnp.float32(window[1]),
         )
+
+    def default_block_size(self, budget_elems: int = 16_777_216) -> int:
+        """A block size keeping each (block, H) event tensor near
+        ``budget_elems`` elements (~64 MiB at f32) — the HBM knob of the
+        scan path.  bench.py's measured sweet spot: 65536 blocks for the
+        121-hop tree, 8192 for the ~2000-hop fan-out."""
+        h = max(self.compiled.num_hops, 1)
+        return int(max(256, min(65_536, budget_elems // h)))
 
     def capacity_qps(self) -> float:
         """Saturation throughput: the bottleneck station's capacity."""
@@ -409,18 +433,18 @@ class Simulator:
         return self._fns[key]
 
     def _get_summary(self, block: int, num_blocks: int, kind: str,
-                     connections: int, collector):
+                     connections: int, collector, trim: bool = False):
         """Jitted scan-over-blocks program producing a RunSummary."""
         from isotope_tpu.sim import summary as summary_mod
 
         cache_key = (block, num_blocks, kind, connections,
-                     collector is not None)
+                     collector is not None, trim)
         if cache_key not in self._summary_fns:
             c = max(connections, 1)
             per = block // c
 
             def scanfn(key, offered_qps, pace_gap, arrival_qps,
-                       nominal_gap):
+                       nominal_gap, win_lo, win_hi):
                 def body(carry, b):
                     t0, conn_t0, req_off = carry
                     # disjoint fold domain: the closed-loop rate solver's
@@ -431,7 +455,10 @@ class Simulator:
                         pace_gap, arrival_qps, nominal_gap, t0, conn_t0,
                         req_off,
                     )
-                    s = summary_mod.summarize(res, collector)
+                    s = summary_mod.summarize(
+                        res, collector,
+                        window=(win_lo, win_hi) if trim else None,
+                    )
                     return (t_end, conn_end, req_off + per), s
 
                 carry0 = (

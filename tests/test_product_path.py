@@ -1,0 +1,141 @@
+"""Summary-path formatters vs the dense per-request formatters.
+
+The product path (runner + CLI) now flows through ``run_summary`` —
+O(buckets) on-device accumulation — so the Fortio JSON / trim-window /
+CSV artifacts are derived from a RunSummary instead of per-request
+tensors.  These tests pin the two derivations against each other on the
+SAME SimResults, so any drift is formatter error, not RNG noise.
+"""
+import jax
+import numpy as np
+import pytest
+import yaml
+
+from isotope_tpu.compiler import compile_graph
+from isotope_tpu.metrics.fortio import (
+    fortio_result,
+    fortio_result_from_summary,
+    trim_window_bounds,
+    trim_window_summary,
+    window_summary_from_summary,
+)
+from isotope_tpu.models.graph import ServiceGraph
+from isotope_tpu.sim.config import LoadModel
+from isotope_tpu.sim.engine import Simulator
+from isotope_tpu.sim.summary import summarize
+
+CHAIN = """
+services:
+- name: entry
+  isEntrypoint: true
+  errorRate: 2%
+  script:
+  - call: leaf
+- name: leaf
+  script:
+  - sleep: 1ms
+"""
+
+
+def _run(load, n, seed=0):
+    sim = Simulator(compile_graph(ServiceGraph.decode(yaml.safe_load(CHAIN))))
+    res = sim.run(load, n, jax.random.PRNGKey(seed))
+    return sim, res
+
+
+def test_fortio_result_from_summary_matches_dense():
+    load = LoadModel(kind="open", qps=500.0, duration_s=10.0)
+    _, res = _run(load, 5000)
+    summary = summarize(res)
+
+    dense = fortio_result(res, load, labels="x", response_size_bytes=1024)
+    via_summary = fortio_result_from_summary(
+        summary, load, labels="x", response_size_bytes=1024
+    )
+
+    for key in ("RunType", "Labels", "RequestedQPS", "RequestedDuration",
+                "NumThreads", "RetCodes", "Sizes"):
+        assert via_summary[key] == dense[key]
+    assert via_summary["ActualQPS"] == pytest.approx(
+        dense["ActualQPS"], rel=1e-4
+    )
+    dh, sh = dense["DurationHistogram"], via_summary["DurationHistogram"]
+    assert sh["Count"] == dh["Count"]
+    assert sh["Min"] == pytest.approx(dh["Min"], rel=1e-5)
+    assert sh["Max"] == pytest.approx(dh["Max"], rel=1e-5)
+    # f32 accumulation on device vs f64 on host
+    assert sh["Avg"] == pytest.approx(dh["Avg"], rel=1e-3)
+    assert sh["StdDev"] == pytest.approx(dh["StdDev"], rel=2e-2)
+    # percentiles recovered from the fine log histogram (~0.6% buckets)
+    for pd, ps in zip(dh["Percentiles"], sh["Percentiles"]):
+        assert ps["Percentile"] == pd["Percentile"]
+        assert ps["Value"] == pytest.approx(pd["Value"], rel=0.02)
+    # re-bucketed rows partition the same population
+    assert sum(r["Count"] for r in sh["Data"]) == sh["Count"]
+    assert sum(r["Count"] for r in dh["Data"]) == dh["Count"]
+
+
+def test_window_summary_from_summary_matches_dense():
+    # 6000 req at 50 qps ~ 120s: window = [62, 62+28)
+    load = LoadModel(kind="open", qps=50.0, duration_s=120.0)
+    sim, res = _run(load, 6000)
+    names = sim.compiled.services.names
+    reps = sim.compiled.services.replicas
+
+    dense = trim_window_summary(res, load, service_names=names,
+                                replicas=reps)
+    lo, hi = trim_window_bounds(6000, 50.0)
+    summary = summarize(res, window=(lo, hi))
+    via = window_summary_from_summary(summary, service_names=names,
+                                      replicas=reps)
+
+    assert via.start_s == dense.start_s
+    # the summary window is placed from the EXPECTED duration; actual
+    # arrival noise at n=6000 is ~1/sqrt(n)
+    assert via.duration_s == pytest.approx(dense.duration_s, rel=0.05)
+    assert via.count == pytest.approx(dense.count, rel=0.05)
+    assert via.qps == pytest.approx(dense.qps, rel=0.1)
+    assert via.discarded == dense.discarded is False
+    assert via.error_percent == pytest.approx(dense.error_percent, abs=1.0)
+    for k, v in dense.percentiles_us.items():
+        assert via.percentiles_us[k] == pytest.approx(v, rel=0.03, abs=30)
+    assert via.cpu_cores == pytest.approx(dense.cpu_cores, rel=1e-5)
+
+
+def test_short_run_discarded_same_as_dense():
+    load = LoadModel(kind="open", qps=500.0, duration_s=4.0)
+    sim, res = _run(load, 2000)
+    dense = trim_window_summary(res, load)
+    summary = summarize(res, window=trim_window_bounds(2000, 500.0))
+    via = window_summary_from_summary(summary)
+    assert dense.discarded and via.discarded
+    assert "less than minimum" in via.discard_reason
+    # fallback: window empty => overall error percent
+    assert via.error_percent == pytest.approx(dense.error_percent, abs=0.5)
+
+
+def test_run_summary_trim_populates_window_fields():
+    sim = Simulator(
+        compile_graph(ServiceGraph.decode(yaml.safe_load(CHAIN)))
+    )
+    load = LoadModel(kind="open", qps=50.0)
+    s = sim.run_summary(load, 6000, jax.random.PRNGKey(1),
+                        block_size=2048, trim=True)
+    assert 0 < float(s.win_count) < float(s.count)
+    assert float(np.asarray(s.win_latency_hist).sum()) == float(s.win_count)
+    # untrimmed: the window covers everything
+    s2 = sim.run_summary(load, 6000, jax.random.PRNGKey(1),
+                         block_size=2048)
+    assert float(s2.win_count) == float(s2.count)
+
+
+def test_closed_loop_summary_window_spans_blocks():
+    sim = Simulator(
+        compile_graph(ServiceGraph.decode(yaml.safe_load(CHAIN)))
+    )
+    load = LoadModel(kind="closed", qps=100.0, connections=8)
+    s = sim.run_summary(load, 12000, jax.random.PRNGKey(2),
+                        block_size=1024, trim=True)
+    # ~120s run: window [62, 90) holds ~100qps * 28s requests
+    expect = 100.0 * (120.0 - 92.0)
+    assert float(s.win_count) == pytest.approx(expect, rel=0.15)
