@@ -162,11 +162,12 @@ def run_check(args) -> int:
 
     from isotope_tpu.compiler import compile_graph
     from isotope_tpu.metrics.alarms import (
-        RunSource,
         requests_sanity,
         run_queries,
         standard_queries,
+        store_from_summary,
     )
+    from isotope_tpu.metrics.prometheus import MetricsCollector
     from isotope_tpu.models.graph import ServiceGraph
     from isotope_tpu.sim.config import LoadModel
     from isotope_tpu.sim.engine import Simulator
@@ -180,15 +181,19 @@ def run_check(args) -> int:
         duration_s=dur.parse_duration_seconds(args.duration),
     )
     sim = Simulator(compiled)
+    collector = MetricsCollector(compiled)
     rate = qps if qps is not None else sim.capacity_qps()
     n = max(1, min(int(rate * load.duration_s), args.max_requests))
-    res = sim.run(load, n, jax.random.PRNGKey(args.seed))
+    summary = sim.run_summary(
+        load, n, jax.random.PRNGKey(args.seed),
+        block_size=sim.default_block_size(), collector=collector,
+    )
     label = pathlib.Path(args.topology).stem
     queries = standard_queries(
         label, cpu_lim=args.cpu_limit, mem_lim=args.mem_limit
     ) + [requests_sanity(label)]
     errors = run_queries(
-        queries, RunSource(compiled, res), debug=args.debug,
+        queries, store_from_summary(collector, summary), debug=args.debug,
         log=lambda m: print(m, file=sys.stderr),
     )
     for e in errors:

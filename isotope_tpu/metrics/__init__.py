@@ -9,7 +9,10 @@ existing analysis keeps working (SURVEY.md §5.5):
 - Fortio-style result JSON + the benchmark runner's flattened single-line
   schema and CSV (perf/benchmark/runner/fortio.py:38-75,215-232) with its
   trim-window and error-discard semantics — see
-  :mod:`isotope_tpu.metrics.fortio`.
+  :mod:`isotope_tpu.metrics.fortio`;
+- a PromQL-subset query layer over the text exposition
+  (perf/benchmark/runner/prom.py:92-126,216-232) — see
+  :mod:`isotope_tpu.metrics.query`.
 """
 from isotope_tpu.metrics.prometheus import (
     DURATION_BUCKETS,
@@ -23,20 +26,27 @@ from isotope_tpu.metrics.fortio import (
     METRICS_SUMMARY_DURATION,
     convert_data,
     fortio_result,
+    fortio_result_from_summary,
     trim_window_summary,
+    window_summary_from_summary,
     write_csv,
 )
+from isotope_tpu.metrics.query import MetricStore, parse_exposition
 
 __all__ = [
     "DURATION_BUCKETS",
     "SIZE_BUCKETS",
     "MetricsCollector",
+    "MetricStore",
     "ServiceMetrics",
     "METRICS_START_SKIP_DURATION",
     "METRICS_END_SKIP_DURATION",
     "METRICS_SUMMARY_DURATION",
     "convert_data",
     "fortio_result",
+    "fortio_result_from_summary",
+    "parse_exposition",
     "trim_window_summary",
+    "window_summary_from_summary",
     "write_csv",
 ]

@@ -1,27 +1,25 @@
-"""Alarm assertions over simulated runs.
+"""Alarm assertions over simulated runs — evaluated as Prometheus queries.
 
 The reference's stability gate is ``metrics/check_metrics.py``: a unittest
-suite where each check is a Prometheus ``Query`` paired with an ``Alarm``
+suite where each check is a PromQL ``Query`` paired with an ``Alarm``
 predicate (metrics/prometheus.py:21-29), with standard checks — zero 5xx,
 proxy CPU below 50 milli-cores (250 for the service-graph load test,
 check_metrics.py:61-102,170-174), memory below limits — run against a
-long-lived cluster.
+long-lived cluster's Prometheus.
 
-Here the same Query/Alarm shape evaluates against a simulated run: the
-``query`` field is a callable on a :class:`RunSource` instead of a PromQL
-string, and the standard suite derives its values from the event tensors
-(5xx counts from the metric scatter, CPU from utilization, memory from a
-Little's-law estimate of resident payload buffers).
+Here the same Query/Alarm shape carries a real query *string*, evaluated
+by :class:`~isotope_tpu.metrics.query.MetricStore` against the run's own
+text exposition (the five service series plus the sim-side resource
+series of ``MetricsCollector.resource_text``) — the alarm layer consumes
+exactly what a Prometheus scraper would see, instead of bypassing the
+metrics with Python callables.
 """
 from __future__ import annotations
 
 import collections
 from typing import Callable, List, Sequence
 
-import numpy as np
-
-from isotope_tpu.compiler.program import CompiledGraph
-from isotope_tpu.sim.engine import SimResults
+from isotope_tpu.metrics.query import MetricStore
 
 # Same tuple shapes as the reference (metrics/prometheus.py:21-29).
 Query = collections.namedtuple(
@@ -29,71 +27,24 @@ Query = collections.namedtuple(
 )
 Alarm = collections.namedtuple("Alarm", ["in_alarm", "error_message"])
 
+# check_metrics.py's unit conversions, applied inside the query string
+# exactly like the reference's ``... * %f`` formatting (:73-84)
 CPU_MILLI = 1000.0
 MEM_MB = 1.0 / 2**20
 
 
-class RunSource:
-    """Derived per-run values the standard queries read."""
-
-    def __init__(self, compiled: CompiledGraph, res: SimResults):
-        self.compiled = compiled
-        self.res = res
-        self._sent = np.asarray(res.hop_sent)
-        self._err = np.asarray(res.hop_error)
-        self._lat = np.asarray(res.hop_latency)
-        end = np.asarray(res.client_end)
-        self.duration_s = float(end.max()) if len(end) else 0.0
-
-    # -- canned values -----------------------------------------------------
-
-    def rate_5xx(self) -> float:
-        """Service-level 5xx per second (client-visible or internal)."""
-        if self.duration_s <= 0:
-            return 0.0
-        return float(self._err.sum()) / self.duration_s
-
-    def total_request_rate(self) -> float:
-        if self.duration_s <= 0:
-            return 0.0
-        return float(self._sent.sum()) / self.duration_s
-
-    def max_cpu_cores(self) -> float:
-        """Worst per-service CPU in cores: utilization x replicas."""
-        util = np.asarray(self.res.utilization)
-        reps = self.compiled.services.replicas
-        return float((util * reps).max())
-
-    def max_memory_bytes(self) -> float:
-        """Little's-law resident-buffer estimate, worst service.
-
-        In-flight requests at service s = arrival rate x mean sojourn;
-        each holds its request + response payload.
-        """
-        hop_svc = self.compiled.hop_service
-        S = self.compiled.num_services
-        counts = np.zeros(S)
-        np.add.at(counts, hop_svc, self._sent.sum(0))
-        lat_sum = np.zeros(S)
-        np.add.at(lat_sum, hop_svc, (self._lat * self._sent).sum(0))
-        if self.duration_s <= 0:
-            return 0.0
-        rate = counts / self.duration_s
-        mean_lat = np.where(counts > 0, lat_sum / np.maximum(counts, 1), 0.0)
-        payload = (
-            self.compiled.services.response_size.astype(np.float64)
-            + _mean_request_size(self.compiled)
+def store_from_summary(collector, summary) -> MetricStore:
+    """Build the queryable store for a run: the five service series plus
+    the resource series, parsed back from the text exposition."""
+    if summary.metrics is None:
+        raise ValueError(
+            "summary has no metrics; run with a MetricsCollector"
         )
-        in_flight = rate * mean_lat
-        return float((in_flight * payload).max())
-
-
-def _mean_request_size(compiled: CompiledGraph) -> np.ndarray:
-    sizes = np.zeros(compiled.num_services)
-    counts = np.zeros(compiled.num_services)
-    np.add.at(sizes, compiled.hop_service, compiled.hop_request_size)
-    np.add.at(counts, compiled.hop_service, 1.0)
-    return sizes / np.maximum(counts, 1.0)
+    duration_s = float(summary.end_max)
+    text = collector.to_text(summary.metrics) + collector.resource_text(
+        summary.metrics, summary.utilization, duration_s
+    )
+    return MetricStore.from_text(text, duration_s)
 
 
 def standard_queries(
@@ -101,7 +52,9 @@ def standard_queries(
     cpu_lim: float = 50,
     mem_lim: float = 64,
 ) -> List[Query]:
-    """The reference's standard checks (check_metrics.py:61-102).
+    """The reference's standard checks (check_metrics.py:61-102), phrased
+    against the sim's series the way the reference phrases them against
+    istio/cadvisor series.
 
     ``cpu_lim`` is in milli-cores, ``mem_lim`` in MiB; the service-graph
     load test overrides them to 250/100 (check_metrics.py:170-174).
@@ -109,19 +62,25 @@ def standard_queries(
     return [
         Query(
             f"{label}: 5xx Requests/s",
-            lambda s: s.rate_5xx(),
+            # ≙ sum(rate(istio_requests_total{response_code=~"5.."}[1m]))
+            'sum(rate(service_request_duration_seconds_count'
+            '{code=~"5.."}[1m]))',
             Alarm(lambda r: r > 0, "There were 5xx errors."),
             None,
         ),
         Query(
             f"{label}: Service CPU",
-            lambda s: s.max_cpu_cores() * CPU_MILLI,
+            # ≙ rate(container_cpu_usage_seconds_total{...}[1m]) * 1000
+            "max(sum(rate(service_cpu_usage_seconds_total[1m])) "
+            f"by (service)) * {CPU_MILLI!r}",
             Alarm(lambda c: c > cpu_lim, "Service CPU is unexpectedly high."),
             None,
         ),
         Query(
             f"{label}: Service Memory",
-            lambda s: s.max_memory_bytes() * MEM_MB,
+            # ≙ max(max_over_time(container_memory_usage_bytes[1m])) * MB
+            "max(max_over_time(service_memory_working_set_bytes[1m])) "
+            f"* {MEM_MB!r}",
             Alarm(
                 lambda m: m > mem_lim, "Service memory is unexpectedly high."
             ),
@@ -134,7 +93,7 @@ def requests_sanity(label: str = "sim") -> Query:
     """There must be *some* traffic (check_metrics.py istio_requests_sanity)."""
     return Query(
         f"{label}: Total Requests/s (sanity check)",
-        lambda s: s.total_request_rate(),
+        "sum(rate(service_incoming_requests_total[1m]))",
         Alarm(lambda r: r <= 0, "No requests were recorded."),
         None,
     )
@@ -142,16 +101,23 @@ def requests_sanity(label: str = "sim") -> Query:
 
 def run_queries(
     queries: Sequence[Query],
-    source: RunSource,
+    store: MetricStore,
     debug: bool = False,
     log: Callable[[str], None] = print,
 ) -> List[str]:
-    """Evaluate queries; return alarm messages (prometheus.py:63-71)."""
+    """Evaluate queries; return alarm messages (prometheus.py:63-71).
+
+    A ``running_query`` gates the check: evaluate it first and skip the
+    check when it returns <= 0 — the scenario isn't deployed
+    (check_metrics.py:196-206).
+    """
     errors: List[str] = []
     for q in queries:
-        if q.running_query is not None and not q.running_query(source):
-            continue  # scenario not deployed (check_metrics.py:196-206)
-        value = q.query(source)
+        if q.running_query is not None and (
+            store.query_value(q.running_query) <= 0
+        ):
+            continue
+        value = store.query_value(q.query)
         if q.alarm.in_alarm(value):
             errors.append(f"{q.alarm.error_message} Response: {value}")
         if debug:

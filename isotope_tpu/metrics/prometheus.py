@@ -172,6 +172,63 @@ class MetricsCollector:
 
     # -- host-side exposition ----------------------------------------------
 
+    def resource_text(self, m: ServiceMetrics, utilization,
+                      duration_s: float) -> str:
+        """Render the sim-side resource series — the counterpart of the
+        cadvisor metrics the reference's analysis queries
+        (prom.py:116-126: ``container_cpu_usage_seconds_total``,
+        ``container_memory_usage_bytes``):
+
+        - ``service_cpu_usage_seconds_total``: CPU-seconds consumed per
+          service over the run = utilization x replicas x duration;
+        - ``service_memory_working_set_bytes``: Little's-law resident
+          payload estimate — in-flight requests (arrival rate x mean
+          sojourn) each holding request + response buffers.
+        """
+        names = self.compiled.services.names
+        reps = np.asarray(self.compiled.services.replicas, np.float64)
+        util = np.asarray(utilization, np.float64)
+        cpu_s = util * reps * float(duration_s)
+
+        inc = np.asarray(m.incoming_total, np.float64)
+        lat_sum = np.asarray(m.duration_sum, np.float64).sum(1)
+        rate = inc / duration_s if duration_s > 0 else np.zeros_like(inc)
+        mean_lat = np.where(inc > 0, lat_sum / np.maximum(inc, 1.0), 0.0)
+        # mean request payload arriving at each service (static per hop)
+        req_sum = np.zeros(len(names))
+        req_cnt = np.zeros(len(names))
+        np.add.at(req_sum, self.compiled.hop_service,
+                  self.compiled.hop_request_size)
+        np.add.at(req_cnt, self.compiled.hop_service, 1.0)
+        payload = (
+            self.compiled.services.response_size.astype(np.float64)
+            + req_sum / np.maximum(req_cnt, 1.0)
+        )
+        mem = rate * mean_lat * payload
+
+        out: List[str] = []
+        out.append(
+            "# HELP service_cpu_usage_seconds_total Simulated CPU seconds"
+            " consumed by this service."
+        )
+        out.append("# TYPE service_cpu_usage_seconds_total counter")
+        for s, name in enumerate(names):
+            out.append(
+                f'service_cpu_usage_seconds_total{{service="{name}"}}'
+                f" {cpu_s[s]:.10g}"
+            )
+        out.append(
+            "# HELP service_memory_working_set_bytes Estimated resident"
+            " payload bytes held by in-flight requests."
+        )
+        out.append("# TYPE service_memory_working_set_bytes gauge")
+        for s, name in enumerate(names):
+            out.append(
+                f'service_memory_working_set_bytes{{service="{name}"}}'
+                f" {mem[s]:.10g}"
+            )
+        return "\n".join(out) + "\n"
+
     def to_text(self, m: ServiceMetrics) -> str:
         """Render the Prometheus text exposition format."""
         names = self.compiled.services.names

@@ -1,4 +1,9 @@
-"""Alarm-suite tests (check_metrics.py / metrics/prometheus.py parity)."""
+"""Alarm-suite tests (check_metrics.py / metrics/prometheus.py parity).
+
+The alarms evaluate real query strings against the run's own text
+exposition — the same consumption path a Prometheus scraper + PromQL
+would take against the reference's cluster.
+"""
 import jax
 import pytest
 
@@ -7,36 +12,38 @@ from isotope_tpu.compiler import compile_graph
 from isotope_tpu.metrics.alarms import (
     Alarm,
     Query,
-    RunSource,
     requests_sanity,
     run_queries,
     standard_queries,
+    store_from_summary,
 )
+from isotope_tpu.metrics.prometheus import MetricsCollector
 from isotope_tpu.models.graph import ServiceGraph
 from isotope_tpu.sim import LoadModel, SimParams, Simulator
 
 KEY = jax.random.PRNGKey(2)
 
 
-def source(yaml, qps=100.0, n=5000, **simkw):
+def store(yaml, qps=100.0, n=5000, **simkw):
     compiled = compile_graph(ServiceGraph.from_yaml(yaml))
-    res = Simulator(compiled, SimParams(**simkw)).run(
-        LoadModel(kind="open", qps=qps), n, KEY
+    collector = MetricsCollector(compiled)
+    summary = Simulator(compiled, SimParams(**simkw)).run_summary(
+        LoadModel(kind="open", qps=qps), n, KEY, collector=collector
     )
-    return RunSource(compiled, res)
+    return store_from_summary(collector, summary)
 
 
 CLEAN = "services:\n- name: a\n  isEntrypoint: true\n  responseSize: 1KiB\n"
 
 
 def test_clean_run_passes_standard_queries():
-    s = source(CLEAN)
+    s = store(CLEAN)
     errors = run_queries(standard_queries() + [requests_sanity()], s)
     assert errors == []
 
 
 def test_5xx_alarm_fires_on_error_rate():
-    s = source(
+    s = store(
         "services:\n- name: a\n  isEntrypoint: true\n  errorRate: 10%\n"
     )
     errors = run_queries(standard_queries(), s)
@@ -45,7 +52,7 @@ def test_5xx_alarm_fires_on_error_rate():
 
 def test_cpu_alarm_fires_under_heavy_load():
     # one replica near saturation: ~0.9 cores >> the 50m default limit
-    s = source(CLEAN, qps=0.9 / SimParams().cpu_time_s, n=20000)
+    s = store(CLEAN, qps=0.9 / SimParams().cpu_time_s, n=20000)
     errors = run_queries(standard_queries(), s)
     assert any("CPU" in e for e in errors)
     # the load-test override (250m) still fires at 900m
@@ -56,18 +63,43 @@ def test_cpu_alarm_fires_under_heavy_load():
     assert errors == []
 
 
-def test_memory_estimate_positive_and_bounded():
-    s = source(CLEAN)
-    mem = s.max_memory_bytes()
+def test_memory_gauge_positive_and_bounded():
+    s = store(CLEAN)
+    mem = s.query_value("max(service_memory_working_set_bytes)")
     assert 0 < mem < 1e6  # a few in-flight 1KiB payloads
 
 
+def test_cpu_query_matches_utilization():
+    # 100 qps at ~77us/req => ~7.7 milli-cores
+    s = store(CLEAN)
+    mcores = s.query_value(
+        "max(sum(rate(service_cpu_usage_seconds_total[1m])) "
+        "by (service)) * 1000"
+    )
+    assert mcores == pytest.approx(7.7, rel=0.1)
+
+
+def test_latency_quantile_over_service_histogram():
+    # the reference's prom.py:216-232 consumer shape works against the
+    # sim's service_request_duration_seconds histogram
+    s = store(CLEAN, qps=500.0, n=20000)
+    v = s.query(
+        "histogram_quantile(0.99, sum(rate("
+        "service_request_duration_seconds_bucket[180s])) "
+        "by (service, le)) * 1000"
+    )
+    (p99_ms,) = v.values()
+    # sub-ms service latencies fall in the first 7ms bucket
+    assert 0 < p99_ms <= 7.0
+
+
 def test_running_query_gate_skips():
-    s = source(CLEAN)
+    s = store(CLEAN)
     q = Query(
-        "gated", lambda _: 1.0,
+        "gated",
+        "sum(service_incoming_requests_total)",
         Alarm(lambda v: True, "should be skipped"),
-        lambda _: False,
+        'sum(service_incoming_requests_total{service="not-deployed"})',
     )
     assert run_queries([q], s) == []
 

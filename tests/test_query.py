@@ -1,0 +1,150 @@
+"""Prometheus query layer: parser, evaluator, histogram_quantile.
+
+Mirrors the consumer shapes of the reference's prom.py:92-126 (canned
+CPU/mem aggregations) and :216-232 (histogram_quantile fetcher).
+"""
+import math
+
+import pytest
+
+from isotope_tpu.metrics.query import (
+    MetricStore,
+    QueryError,
+    parse_exposition,
+)
+
+EXPO = """\
+# HELP m_total A counter.
+# TYPE m_total counter
+m_total{service="a",code="200"} 90
+m_total{service="a",code="500"} 10
+m_total{service="b",code="200"} 50
+gauge_bytes{service="a"} 1024
+gauge_bytes{service="b"} 4096
+h_bucket{service="a",le="0.1"} 20
+h_bucket{service="a",le="0.5"} 80
+h_bucket{service="a",le="+Inf"} 100
+"""
+
+STORE = MetricStore.from_text(EXPO, duration_s=10.0)
+
+
+def test_parse_exposition():
+    samples = parse_exposition(EXPO)
+    assert len(samples) == 8
+    assert samples[0].name == "m_total"
+    assert samples[0].labels == {"service": "a", "code": "200"}
+    assert samples[0].value == 90.0
+
+
+def test_parse_rejects_garbage():
+    with pytest.raises(ValueError):
+        parse_exposition("not a metric line at all!")
+    # malformed label pairs must raise, not silently drop
+    with pytest.raises(ValueError):
+        parse_exposition('m{a="x",b=nope} 3')
+
+
+def test_instant_selector_and_matchers():
+    assert STORE.query_value('m_total{service="a",code="200"}') == 90
+    assert STORE.query_value('m_total{service="b"}') == 50
+    # != and regex matchers (fully anchored, like Prometheus)
+    assert STORE.query_value(
+        'sum(m_total{code!="500"})'
+    ) == 140
+    assert STORE.query_value('sum(m_total{code=~"5.."})') == 10
+    assert STORE.query_value('sum(m_total{code!~"5.."})') == 140
+    # no match -> empty vector -> fetch_value semantics: 0
+    assert STORE.query_value('m_total{service="nosuch"}') == 0.0
+
+
+def test_rate_divides_by_run_duration():
+    assert STORE.query_value(
+        'rate(m_total{service="a",code="500"}[1m])'
+    ) == pytest.approx(1.0)
+    # the bracketed window is parsed but the run is the window
+    assert STORE.query_value(
+        'rate(m_total{service="a",code="500"}[5m])'
+    ) == pytest.approx(1.0)
+
+
+def test_sum_by_and_without():
+    v = STORE.query('sum(m_total) by (service)')
+    assert v[(("service", "a"),)] == 100
+    assert v[(("service", "b"),)] == 50
+    w = STORE.query('sum(m_total) without (code)')
+    assert w == v
+    assert STORE.query_value('max(sum(m_total) by (service))') == 100
+    assert STORE.query_value('avg(sum(m_total) by (service))') == 75
+    assert STORE.query_value('count(sum(m_total) by (service))') == 2
+
+
+def test_scalar_arithmetic():
+    assert STORE.query_value(
+        'sum(rate(m_total[1m])) * 1000'
+    ) == pytest.approx(15000.0)
+    assert STORE.query_value(
+        'max(gauge_bytes) * 9.5367431640625e-07'
+    ) == pytest.approx(4096 / 2**20)
+
+
+def test_max_over_time_identity():
+    assert STORE.query_value(
+        'max(max_over_time(gauge_bytes[1m]))'
+    ) == 4096
+
+
+def test_histogram_quantile_interpolates():
+    # 20 <= 0.1, 80 <= 0.5, 100 total.  p50: rank 50 in (0.1, 0.5]:
+    # 0.1 + 0.4 * (50-20)/(80-20) = 0.3
+    got = STORE.query_value(
+        'histogram_quantile(0.5, h_bucket{service="a"})'
+    )
+    assert got == pytest.approx(0.3)
+    # p10 falls in the first bucket: interpolate from 0
+    got = STORE.query_value(
+        'histogram_quantile(0.1, h_bucket{service="a"})'
+    )
+    assert got == pytest.approx(0.1 * 10 / 20)
+    # p99 beyond the last finite bucket: report the last finite bound
+    got = STORE.query_value(
+        'histogram_quantile(0.99, h_bucket{service="a"})'
+    )
+    assert got == pytest.approx(0.5)
+
+
+def test_histogram_quantile_reference_shape():
+    # prom.py:216-232's exact shape:
+    # histogram_quantile(p, sum(rate(m[Ns])) by (g, le)) * 1000
+    v = STORE.query(
+        'histogram_quantile(0.5, sum(rate(h_bucket[180s])) '
+        'by (service, le)) * 1000'
+    )
+    assert v[(("service", "a"),)] == pytest.approx(300.0)
+
+
+def test_query_errors():
+    with pytest.raises(QueryError):
+        STORE.query("nosuchfn(m_total)")
+    with pytest.raises(QueryError):
+        STORE.query("m_total garbage")
+    with pytest.raises(QueryError):
+        STORE.query("m_total * gauge_bytes")  # vector*vector unsupported
+    with pytest.raises(QueryError):
+        # two series -> not a scalar
+        STORE.query_value("sum(m_total) by (service)")
+
+
+def test_histogram_quantile_empty_group_is_nan():
+    s = MetricStore.from_text('e_bucket{le="+Inf"} 0\n', 1.0)
+    assert math.isnan(
+        s.query_value("histogram_quantile(0.9, e_bucket)")
+    )
+
+
+def test_histogram_quantile_single_inf_bucket_is_nan():
+    # Prometheus needs at least one finite bucket + Inf
+    s = MetricStore.from_text('e_bucket{le="+Inf"} 5\n', 1.0)
+    assert math.isnan(
+        s.query_value("histogram_quantile(0.9, e_bucket)")
+    )
