@@ -50,6 +50,14 @@ def register(sub) -> None:
                         "of the full Fortio JSON")
     s.add_argument("--prometheus", metavar="FILE",
                    help="also write the Prometheus text exposition here")
+    s.add_argument("--trace", metavar="FILE",
+                   help="write sampled per-request spans here (the "
+                        "reference's OTel->Jaeger tracing, "
+                        "service/main.go:76-109)")
+    s.add_argument("--trace-format", choices=["chrome", "jaeger"],
+                   default="chrome")
+    s.add_argument("--trace-requests", type=int, default=32,
+                   help="how many requests to trace (sampled dense run)")
     s.set_defaults(func=run_simulate)
 
     k = sub.add_parser(
@@ -145,6 +153,31 @@ def run_simulate(args) -> int:
     if args.prometheus:
         with open(args.prometheus, "w") as f:
             f.write(result.prometheus_text)
+    if args.trace:
+        # traces are sampled: re-run a small dense batch (the load path
+        # keeps only histograms, like the reference's samplers)
+        import jax
+
+        from isotope_tpu.compiler import compile_graph
+        from isotope_tpu.metrics.trace import write_trace
+        from isotope_tpu.models.graph import ServiceGraph
+        from isotope_tpu.sim.engine import Simulator
+
+        # identical model to the main run: same compiled graph shape,
+        # same env-applied params, same load grid (of one), same chaos
+        compiled = compile_graph(ServiceGraph.from_yaml_file(args.topology))
+        sim = Simulator(
+            compiled,
+            config.environments[0].apply(config.sim_params()),
+            config.chaos,
+        )
+        (load,) = config.load_models()
+        res = sim.run(load, args.trace_requests,
+                      jax.random.PRNGKey(args.seed))
+        traced = write_trace(args.trace, compiled, res,
+                             fmt=args.trace_format)
+        print(f"traced {traced} requests -> {args.trace}",
+              file=sys.stderr)
     if result.window.discarded:
         print(
             f"warning: run would be discarded by the collector: "

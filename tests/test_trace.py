@@ -1,0 +1,129 @@
+"""Trace export: parent/child containment, statuses, CLI round trip."""
+import json
+
+import jax
+import numpy as np
+import yaml
+
+from isotope_tpu import cli
+from isotope_tpu.compiler import compile_graph
+from isotope_tpu.metrics.trace import chrome_trace, jaeger_trace
+from isotope_tpu.models.graph import ServiceGraph
+from isotope_tpu.sim import LoadModel, Simulator
+
+TOPO = """
+services:
+- name: entry
+  isEntrypoint: true
+  script:
+  - sleep: 2ms
+  - [{call: left}, {call: right}]
+  - call: tail
+- name: left
+  script: [{call: leaf}]
+- name: right
+- name: tail
+  errorRate: 30%
+- name: leaf
+"""
+
+
+def run(n=24, seed=0):
+    compiled = compile_graph(ServiceGraph.decode(yaml.safe_load(TOPO)))
+    sim = Simulator(compiled)
+    res = sim.run(
+        LoadModel(kind="open", qps=200.0), n, jax.random.PRNGKey(seed)
+    )
+    return compiled, res
+
+
+def test_chrome_trace_containment_and_status():
+    compiled, res = run()
+    doc = chrome_trace(compiled, res)
+    events = doc["traceEvents"]
+    assert events
+    by_req = {}
+    for e in events:
+        by_req.setdefault(e["pid"], {})[e["args"]["hop"]] = e
+    for spans in by_req.values():
+        for e in spans.values():
+            p = e["args"]["parent_hop"]
+            if p < 0:
+                continue
+            parent = spans[p]
+            # child executes inside its caller's span (wire time is
+            # outside the child but inside the parent)
+            assert e["ts"] >= parent["ts"] - 1e-6
+            assert e["ts"] + e["dur"] <= parent["ts"] + parent["dur"] + 1e-6
+    # the flaky 'tail' service produced some 500s across requests
+    statuses = {
+        e["args"]["status"] for e in events if e["name"] == "tail"
+    }
+    assert 500 in statuses and 200 in statuses
+    # depth is the thread id
+    assert {e["tid"] for e in events} == {0, 1, 2}
+
+
+def test_chrome_trace_respects_max_requests():
+    compiled, res = run()
+    doc = chrome_trace(compiled, res, max_requests=5)
+    assert {e["pid"] for e in doc["traceEvents"]} == set(range(5))
+
+
+def test_jaeger_trace_references_resolve():
+    compiled, res = run()
+    doc = jaeger_trace(compiled, res, max_requests=8)
+    assert len(doc["data"]) == 8
+    for trace in doc["data"]:
+        ids = {s["spanID"] for s in trace["spans"]}
+        by_id = {s["spanID"]: s for s in trace["spans"]}
+        roots = 0
+        for s in trace["spans"]:
+            assert s["traceID"] == trace["traceID"]
+            assert s["processID"] in trace["processes"]
+            if not s["references"]:
+                roots += 1
+                continue
+            (ref,) = s["references"]
+            assert ref["refType"] == "CHILD_OF"
+            assert ref["spanID"] in ids
+            parent = by_id[ref["spanID"]]
+            assert s["startTime"] >= parent["startTime"]
+            assert (
+                s["startTime"] + s["duration"]
+                <= parent["startTime"] + parent["duration"]
+            )
+        assert roots == 1  # exactly the entrypoint span
+
+
+def test_unsent_hops_produce_no_spans():
+    compiled, res = run()
+    sent = np.asarray(res.hop_sent)
+    doc = chrome_trace(compiled, res)
+    assert len(doc["traceEvents"]) == int(sent.sum())
+
+
+def test_cli_trace_export(tmp_path, capsys):
+    topo = tmp_path / "t.yaml"
+    topo.write_text(TOPO)
+    out = tmp_path / "trace.json"
+    rc = cli.main(
+        ["simulate", str(topo), "--qps", "100", "--duration", "30s",
+         "--max-requests", "2000", "--flat",
+         "--trace", str(out), "--trace-requests", "8"]
+    )
+    assert rc == 0
+    doc = json.loads(out.read_text())
+    assert {e["pid"] for e in doc["traceEvents"]} == set(range(8))
+    assert "traced 8 requests" in capsys.readouterr().err
+
+    out2 = tmp_path / "trace_jaeger.json"
+    rc = cli.main(
+        ["simulate", str(topo), "--qps", "100", "--duration", "30s",
+         "--max-requests", "2000", "--flat",
+         "--trace", str(out2), "--trace-format", "jaeger",
+         "--trace-requests", "4"]
+    )
+    assert rc == 0
+    doc = json.loads(out2.read_text())
+    assert len(doc["data"]) == 4
