@@ -27,33 +27,81 @@ from isotope_tpu.sim.config import (
 from isotope_tpu.utils import duration as dur
 
 
+# One Envoy traversal, one way — the per-pass tax underlying the
+# baseline-vs-sidecar deltas of the twopods benchmarks
+# (perf/benchmark/README.md's mode comparisons).
+DEFAULT_PROXY_LATENCY_S = 250e-6
+
+
 @dataclasses.dataclass(frozen=True)
 class EnvironmentModel:
-    """How an environment (service mesh flavor) perturbs the data plane."""
+    """How an environment (service mesh flavor) perturbs the data plane.
+
+    Models the reference's 5-way sidecar-mode matrix
+    (perf/benchmark/runner/runner.py:93-99 port table, :178-197
+    mode -> URI) as direction-aware per-edge proxy passes:
+
+    - ``client_proxy``: the *caller's* outbound Envoy on every edge
+      (fortio client included) — the "clientsidecar" mode;
+    - ``server_proxy``: the *callee's* inbound Envoy on every edge —
+      "serversidecar";
+    - both flags -> "both"; neither -> "baseline";
+    - ``gateway``: entry traffic traverses the ingress gateway (an
+      extra Envoy on the client -> entrypoint edge only) — "ingress".
+
+    Each pass adds ``proxy_latency_s`` to the edge's one-way latency in
+    both directions (Envoy sits on the request and response path).
+    ``extra_hop_latency_s`` is a free-form additional per-edge tax for
+    custom environments.
+    """
 
     name: str
-    # extra one-way per-edge latency from traversing client+server sidecars
+    client_proxy: bool = False
+    server_proxy: bool = False
+    gateway: bool = False
+    proxy_latency_s: float = DEFAULT_PROXY_LATENCY_S
+    # extra one-way per-edge latency on top of the proxy passes
     extra_hop_latency_s: float = 0.0
 
     def apply(self, params: SimParams) -> SimParams:
-        if not self.extra_hop_latency_s:
+        passes = int(self.client_proxy) + int(self.server_proxy)
+        extra = self.extra_hop_latency_s + passes * self.proxy_latency_s
+        entry_extra = self.proxy_latency_s if self.gateway else 0.0
+        if not extra and not entry_extra:
             return params
         net = params.network
         return dataclasses.replace(
             params,
             network=NetworkModel(
-                base_latency_s=net.base_latency_s + self.extra_hop_latency_s,
+                base_latency_s=net.base_latency_s + extra,
                 bytes_per_second=net.bytes_per_second,
+                entry_extra_latency_s=(
+                    net.entry_extra_latency_s + entry_extra
+                ),
             ),
         )
 
 
-# Default mesh tax: two Envoy passes per edge, ~0.5ms each way — the
-# ballpark the twopods latency benchmarks attribute to the sidecar path
-# (perf/benchmark/README.md's baseline-vs-both comparisons).
+# The reference's sidecar-mode matrix (runner.py:93-99), plus the
+# NONE/ISTIO pair of isotope's run_tests.py (aliases of baseline/both).
 DEFAULT_ENVIRONMENTS = {
     "NONE": EnvironmentModel(name="NONE"),
-    "ISTIO": EnvironmentModel(name="ISTIO", extra_hop_latency_s=500e-6),
+    "ISTIO": EnvironmentModel(
+        name="ISTIO", client_proxy=True, server_proxy=True
+    ),
+    "baseline": EnvironmentModel(name="baseline"),
+    "clientsidecar": EnvironmentModel(
+        name="clientsidecar", client_proxy=True
+    ),
+    "serversidecar": EnvironmentModel(
+        name="serversidecar", server_proxy=True
+    ),
+    "both": EnvironmentModel(
+        name="both", client_proxy=True, server_proxy=True
+    ),
+    "ingress": EnvironmentModel(
+        name="ingress", server_proxy=True, gateway=True
+    ),
 }
 
 
@@ -115,11 +163,29 @@ def load_toml(path) -> ExperimentConfig:
     for name in doc.get("environments", ["NONE"]):
         if name in env_overrides:
             o = env_overrides[name]
+            base = DEFAULT_ENVIRONMENTS.get(
+                name, EnvironmentModel(name=name)
+            )
             envs.append(
-                EnvironmentModel(
+                dataclasses.replace(
+                    base,
                     name=name,
-                    extra_hop_latency_s=dur.parse_duration_seconds(
-                        o.get("extra_hop_latency", "0s")
+                    client_proxy=bool(
+                        o.get("client_proxy", base.client_proxy)
+                    ),
+                    server_proxy=bool(
+                        o.get("server_proxy", base.server_proxy)
+                    ),
+                    gateway=bool(o.get("gateway", base.gateway)),
+                    proxy_latency_s=(
+                        dur.parse_duration_seconds(o["proxy_latency"])
+                        if "proxy_latency" in o
+                        else base.proxy_latency_s
+                    ),
+                    extra_hop_latency_s=(
+                        dur.parse_duration_seconds(o["extra_hop_latency"])
+                        if "extra_hop_latency" in o
+                        else base.extra_hop_latency_s
                     ),
                 )
             )

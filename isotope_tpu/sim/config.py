@@ -30,13 +30,21 @@ class NetworkModel:
     through optional Envoy sidecars (srv/request.go:30-48); intra-cluster
     one-way latency is typically a few hundred microseconds and payloads
     ride ~10 Gbps NICs.
+
+    ``entry_extra_latency_s`` is additional one-way latency on the
+    client -> entrypoint edge only — the ingress-gateway traversal of
+    the reference's "ingress" sidecar mode (runner.py:96,190-197).
     """
 
     base_latency_s: float = 250e-6
     bytes_per_second: float = 1.25e9  # 10 Gbit/s
+    entry_extra_latency_s: float = 0.0
 
     def one_way(self, size_bytes):
         return self.base_latency_s + size_bytes / self.bytes_per_second
+
+    def entry_one_way(self, size_bytes):
+        return self.one_way(size_bytes) + self.entry_extra_latency_s
 
 
 @dataclasses.dataclass(frozen=True)

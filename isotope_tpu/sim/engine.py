@@ -174,7 +174,12 @@ class Simulator:
         req = compiled.hop_request_size.astype(np.float64)
         net_out = net.base_latency_s + req / net.bytes_per_second
         net_back = net.base_latency_s + resp[hs] / net.bytes_per_second
+        # the client -> entrypoint edge may traverse an ingress gateway
+        net_out[0] += net.entry_extra_latency_s
+        net_back[0] += net.entry_extra_latency_s
         self._root_net = float(net_out[0] + net_back[0])
+        # payload-free entry one-way: root start offset + refused-conn cost
+        self._entry_one_way = net.entry_one_way(0.0)
 
         levels: List[_Level] = []
         offset = 0
@@ -773,7 +778,7 @@ class Simulator:
         # a refused connection to the entry costs one wire round trip
         root_lat = jnp.where(
             root_down,
-            2 * self.params.network.one_way(0.0),
+            2 * self._entry_one_way,
             self._root_net + lat_lvls[0][:, 0],
         )
         if kind == CLOSED_LOOP:
@@ -799,7 +804,7 @@ class Simulator:
 
         # ---- downward pass 2: absolute start times -----------------------
         start_lvls: List[jax.Array] = [
-            (arrivals + self.params.network.one_way(0.0))[:, None]
+            (arrivals + self._entry_one_way)[:, None]
         ]
         for d in range(len(self._levels) - 1):
             lvl = self._levels[d]

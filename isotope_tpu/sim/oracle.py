@@ -113,6 +113,14 @@ class OracleSimulator:
     ):
         self.graph = graph
         self.params = params
+        if params.network.entry_extra_latency_s:
+            # des_oracle.cpp models a uniform per-edge network; the
+            # ingress gateway's entry-edge tax is engine-only for now
+            raise ValueError(
+                "the DES oracle does not model entry_extra_latency_s "
+                "(ingress gateway environments); compare against an "
+                "environment without a gateway"
+            )
         names = tuple(s.name for s in graph.services)
         self.names = names
         idx = {n: i for i, n in enumerate(names)}

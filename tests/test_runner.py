@@ -44,11 +44,13 @@ def test_load_toml_schema(tmp_path):
     assert cfg.connections == (8,)
     assert cfg.duration_s == 120.0
     assert cfg.num_requests == 2000
-    # ISTIO default adds the sidecar latency tax
+    # ISTIO default == "both": two proxy passes of per-edge latency tax
     istio = cfg.environments[1]
-    assert istio.extra_hop_latency_s == pytest.approx(500e-6)
+    assert istio.client_proxy and istio.server_proxy
     base = cfg.sim_params()
-    assert istio.apply(base).network.base_latency_s > base.network.base_latency_s
+    assert istio.apply(base).network.base_latency_s == pytest.approx(
+        base.network.base_latency_s + 500e-6
+    )
 
 
 def test_load_toml_qps_max_and_env_override(tmp_path):

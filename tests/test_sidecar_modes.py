@@ -1,0 +1,123 @@
+"""The 5-way sidecar-mode matrix (runner.py:93-99,178-197 parity)."""
+import jax
+import numpy as np
+import pytest
+import yaml
+
+from isotope_tpu.compiler import compile_graph
+from isotope_tpu.models.graph import ServiceGraph
+from isotope_tpu.runner.config import DEFAULT_ENVIRONMENTS, load_toml
+from isotope_tpu.runner.run import run_experiment
+from isotope_tpu.sim import LoadModel, SimParams, Simulator
+
+CHAIN = """
+services:
+- name: entry
+  isEntrypoint: true
+  script: [{call: mid}]
+- name: mid
+  script: [{call: leaf}]
+- name: leaf
+"""
+
+MODES = ["baseline", "clientsidecar", "serversidecar", "both", "ingress"]
+
+
+def mean_latency(mode: str) -> float:
+    params = DEFAULT_ENVIRONMENTS[mode].apply(
+        SimParams(service_time="deterministic")
+    )
+    sim = Simulator(
+        compile_graph(ServiceGraph.decode(yaml.safe_load(CHAIN))), params
+    )
+    res = sim.run(
+        LoadModel(kind="open", qps=1.0), 64, jax.random.PRNGKey(0)
+    )
+    return float(np.asarray(res.client_latency).mean())
+
+
+def test_mode_latency_ordering():
+    lat = {m: mean_latency(m) for m in MODES}
+    # one-sided sidecars tax every edge equally; both doubles the tax
+    assert lat["baseline"] < lat["clientsidecar"]
+    assert lat["clientsidecar"] == pytest.approx(lat["serversidecar"])
+    assert lat["serversidecar"] < lat["both"]
+    # a 3-hop chain quietly: each one-way pass costs 250us per edge;
+    # 3 edges (client->entry, entry->mid, mid->leaf), out + back
+    per_pass = 2 * 3 * 250e-6
+    assert lat["clientsidecar"] - lat["baseline"] == pytest.approx(
+        per_pass, rel=0.02
+    )
+    assert lat["both"] - lat["baseline"] == pytest.approx(
+        2 * per_pass, rel=0.02
+    )
+    # ingress = server sidecars + one gateway traversal on the entry edge
+    assert lat["ingress"] - lat["serversidecar"] == pytest.approx(
+        2 * 250e-6, rel=0.05
+    )
+
+
+def test_istio_alias_equals_both():
+    assert mean_latency("both") == pytest.approx(mean_latency("ISTIO"))
+
+
+def test_sweep_emits_one_row_per_mode(tmp_path):
+    topo = tmp_path / "chain.yaml"
+    topo.write_text(CHAIN)
+    cfg = tmp_path / "exp.toml"
+    cfg.write_text(
+        f"""
+topology_paths = ["{topo}"]
+environments = ["baseline", "clientsidecar", "serversidecar", "both",
+                "ingress"]
+
+[client]
+qps = [200]
+num_concurrent_connections = [8]
+duration = "120s"
+load_kind = "open"
+
+[sim]
+num_requests = 4000
+seed = 1
+"""
+    )
+    results = run_experiment(load_toml(cfg), out_dir=str(tmp_path / "out"))
+    assert [r.environment for r in results] == MODES
+    rows = (tmp_path / "out" / "benchmark.csv").read_text().splitlines()
+    assert len(rows) == 1 + len(MODES)
+    p50 = {
+        r.environment: r.flat["p50"] for r in results
+    }
+    assert p50["baseline"] < p50["both"]
+    assert p50["serversidecar"] < p50["ingress"]
+
+
+def test_latency_toml_carries_five_modes():
+    import pathlib
+
+    cfg = load_toml(
+        pathlib.Path(__file__).parent.parent / "configs/latency.toml"
+    )
+    assert [e.name for e in cfg.environments] == MODES
+
+
+def test_env_override_can_tune_proxy_latency(tmp_path):
+    topo = tmp_path / "chain.yaml"
+    topo.write_text(CHAIN)
+    cfg = tmp_path / "exp.toml"
+    cfg.write_text(
+        f"""
+topology_paths = ["{topo}"]
+environments = ["both"]
+
+[environment.both]
+proxy_latency = "1ms"
+"""
+    )
+    env = load_toml(cfg).environments[0]
+    assert env.client_proxy and env.server_proxy
+    base = SimParams()
+    assert env.apply(base).network.base_latency_s == pytest.approx(
+        base.network.base_latency_s + 2e-3
+    )
