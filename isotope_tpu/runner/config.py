@@ -163,29 +163,43 @@ def load_toml(path) -> ExperimentConfig:
     for name in doc.get("environments", ["NONE"]):
         if name in env_overrides:
             o = env_overrides[name]
-            base = DEFAULT_ENVIRONMENTS.get(
+            if set(o) == {"extra_hop_latency"}:
+                # legacy knob alone: REPLACES the whole tax (the
+                # pre-matrix semantics), so existing configs that tuned
+                # e.g. ISTIO via extra_hop_latency keep their numbers
+                # instead of silently stacking on the proxy passes
+                envs.append(
+                    EnvironmentModel(
+                        name=name,
+                        extra_hop_latency_s=dur.parse_duration_seconds(
+                            o["extra_hop_latency"]
+                        ),
+                    )
+                )
+                continue
+            default_env = DEFAULT_ENVIRONMENTS.get(
                 name, EnvironmentModel(name=name)
             )
             envs.append(
                 dataclasses.replace(
-                    base,
+                    default_env,
                     name=name,
                     client_proxy=bool(
-                        o.get("client_proxy", base.client_proxy)
+                        o.get("client_proxy", default_env.client_proxy)
                     ),
                     server_proxy=bool(
-                        o.get("server_proxy", base.server_proxy)
+                        o.get("server_proxy", default_env.server_proxy)
                     ),
-                    gateway=bool(o.get("gateway", base.gateway)),
+                    gateway=bool(o.get("gateway", default_env.gateway)),
                     proxy_latency_s=(
                         dur.parse_duration_seconds(o["proxy_latency"])
                         if "proxy_latency" in o
-                        else base.proxy_latency_s
+                        else default_env.proxy_latency_s
                     ),
                     extra_hop_latency_s=(
                         dur.parse_duration_seconds(o["extra_hop_latency"])
                         if "extra_hop_latency" in o
-                        else base.extra_hop_latency_s
+                        else default_env.extra_hop_latency_s
                     ),
                 )
             )

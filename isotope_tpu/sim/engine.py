@@ -185,20 +185,28 @@ class Simulator:
         offset = 0
         for lvl in compiled.levels:
             cids = lvl.child_ids
+            # Per-level step width: the compiler encodes segments with the
+            # GLOBAL max_steps stride, but a level only needs the widest
+            # script among ITS services — on skewed graphs (one huge
+            # fan-out service, thousands of leaves) the global width
+            # wastes multiples of the step-tensor footprint.
+            pmax = max(int(lvl.step_is_real.sum(1).max(initial=0)), 1)
+            parent_local = lvl.child_seg // compiled.max_steps
+            child_step = lvl.child_seg % compiled.max_steps
+            call_local = lvl.call_seg // compiled.max_steps
+            call_step = lvl.call_seg % compiled.max_steps
             levels.append(
                 _Level(
                     offset=offset,
                     size=lvl.num_hops,
-                    pmax=compiled.max_steps,
-                    step_mask=jnp.asarray(lvl.step_is_real, jnp.float32),
-                    step_base=jnp.asarray(lvl.step_base),
-                    child_seg=jnp.asarray(lvl.child_seg),
-                    child_parent_local=jnp.asarray(
-                        lvl.child_seg // compiled.max_steps
+                    pmax=pmax,
+                    step_mask=jnp.asarray(
+                        lvl.step_is_real[:, :pmax], jnp.float32
                     ),
-                    child_step=jnp.asarray(
-                        lvl.child_seg % compiled.max_steps
-                    ),
+                    step_base=jnp.asarray(lvl.step_base[:, :pmax]),
+                    child_seg=jnp.asarray(parent_local * pmax + child_step),
+                    child_parent_local=jnp.asarray(parent_local),
+                    child_step=jnp.asarray(child_step),
                     child_rtt=jnp.asarray(
                         (net_out[cids] + net_back[cids]), jnp.float32
                     ),
@@ -206,8 +214,8 @@ class Simulator:
                     child_send_prob=jnp.asarray(
                         compiled.hop_send_prob[cids]
                     ),
-                    call_seg=jnp.asarray(lvl.call_seg),
-                    call_step=jnp.asarray(lvl.call_step),
+                    call_seg=jnp.asarray(call_local * pmax + call_step),
+                    call_step=jnp.asarray(call_step),
                     call_timeout=jnp.asarray(lvl.call_timeout),
                     att_child=lvl.att_child,
                     att_valid=lvl.att_valid,
@@ -552,7 +560,6 @@ class Simulator:
         form one continuous timeline; returns ``(results, t_end,
         conn_end)`` for the next block's carry."""
         H = self.compiled.num_hops
-        Pmax = self.compiled.max_steps
         if self._copula_active:
             (k_send, k_err, k_wait_u, k_svc, k_arr,
              k_wait2) = jax.random.split(key, 6)
@@ -712,41 +719,43 @@ class Simulator:
                     used_a = use & failed_a
                 used_lvls[d] = used[:, :C]
 
+                P = lvl.pmax
                 agg = (
-                    jnp.zeros((n, lvl.size * Pmax))
+                    jnp.zeros((n, lvl.size * P))
                     .at[:, lvl.call_seg]
                     .max(dur_call)
-                    .reshape(n, lvl.size, Pmax)
+                    .reshape(n, lvl.size, P)
                 )
                 step_dur = jnp.maximum(lvl.step_base, agg) * lvl.step_mask
                 # the call's coin gates the failure too: an unsent call
                 # cannot fail anything (used_a starts from coin)
                 fail_contrib = jnp.where(
-                    final_transport, lvl.call_step, Pmax
+                    final_transport, lvl.call_step, P
                 ).astype(jnp.int32)
                 fail_step = (
-                    jnp.full((n, lvl.size), Pmax, jnp.int32)
-                    .at[:, lvl.call_seg // Pmax]
+                    jnp.full((n, lvl.size), P, jnp.int32)
+                    .at[:, lvl.call_seg // P]
                     .min(fail_contrib)
                 )
             else:
+                P = lvl.pmax
                 step_dur = (
-                    jnp.broadcast_to(lvl.step_base, (n, lvl.size, Pmax))
+                    jnp.broadcast_to(lvl.step_base, (n, lvl.size, P))
                     * lvl.step_mask
                 )
-                fail_step = jnp.full((n, lvl.size), Pmax, jnp.int32)
+                fail_step = jnp.full((n, lvl.size), P, jnp.int32)
             fail_lvls[d] = fail_step
             # executed-step mask: errorRate 500s skip the whole script;
             # transport errors truncate it after the failing step
             executed = (
-                jnp.arange(Pmax, dtype=jnp.int32) <= fail_step[:, :, None]
+                jnp.arange(P, dtype=jnp.int32) <= fail_step[:, :, None]
             ) & ~err_coin[:, sl][:, :, None]
             step_dur = step_dur * executed
             busy = step_dur.sum(-1)
             lat_lvls[d] = wait[:, sl] + svc_time[:, sl] + busy
             # this hop's own response status: 500 iff errorRate coin or a
             # transport-failed step
-            err_lvls[d] = err_coin[:, sl] | (fail_step < Pmax)
+            err_lvls[d] = err_coin[:, sl] | (fail_step < P)
             if lvl.num_children > 0:
                 prefix = jnp.cumsum(step_dur, axis=-1) - step_dur
                 off_lvls[d] = (

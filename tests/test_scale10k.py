@@ -1,0 +1,37 @@
+"""BASELINE configs[3]: the 10,000-service realistic path compiles and
+runs (CPU-sized request counts; the TPU rate is measured by bench.py)."""
+import jax
+import pytest
+
+from isotope_tpu.compiler import compile_graph
+from isotope_tpu.models.generators import realistic_topology
+from isotope_tpu.models.graph import ServiceGraph
+from isotope_tpu.sim import LoadModel, Simulator
+
+
+@pytest.fixture(scope="module")
+def compiled10k():
+    doc = realistic_topology(10_000, archetype="multitier", seed=0)
+    return compile_graph(ServiceGraph.decode(doc))
+
+
+def test_10k_compile_shape(compiled10k):
+    # BA(m=1) graphs are trees: one hop per service, no unroll blowup
+    assert compiled10k.num_services == 10_000
+    assert compiled10k.num_hops == 10_000
+    assert len(compiled10k.levels) < 40
+
+
+def test_10k_simulates_through_scan_path(compiled10k):
+    sim = Simulator(compiled10k)
+    s = sim.run_summary(
+        LoadModel(kind="open", qps=1000.0), 64, jax.random.PRNGKey(0),
+        block_size=32,
+    )
+    assert float(s.count) == 64
+    # every request traverses the whole tree (no probability/errors)
+    assert float(s.hop_events) == 64 * 10_000
+    # deep sequential scripts: one request sweeps all 10k services, so
+    # client latency is thousands of network+service legs
+    assert 1.0 < s.mean_latency_s < 30.0
+    assert not bool(s.unstable.any())
