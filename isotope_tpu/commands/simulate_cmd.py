@@ -84,6 +84,9 @@ def register(sub) -> None:
     w.add_argument("config", help="experiment TOML (example-config.toml shape)")
     w.add_argument("--out", "-o", default="results",
                    help="output directory (default: ./results)")
+    w.add_argument("--fresh", action="store_true",
+                   help="ignore an existing checkpoint and rerun "
+                        "everything (default: resume a killed sweep)")
     w.set_defaults(func=run_sweep)
 
     p = sub.add_parser(
@@ -267,6 +270,7 @@ def run_sweep(args) -> int:
         config,
         out_dir=args.out,
         progress=lambda label: print(f"running {label}", file=sys.stderr),
+        resume=not args.fresh,
     )
     discarded = [r.label for r in results if r.window.discarded]
     print(

@@ -4,6 +4,16 @@ Mirrors the shape of the reference's drivers (run_tests.py:35-44 outer
 product; runner.py:522-525 conn x qps grid; fortio.py artifact formats)
 with compilation replacing deployment and simulation replacing ``kubectl
 exec fortio load``.
+
+Checkpoint/resume: every completed run appends one line to
+``<out>/checkpoint.jsonl`` (after a header binding the config), and its
+per-run artifacts are written immediately.  A killed sweep re-invoked
+with the same config skips the completed prefix — the run key is
+``fold_in(seed_key, run_index)``, so the resumed tail draws the exact
+streams the uninterrupted sweep would have, and the final benchmark.csv
+is identical except the wall-clock StartTime column.  The reference's durability analogue: Prometheus on a
+persistent disk + raw Fortio JSONs copied off-pod
+(isotope/README.md:313-323; run_benchmark_job.sh exit handler).
 """
 from __future__ import annotations
 
@@ -55,10 +65,116 @@ def _num_requests(load: LoadModel, capacity: float, cap: int) -> int:
     return max(1, min(int(rate * load.duration_s), cap))
 
 
+class _LazyTopology:
+    """Compile a topology (and build its simulators) only if some run of
+    it actually executes — a fully-resumed topology costs nothing."""
+
+    def __init__(self, topo_path: str, config: ExperimentConfig,
+                 mesh_data: int, mesh_svc: int):
+        self.path = topo_path
+        self.config = config
+        self.mesh_data = mesh_data
+        self.mesh_svc = mesh_svc
+        self._compiled = None
+        self._collector = None
+        self._entry_resp = 0.0
+        self._sims = {}
+
+    @property
+    def compiled(self):
+        if self._compiled is None:
+            graph = ServiceGraph.from_yaml_file(self.path)
+            eps = graph.entrypoints()
+            self._entry_resp = (
+                float(int(eps[0].response_size)) if eps else 0.0
+            )
+            self._compiled = compile_graph(graph)
+            self._collector = MetricsCollector(self._compiled)
+        return self._compiled
+
+    @property
+    def collector(self):
+        self.compiled
+        return self._collector
+
+    @property
+    def entry_response_size(self) -> float:
+        self.compiled
+        return self._entry_resp
+
+    def sims(self, env):
+        """(Simulator, ShardedSimulator | None) for an environment."""
+        if env.name not in self._sims:
+            params = env.apply(self.config.sim_params())
+            sim = Simulator(self.compiled, params, self.config.chaos)
+            use_mesh = self.mesh_data * self.mesh_svc > 1
+            sharded = (
+                ShardedSimulator(
+                    self.compiled,
+                    make_mesh(self.mesh_data, self.mesh_svc),
+                    params,
+                    self.config.chaos,
+                )
+                if use_mesh
+                else None
+            )
+            self._sims[env.name] = (sim, sharded)
+        return self._sims[env.name]
+
+
+def _config_fingerprint(config: ExperimentConfig) -> str:
+    return repr(config)
+
+
+def _load_checkpoint(path: pathlib.Path, fingerprint: str) -> List[dict]:
+    """Completed-run records, or [] when absent/config-mismatched."""
+    if not path.exists():
+        return []
+    lines = path.read_text().splitlines()
+    if not lines:
+        return []
+    try:
+        header = json.loads(lines[0])
+    except json.JSONDecodeError:
+        return []
+    if header.get("config") != fingerprint:
+        return []
+    records = []
+    for line in lines[1:]:
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            rec = json.loads(line)
+        except json.JSONDecodeError:
+            # a kill mid-write leaves a truncated tail line: that run's
+            # record is lost, so resume re-executes it (and stops
+            # trusting anything after the corruption point)
+            break
+        records.append(rec)
+    return records
+
+
+def _restore_result(rec: dict, out: pathlib.Path) -> RunResult:
+    prom_path = out / f"{rec['label']}.prom"
+    return RunResult(
+        label=rec["label"],
+        topology=rec["topology"],
+        environment=rec["environment"],
+        flat=rec["flat"],
+        window=WindowSummary(**rec["window"]),
+        fortio_json=rec["fortio_json"],
+        prometheus_text=(
+            prom_path.read_text() if prom_path.exists() else ""
+        ),
+    )
+
+
 def run_experiment(
     config: ExperimentConfig,
     out_dir: Optional[str] = None,
     progress=None,
+    resume: bool = True,
 ) -> List[RunResult]:
     results: List[RunResult] = []
     key = jax.random.PRNGKey(config.seed)
@@ -68,76 +184,85 @@ def run_experiment(
         if config.mesh_data > 0
         else max(jax.device_count() // mesh_svc, 1)
     )
-    use_mesh = mesh_data * mesh_svc > 1
 
-    for topo_path in config.topology_paths:
-        graph = ServiceGraph.from_yaml_file(topo_path)
-        topo_yaml_entry = graph.entrypoints()
-        entry_resp = (
-            float(int(topo_yaml_entry[0].response_size))
-            if topo_yaml_entry
-            else 0.0
-        )
-        compiled = compile_graph(graph)
-        collector = MetricsCollector(compiled)
-        for env in config.environments:
-            params = env.apply(config.sim_params())
-            sim = Simulator(compiled, params, config.chaos)
-            sharded = (
-                ShardedSimulator(
-                    compiled,
-                    make_mesh(mesh_data, mesh_svc),
-                    params,
-                    config.chaos,
-                )
-                if use_mesh
-                else None
-            )
-            for i, load in enumerate(config.load_models()):
-                label = _label(topo_path, env.name, load, config.labels)
-                if progress:
-                    progress(label)
-                run_key = jax.random.fold_in(key, len(results))
-                n = _num_requests(
-                    load, sim.capacity_qps(), config.num_requests
-                )
-                # the scan path is the product path: requests stream
-                # through HBM-bounded blocks, metrics and the trim window
-                # accumulate on device — 1M-request runs fit on one chip
-                block = sim.default_block_size()
-                use_sharded = sharded is not None and (
-                    load.kind == OPEN_LOOP
-                    or load.connections % sharded.n_shards == 0
-                )
-                if use_sharded:
-                    summary = sharded.run(
-                        load, n, run_key, block_size=block, trim=True
+    out = ckpt_path = ckpt_file = None
+    done_records: List[dict] = []
+    fingerprint = _config_fingerprint(config)
+    if out_dir is not None:
+        out = pathlib.Path(out_dir)
+        out.mkdir(parents=True, exist_ok=True)
+        ckpt_path = out / "checkpoint.jsonl"
+        if resume:
+            done_records = _load_checkpoint(ckpt_path, fingerprint)
+        # rewrite the file from the parsed records: drops any truncated
+        # tail a kill left behind and guarantees appends start on a
+        # fresh line
+        ckpt_file = open(ckpt_path, "w")
+        ckpt_file.write(json.dumps({"config": fingerprint}) + "\n")
+        for rec in done_records:
+            ckpt_file.write(json.dumps(rec) + "\n")
+        ckpt_file.flush()
+
+    try:
+        run_index = 0
+        for topo_path in config.topology_paths:
+            topo = _LazyTopology(topo_path, config, mesh_data, mesh_svc)
+            for env in config.environments:
+                for load in config.load_models():
+                    label = _label(topo_path, env.name, load, config.labels)
+                    if run_index < len(done_records):
+                        rec = done_records[run_index]
+                        if rec["label"] != label:
+                            raise ValueError(
+                                f"checkpoint out of order: run {run_index}"
+                                f" is {rec['label']!r}, expected {label!r}"
+                            )
+                        results.append(_restore_result(rec, out))
+                        run_index += 1
+                        continue
+                    if progress:
+                        progress(label)
+                    run_key = jax.random.fold_in(key, run_index)
+                    sim, sharded = topo.sims(env)
+                    n = _num_requests(
+                        load, sim.capacity_qps(), config.num_requests
                     )
-                else:
-                    summary = sim.run_summary(
-                        load, n, run_key, block_size=block,
-                        collector=collector, trim=True,
+                    # the scan path is the product path: requests stream
+                    # through HBM-bounded blocks, metrics and the trim
+                    # window accumulate on device
+                    block = sim.default_block_size()
+                    use_sharded = sharded is not None and (
+                        load.kind == OPEN_LOOP
+                        or load.connections % sharded.n_shards == 0
                     )
-                doc = fortio_result_from_summary(
-                    summary, load, labels=label,
-                    response_size_bytes=entry_resp,
-                )
-                flat = convert_data(doc)
-                window = window_summary_from_summary(
-                    summary,
-                    service_names=compiled.services.names,
-                    replicas=compiled.services.replicas,
-                )
-                flat["windowDiscarded"] = window.discarded
-                flat.update(
-                    {
-                        "cpu_cores_" + name: round(v, 4)
-                        for name, v in window.cpu_cores.items()
-                    }
-                )
-                prom_text = collector.to_text(summary.metrics)
-                results.append(
-                    RunResult(
+                    if use_sharded:
+                        summary = sharded.run(
+                            load, n, run_key, block_size=block, trim=True
+                        )
+                    else:
+                        summary = sim.run_summary(
+                            load, n, run_key, block_size=block,
+                            collector=topo.collector, trim=True,
+                        )
+                    doc = fortio_result_from_summary(
+                        summary, load, labels=label,
+                        response_size_bytes=topo.entry_response_size,
+                    )
+                    flat = convert_data(doc)
+                    window = window_summary_from_summary(
+                        summary,
+                        service_names=topo.compiled.services.names,
+                        replicas=topo.compiled.services.replicas,
+                    )
+                    flat["windowDiscarded"] = window.discarded
+                    flat.update(
+                        {
+                            "cpu_cores_" + name: round(v, 4)
+                            for name, v in window.cpu_cores.items()
+                        }
+                    )
+                    prom_text = topo.collector.to_text(summary.metrics)
+                    result = RunResult(
                         label=label,
                         topology=topo_path,
                         environment=env.name,
@@ -146,18 +271,36 @@ def run_experiment(
                         fortio_json=doc,
                         prometheus_text=prom_text,
                     )
-                )
+                    results.append(result)
+                    if out is not None:
+                        # per-run artifacts + checkpoint line land NOW,
+                        # so a kill loses at most the in-flight run
+                        with open(out / f"{label}.json", "w") as f:
+                            json.dump(doc, f, indent=2)
+                        (out / f"{label}.prom").write_text(prom_text)
+                        ckpt_file.write(
+                            json.dumps(
+                                {
+                                    "label": label,
+                                    "topology": topo_path,
+                                    "environment": env.name,
+                                    "flat": flat,
+                                    "window": dataclasses.asdict(window),
+                                    "fortio_json": doc,
+                                }
+                            )
+                            + "\n"
+                        )
+                        ckpt_file.flush()
+                    run_index += 1
+    finally:
+        if ckpt_file is not None:
+            ckpt_file.close()
 
-    if out_dir is not None:
-        out = pathlib.Path(out_dir)
-        out.mkdir(parents=True, exist_ok=True)
+    if out is not None:
         with open(out / "results.jsonl", "w") as f:
             for r in results:
                 f.write(json.dumps(r.flat) + "\n")
-        for r in results:
-            with open(out / f"{r.label}.json", "w") as f:
-                json.dump(r.fortio_json, f, indent=2)
-            (out / f"{r.label}.prom").write_text(r.prometheus_text)
         # the per-service cpu_cores_<svc> columns are record-dependent;
         # append them so `plot --metrics cpu_cores_<svc>` works off this CSV
         extra_keys = sorted(

@@ -1,0 +1,162 @@
+"""Sweep checkpoint/resume: a killed sweep resumes to an identical
+benchmark.csv (SURVEY.md §5.4; the reference's durability is a
+persistent-disk Prometheus + off-pod Fortio JSONs)."""
+import json
+import pathlib
+
+import pytest
+
+from isotope_tpu import cli
+from isotope_tpu.runner import load_toml, run_experiment
+
+TOPO = pathlib.Path(__file__).parent.parent / "examples/topologies/canonical.yaml"
+
+
+def config(tmp_path):
+    cfg = tmp_path / "exp.toml"
+    cfg.write_text(
+        f"""
+topology_paths = ["{TOPO}"]
+environments = ["NONE", "ISTIO"]
+
+[client]
+qps = [200, 400]
+num_concurrent_connections = [8]
+duration = "60s"
+load_kind = "open"
+
+[sim]
+num_requests = 3000
+seed = 11
+"""
+    )
+    return load_toml(cfg)
+
+
+class Kill(Exception):
+    pass
+
+
+def test_kill_and_resume_identical_csv(tmp_path):
+    cfg = config(tmp_path)
+
+    # ground truth: one uninterrupted sweep
+    full_dir = tmp_path / "full"
+    run_experiment(cfg, out_dir=str(full_dir))
+    want_csv = (full_dir / "benchmark.csv").read_text()
+
+    # killed after 2 of 4 runs
+    resumed_dir = tmp_path / "resumed"
+    count = 0
+
+    def killer(label):
+        nonlocal count
+        count += 1
+        if count > 2:
+            raise Kill(label)
+
+    with pytest.raises(Kill):
+        run_experiment(cfg, out_dir=str(resumed_dir), progress=killer)
+    ckpt = (resumed_dir / "checkpoint.jsonl").read_text().splitlines()
+    assert len(ckpt) == 1 + 2  # header + the 2 completed runs
+
+    # resume: only the remaining 2 runs execute
+    ran = []
+    results = run_experiment(
+        cfg, out_dir=str(resumed_dir), progress=ran.append
+    )
+    assert len(ran) == 2
+    assert len(results) == 4
+    got_csv = (resumed_dir / "benchmark.csv").read_text()
+    # identical rows except the wall-clock StartTime column
+    for want, got in zip(want_csv.splitlines(), got_csv.splitlines()):
+        w = want.split(",")
+        g = got.split(",")
+        del w[1], g[1]  # StartTime
+        assert w == g
+
+
+def test_config_change_invalidates_checkpoint(tmp_path):
+    cfg = config(tmp_path)
+    out = tmp_path / "out"
+    run_experiment(cfg, out_dir=str(out))
+
+    cfg2 = config(tmp_path)
+    cfg2 = cfg2.__class__(**{**cfg2.__dict__, "seed": 12})
+    ran = []
+    run_experiment(cfg2, out_dir=str(out), progress=ran.append)
+    assert len(ran) == 4  # everything reruns
+
+
+def test_completed_sweep_replays_for_free(tmp_path):
+    cfg = config(tmp_path)
+    out = tmp_path / "out"
+    run_experiment(cfg, out_dir=str(out))
+    ran = []
+    results = run_experiment(cfg, out_dir=str(out), progress=ran.append)
+    assert ran == []
+    assert len(results) == 4
+    # restored results carry their persisted prometheus text
+    assert all(r.prometheus_text for r in results)
+
+
+def test_cli_fresh_flag_reruns(tmp_path, capsys):
+    cfg_path = tmp_path / "exp.toml"
+    cfg_path.write_text(
+        f"""
+topology_paths = ["{TOPO}"]
+environments = ["NONE"]
+
+[client]
+qps = [100]
+num_concurrent_connections = [4]
+duration = "30s"
+load_kind = "open"
+
+[sim]
+num_requests = 1000
+"""
+    )
+    out = tmp_path / "o"
+    assert cli.main(["sweep", str(cfg_path), "-o", str(out)]) == 0
+    capsys.readouterr()
+    # resume: nothing runs
+    assert cli.main(["sweep", str(cfg_path), "-o", str(out)]) == 0
+    assert "running" not in capsys.readouterr().err
+    # fresh: run again
+    assert cli.main(
+        ["sweep", str(cfg_path), "-o", str(out), "--fresh"]
+    ) == 0
+    assert "running" in capsys.readouterr().err
+
+
+def test_truncated_tail_record_is_tolerated(tmp_path):
+    # a SIGKILL mid-append leaves a partial final line; resume must
+    # treat it as the lost in-flight run, not crash
+    cfg = config(tmp_path)
+    out = tmp_path / "out"
+    run_experiment(cfg, out_dir=str(out))
+    ckpt = out / "checkpoint.jsonl"
+    lines = ckpt.read_text().splitlines()
+    ckpt.write_text(
+        "\n".join(lines[:-1]) + "\n" + lines[-1][: len(lines[-1]) // 2]
+    )
+    ran = []
+    results = run_experiment(cfg, out_dir=str(out), progress=ran.append)
+    assert len(ran) == 1  # only the truncated run re-executes
+    assert len(results) == 4
+
+
+def test_checkpoint_records_are_wellformed(tmp_path):
+    cfg = config(tmp_path)
+    out = tmp_path / "out"
+    run_experiment(cfg, out_dir=str(out))
+    lines = (out / "checkpoint.jsonl").read_text().splitlines()
+    header = json.loads(lines[0])
+    assert "config" in header
+    for line in lines[1:]:
+        rec = json.loads(line)
+        assert {"label", "topology", "environment", "flat", "window",
+                "fortio_json"} <= set(rec)
+        assert (out / f"{rec['label']}.prom").exists()
+        assert (out / f"{rec['label']}.json").exists()
