@@ -3,10 +3,11 @@ from __future__ import annotations
 
 
 def register_all(sub) -> None:
-    from isotope_tpu.commands import convert_cmd, generate_cmd
+    from isotope_tpu.commands import convert_cmd, generate_cmd, report_cmd
 
     convert_cmd.register(sub)
     generate_cmd.register(sub)
+    report_cmd.register(sub)
     # simulate_cmd defers its jax-dependent imports into the handlers (so
     # --help stays instant); a jax-less environment gets a clean error at
     # run time from _require_jax, not a hidden subcommand.

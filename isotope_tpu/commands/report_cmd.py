@@ -1,0 +1,34 @@
+"""``isotope-tpu report`` — dashboard-lite over sweep artifacts.
+
+The one-file replacement for the reference's Django dashboard
+(perf_dashboard/benchmarks/views.py): latency/CPU/error charts per
+series, the full results table, and a run-vs-run regression view when a
+baseline sweep directory is given.
+"""
+from __future__ import annotations
+
+import sys
+
+
+def register(sub) -> None:
+    r = sub.add_parser(
+        "report",
+        help="render a sweep's results.jsonl as a static HTML report",
+    )
+    r.add_argument("results", help="sweep output directory")
+    r.add_argument("--baseline", metavar="DIR",
+                   help="another sweep to diff against (regression view)")
+    r.add_argument("--title", default=None)
+    r.add_argument("-o", "--output", default="report.html")
+    r.set_defaults(func=run_report)
+
+
+def run_report(args) -> int:
+    from isotope_tpu.report import write_report
+
+    count = write_report(
+        args.results, args.output,
+        baseline_dir=args.baseline, title=args.title,
+    )
+    print(f"{count} runs -> {args.output}", file=sys.stderr)
+    return 0

@@ -18,7 +18,9 @@ persistent disk + raw Fortio JSONs copied off-pod
 from __future__ import annotations
 
 import dataclasses
+import hashlib
 import json
+import os
 import pathlib
 from typing import List, Optional
 
@@ -123,7 +125,16 @@ class _LazyTopology:
 
 
 def _config_fingerprint(config: ExperimentConfig) -> str:
-    return repr(config)
+    """Config identity for resume: the dataclass repr plus a hash of
+    each topology file's bytes — editing a topology YAML must
+    invalidate the checkpoint, not silently replay stale results."""
+    h = hashlib.sha256()
+    for p in config.topology_paths:
+        try:
+            h.update(pathlib.Path(p).read_bytes())
+        except OSError:
+            h.update(b"<missing>")
+    return f"{config!r}#topos={h.hexdigest()[:16]}"
 
 
 def _load_checkpoint(path: pathlib.Path, fingerprint: str) -> List[dict]:
@@ -194,14 +205,18 @@ def run_experiment(
         ckpt_path = out / "checkpoint.jsonl"
         if resume:
             done_records = _load_checkpoint(ckpt_path, fingerprint)
-        # rewrite the file from the parsed records: drops any truncated
-        # tail a kill left behind and guarantees appends start on a
-        # fresh line
-        ckpt_file = open(ckpt_path, "w")
-        ckpt_file.write(json.dumps({"config": fingerprint}) + "\n")
-        for rec in done_records:
-            ckpt_file.write(json.dumps(rec) + "\n")
-        ckpt_file.flush()
+        # rewrite via temp + atomic rename: drops any truncated tail a
+        # kill left behind, guarantees appends start on a fresh line,
+        # and a kill during the rewrite itself cannot lose the old file
+        tmp_path = out / "checkpoint.jsonl.tmp"
+        with open(tmp_path, "w") as tmp:
+            tmp.write(json.dumps({"config": fingerprint}) + "\n")
+            for rec in done_records:
+                tmp.write(json.dumps(rec) + "\n")
+            tmp.flush()
+            os.fsync(tmp.fileno())
+        os.replace(tmp_path, ckpt_path)
+        ckpt_file = open(ckpt_path, "a")
 
     try:
         run_index = 0

@@ -88,6 +88,23 @@ def test_config_change_invalidates_checkpoint(tmp_path):
     assert len(ran) == 4  # everything reruns
 
 
+def test_topology_edit_invalidates_checkpoint(tmp_path):
+    # same config object, but the YAML the paths point at changed:
+    # resuming stale results would silently simulate the old graph
+    topo = tmp_path / "t.yaml"
+    topo.write_text(TOPO.read_text())
+    cfg = config(tmp_path)
+    cfg = cfg.__class__(**{**cfg.__dict__,
+                           "topology_paths": (str(topo),)})
+    out = tmp_path / "out"
+    run_experiment(cfg, out_dir=str(out))
+
+    topo.write_text(TOPO.read_text() + "- name: extra\n")
+    ran = []
+    run_experiment(cfg, out_dir=str(out), progress=ran.append)
+    assert len(ran) == 4  # checkpoint invalidated, everything reruns
+
+
 def test_completed_sweep_replays_for_free(tmp_path):
     cfg = config(tmp_path)
     out = tmp_path / "out"

@@ -1,0 +1,145 @@
+"""Dashboard-lite report tests: renders from a sweep's results.jsonl,
+regression deltas, chart/table structure."""
+import json
+import pathlib
+import re
+
+import pytest
+
+from isotope_tpu import cli
+from isotope_tpu.report import (
+    build_report,
+    regression_rows,
+    svg_line_chart,
+    write_report,
+)
+
+
+def fake_sweep(tmp_path, name, p99s, qps=1000):
+    out = tmp_path / name
+    out.mkdir()
+    rows = []
+    for env, per_env in p99s.items():
+        for conns, p99 in per_env:
+            rows.append(
+                {
+                    "Labels": f"topo_{env}_{qps}qps_{conns}c",
+                    "StartTime": "2026-07-30T00:00:00+00:00",
+                    "RequestedQPS": qps,
+                    "ActualQPS": qps,
+                    "NumThreads": conns,
+                    "RunType": "HTTP",
+                    "ActualDuration": 240,
+                    "min": 2000,
+                    "max": 9000,
+                    "p50": p99 // 2,
+                    "p75": int(p99 * 0.6),
+                    "p90": int(p99 * 0.8),
+                    "p99": p99,
+                    "p999": int(p99 * 1.1),
+                    "errorPercent": 0.0,
+                    "windowDiscarded": False,
+                    "cpu_cores_a": 0.1,
+                    "cpu_cores_b": 0.2,
+                }
+            )
+    with open(out / "results.jsonl", "w") as f:
+        for r in rows:
+            f.write(json.dumps(r) + "\n")
+    return out
+
+
+SWEEP = {
+    "baseline": [(2, 3000), (16, 3200), (64, 3600)],
+    "both": [(2, 4200), (16, 4500), (64, 5100)],
+}
+
+
+def test_report_renders_charts_and_table(tmp_path):
+    d = fake_sweep(tmp_path, "run1", SWEEP)
+    out = tmp_path / "report.html"
+    n = write_report(d, out)
+    assert n == 6
+    doc = out.read_text()
+    assert doc.startswith("<!doctype html>")
+    # charts: p50, p99, errors, cpu — each an svg
+    assert doc.count("<svg") == 4
+    assert "p99 vs connections" in doc
+    assert "total service CPU vs connections" in doc
+    # legend with both series, fixed slot colors in CSS
+    assert "topo_baseline" in doc and "topo_both" in doc
+    assert "#2a78d6" in doc and "#3987e5" in doc  # light + dark steps
+    # table row per run
+    assert doc.count("<tr") >= 7
+    # native hover tooltips on the data points
+    assert "<title>" in doc
+
+
+def test_regression_view_flags_deltas(tmp_path):
+    worse = {
+        "baseline": [(2, 3000), (16, 3100), (64, 3500)],  # improved a bit
+        "both": [(2, 5000), (16, 5600), (64, 6400)],      # >5% regressions
+    }
+    base = fake_sweep(tmp_path, "base", SWEEP)
+    curdir = fake_sweep(tmp_path, "cur2", worse)
+    out = tmp_path / "r.html"
+    write_report(curdir, out, baseline_dir=base)
+    doc = out.read_text()
+    assert "Regression vs baseline" in doc
+    assert 'class="regress"' in doc
+    assert "+19.0%" in doc  # both/2c: 4200 -> 5000
+
+    rows = regression_rows(
+        [json.loads(line) for line in
+         (curdir / "results.jsonl").read_text().splitlines()],
+        [json.loads(line) for line in
+         (base / "results.jsonl").read_text().splitlines()],
+    )
+    by_label = {r["label"]: r for r in rows}
+    d = by_label["topo_both_1000qps_2c"]["metrics"]["p99"]
+    assert d["delta"] == pytest.approx((5000 - 4200) / 4200)
+
+
+def test_regression_direction_qps_down_is_worse():
+    cur = [{"Labels": "x_1000qps_8c", "ActualQPS": 900, "NumThreads": 8,
+            "p50": 100, "p90": 110, "p99": 120, "errorPercent": 0.0}]
+    base = [{"Labels": "x_1000qps_8c", "ActualQPS": 1000, "NumThreads": 8,
+             "p50": 100, "p90": 110, "p99": 120, "errorPercent": 0.0}]
+    doc = build_report(cur, base)
+    m = re.search(r'<td class="(\w+)"[^>]*>-10\.0%</td>', doc)
+    assert m and m.group(1) == "regress"
+
+
+def test_svg_chart_degenerate_inputs():
+    assert svg_line_chart({}, "t", "x", "y") == ""
+    one = svg_line_chart({"s": [(1.0, 5.0)]}, "t", "x", "y")
+    assert "<svg" in one  # single point doesn't crash the scales
+    # sub-1 spans still get a real tick scale (not a lone 0)
+    small = svg_line_chart(
+        {"s": [(1.0, 0.1), (2.0, 0.5)]}, "t", "x", "y"
+    )
+    ticks = re.findall(r'class="tick">([^<]+)', small)
+    assert "0.2" in ticks or "0.25" in ticks
+
+
+def test_regression_from_zero_baseline_is_flagged():
+    cur = [{"Labels": "x_1000qps_8c", "ActualQPS": 1000, "NumThreads": 8,
+            "p50": 100, "p90": 110, "p99": 120, "errorPercent": 8.0}]
+    base = [{"Labels": "x_1000qps_8c", "ActualQPS": 1000, "NumThreads": 8,
+             "p50": 100, "p90": 110, "p99": 120, "errorPercent": 0.0}]
+    doc = build_report(cur, base)
+    assert '<td class="regress" title="0 → 8">new</td>' in doc
+
+
+def test_report_cli(tmp_path, capsys):
+    d = fake_sweep(tmp_path, "run1", SWEEP)
+    out = tmp_path / "rep.html"
+    rc = cli.main(["report", str(d), "-o", str(out)])
+    assert rc == 0
+    assert out.exists()
+    assert "6 runs" in capsys.readouterr().err
+
+
+def test_report_missing_dir_errors(tmp_path):
+    with pytest.raises(FileNotFoundError):
+        write_report(tmp_path / "nosuch", tmp_path / "x.html")
