@@ -7,7 +7,17 @@ merging metrics with XLA collectives over ICI — psum for counters and
 histograms, psum_scatter to leave per-service histogram state sharded over
 the ``svc`` axis (SURVEY.md §2.5, §5.8).
 """
-from isotope_tpu.parallel.mesh import default_mesh, make_mesh
+from isotope_tpu.parallel.mesh import (
+    default_mesh,
+    make_mesh,
+    make_multislice_mesh,
+)
 from isotope_tpu.parallel.sharded import ShardedSimulator, ShardedSummary
 
-__all__ = ["default_mesh", "make_mesh", "ShardedSimulator", "ShardedSummary"]
+__all__ = [
+    "default_mesh",
+    "make_mesh",
+    "make_multislice_mesh",
+    "ShardedSimulator",
+    "ShardedSummary",
+]

@@ -2,9 +2,15 @@
 
 Axis conventions:
 
-- ``data``: shards the request batch (every device simulates a disjoint
-  slice of the arrival stream — the analogue of running more Fortio
-  clients, perf/load/common.sh:68-90);
+- ``slice`` (optional, outermost): multi-slice scale-out — collectives
+  crossing it ride DCN, the analogue of the reference's
+  cluster1/cluster2 multicluster split
+  (perf/load/templates/service-graph.gen.yaml:1-3).  Per-request work
+  never crosses it; only the O(buckets) summary reduction does, so the
+  DCN traffic per run is a few KB regardless of request count;
+- ``data``: shards the request batch within a slice over ICI (every
+  device simulates a disjoint slice of the arrival stream — the
+  analogue of running more Fortio clients, perf/load/common.sh:68-90);
 - ``svc``: shards per-service metric state (the analogue of services
   living on different nodes/namespaces).  Compute for all hops is still
   data-parallel; cross-``svc`` traffic is the metrics reduce-scatter.
@@ -17,6 +23,7 @@ import jax
 import numpy as np
 from jax.sharding import Mesh
 
+SLICE_AXIS = "slice"
 DATA_AXIS = "data"
 SVC_AXIS = "svc"
 
@@ -34,6 +41,30 @@ def make_mesh(
         )
     grid = np.asarray(devices[: data * svc]).reshape(data, svc)
     return Mesh(grid, (DATA_AXIS, SVC_AXIS))
+
+
+def make_multislice_mesh(
+    slices: int,
+    data: int,
+    svc: int = 1,
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> Mesh:
+    """(slice, data, svc) mesh for multi-slice runs.
+
+    On real multi-slice hardware, pass ``devices`` ordered so that each
+    contiguous ``data * svc`` block lives on one slice (the order
+    ``jax.devices()`` already uses) — then ``data``/``svc`` collectives
+    stay on ICI and only the ``slice`` axis crosses DCN.
+    """
+    devices = list(devices) if devices is not None else jax.devices()
+    need = slices * data * svc
+    if need > len(devices):
+        raise ValueError(
+            f"mesh {slices}x{data}x{svc} needs {need} devices, have "
+            f"{len(devices)}"
+        )
+    grid = np.asarray(devices[:need]).reshape(slices, data, svc)
+    return Mesh(grid, (SLICE_AXIS, DATA_AXIS, SVC_AXIS))
 
 
 def default_mesh(devices: Optional[Sequence[jax.Device]] = None) -> Mesh:

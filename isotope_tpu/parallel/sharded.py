@@ -28,7 +28,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 from isotope_tpu.compiler.program import CompiledGraph
 from isotope_tpu.metrics.prometheus import MetricsCollector, ServiceMetrics
-from isotope_tpu.parallel.mesh import DATA_AXIS, SVC_AXIS
+from isotope_tpu.parallel.mesh import SVC_AXIS
 from isotope_tpu.sim.config import CLOSED_LOOP, OPEN_LOOP, LoadModel, SimParams
 from isotope_tpu.sim.engine import Simulator
 from isotope_tpu.sim.summary import RunSummary, reduce_stacked, summarize
@@ -52,9 +52,19 @@ class ShardedSimulator:
         self.mesh = mesh
         self.sim = Simulator(compiled, params, chaos)
         self.collector = MetricsCollector(compiled)
-        self.n_data = mesh.shape[DATA_AXIS]
+        if SVC_AXIS not in mesh.axis_names:
+            raise ValueError(
+                f"mesh must carry a {SVC_AXIS!r} axis; got "
+                f"{mesh.axis_names}"
+            )
+        # every non-svc axis shards the request stream: (data,) on one
+        # slice, (slice, data) across slices — only the O(buckets)
+        # summary reduction ever crosses the slice (DCN) axis
+        self.request_axes = tuple(
+            a for a in mesh.axis_names if a != SVC_AXIS
+        )
         self.n_svc = mesh.shape[SVC_AXIS]
-        self.n_shards = self.n_data * self.n_svc
+        self.n_shards = mesh.size
         # services padded so psum_scatter can tile over the svc axis
         s = compiled.num_services
         self.s_pad = -(-s // self.n_svc) * self.n_svc
@@ -132,7 +142,7 @@ class ShardedSimulator:
             mapped = jax.shard_map(
                 body,
                 mesh=self.mesh,
-                in_specs=(P(), P(), P(), P(), P(), P()),
+                in_specs=tuple(P() for _ in range(6)),
                 out_specs=RunSummary(
                     count=P(),
                     error_count=P(),
@@ -180,11 +190,10 @@ class ShardedSimulator:
         win_lo: jax.Array,
         win_hi: jax.Array,
     ) -> RunSummary:
-        both = (DATA_AXIS, SVC_AXIS)
-        shard = (
-            jax.lax.axis_index(DATA_AXIS) * self.n_svc
-            + jax.lax.axis_index(SVC_AXIS)
-        )
+        both = tuple(self.mesh.axis_names)
+        shard = jnp.int32(0)
+        for a in self.mesh.axis_names:
+            shard = shard * self.mesh.shape[a] + jax.lax.axis_index(a)
         # disjoint fold domains: the rate solver's pilots consumed
         # fold_in(key, 0..iters) on the same base key
         local_key = jax.random.fold_in(key, 500_000 + shard)
@@ -224,9 +233,10 @@ class ShardedSimulator:
         def allsum(x):
             return jax.lax.psum(x, both)
 
-        # per-service hists: reduce over data, stay sharded over svc
+        # per-service hists: reduce over the request axes (incl. the
+        # DCN slice axis, if any), stay sharded over svc
         def scatter_svc(x):
-            x = jax.lax.psum(x, DATA_AXIS)
+            x = jax.lax.psum(x, self.request_axes)
             pad = self.s_pad - x.shape[0]
             if pad:
                 x = jnp.pad(x, [(0, pad)] + [(0, 0)] * (x.ndim - 1))

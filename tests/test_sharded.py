@@ -116,3 +116,64 @@ def test_quantile_from_histogram_accuracy():
     got = quantile_from_histogram(hist, [0.5, 0.9, 0.99])
     want = np.quantile(samples, [0.5, 0.9, 0.99])
     np.testing.assert_allclose(got, want, rtol=0.01)
+
+
+# -- multi-slice (DCN axis) ------------------------------------------------
+
+
+def test_multislice_mesh_shape():
+    from isotope_tpu.parallel import make_multislice_mesh
+
+    mesh = make_multislice_mesh(2, 2, 2)
+    assert mesh.axis_names == ("slice", "data", "svc")
+    assert dict(mesh.shape) == {"slice": 2, "data": 2, "svc": 2}
+    with pytest.raises(ValueError):
+        make_multislice_mesh(4, 4, 4)  # > 8 devices
+
+
+def test_multislice_matches_single_slice(compiled):
+    from isotope_tpu.parallel import make_multislice_mesh
+
+    n = 16384
+    multi = ShardedSimulator(compiled, make_multislice_mesh(2, 2, 2))
+    flat = ShardedSimulator(compiled, make_mesh(4, 2))
+    s_multi = multi.run(LOAD, n, KEY)
+    s_flat = flat.run(LOAD, n, KEY)
+
+    # same shard count => identical per-shard streams, identical merge
+    assert multi.n_shards == flat.n_shards == 8
+    assert int(s_multi.count) == int(s_flat.count) == n
+    np.testing.assert_allclose(
+        np.asarray(s_multi.latency_hist),
+        np.asarray(s_flat.latency_hist),
+    )
+    np.testing.assert_allclose(
+        float(s_multi.latency_sum), float(s_flat.latency_sum), rtol=1e-6
+    )
+    # per-service state is sharded over svc identically in both
+    np.testing.assert_allclose(
+        np.asarray(s_multi.metrics.duration_hist),
+        np.asarray(s_flat.metrics.duration_hist),
+    )
+
+
+def test_multislice_closed_loop(compiled):
+    from isotope_tpu.parallel import make_multislice_mesh
+
+    load = LoadModel(kind="closed", qps=None, connections=16)
+    sharded = ShardedSimulator(compiled, make_multislice_mesh(2, 2, 2))
+    s = sharded.run(load, 4096, KEY)
+    assert int(s.count) >= 4096
+    single = Simulator(compiled).run(load, 4096, KEY)
+    assert s.mean_latency_s == pytest.approx(
+        float(single.client_latency.mean()), rel=0.05
+    )
+
+
+def test_svc_axis_required():
+    from jax.sharding import Mesh
+
+    devices = np.asarray(jax.devices()[:4]).reshape(2, 2)
+    bad = Mesh(devices, ("a", "b"))
+    with pytest.raises(ValueError, match="svc"):
+        ShardedSimulator(compile_graph(ServiceGraph.from_yaml(YAML)), bad)
