@@ -75,6 +75,45 @@ def tree_topology(
     }
 
 
+class _Fenwick:
+    """Prefix-sum tree for O(log n) weighted sampling with updates —
+    the nonlinear-preferential-attachment loop is O(n^2) with a dense
+    weight array, which caps the generator at ~10k services; this keeps
+    100k-service topologies (BASELINE configs[4]) in seconds."""
+
+    def __init__(self, n: int):
+        self.n = n
+        self.tree = [0.0] * (n + 1)
+        self.total = 0.0
+
+    def add(self, i: int, delta: float) -> None:
+        self.total += delta
+        i += 1
+        while i <= self.n:
+            self.tree[i] += delta
+            i += i & (-i)
+
+    def sample(self, u: float, hi: int) -> int:
+        """Index i < hi with cumweight(i-1) <= u*total < cumweight(i).
+
+        ``hi`` bounds the attachable prefix: float accumulation drift
+        (tree vs ``total`` sum the same deltas in different orders) can
+        push the target a ULP past the tree sum, and the descent would
+        then walk into the zero-weight suffix of not-yet-added nodes.
+        """
+        target = u * self.total
+        idx = 0
+        bit = 1 << (self.n.bit_length())
+        tree = self.tree
+        while bit:
+            nxt = idx + bit
+            if nxt <= self.n and tree[nxt] <= target:
+                target -= tree[nxt]
+                idx = nxt
+            bit >>= 1
+        return min(idx, hi - 1)
+
+
 def barabasi_albert_edges(
     n: int,
     power: float,
@@ -95,14 +134,26 @@ def barabasi_albert_edges(
     if n < 1:
         raise ValueError("need at least one node")
     edges = np.empty((max(n - 1, 0), 2), dtype=np.int64)
-    in_degree = np.zeros(n, dtype=np.float64)
+    in_degree = np.zeros(n, dtype=np.int64)
+    weights = _Fenwick(n)
+    weights.add(0, zero_appeal)  # node 0: in_degree 0
+    if n > 1 and zero_appeal <= 0:
+        # node 0 starts with in_degree 0 => weight 0**power + 0 = 0;
+        # nothing is attachable (the dense implementation hit the same
+        # wall as a 0/0 in the probability normalization)
+        raise ValueError(
+            "zero_appeal must be positive: with no appeal an empty "
+            "graph has all-zero attachment weights"
+        )
+    us = rng.random(max(n - 1, 0))
     for j in range(1, n):
-        weights = in_degree[:j] ** power + zero_appeal
-        probs = weights / weights.sum()
-        target = rng.choice(j, p=probs)
+        target = weights.sample(us[j - 1], j)
         # igraph edge j->target; reversed: target is the caller of j.
         edges[j - 1] = (target, j)
-        in_degree[target] += 1
+        d = in_degree[target]
+        in_degree[target] = d + 1
+        weights.add(target, float((d + 1) ** power - d**power))
+        weights.add(j, zero_appeal)  # j becomes attachable
     return edges
 
 

@@ -79,3 +79,25 @@ def test_realistic_star_is_flat():
 def test_realistic_unknown_archetype():
     with pytest.raises(ValueError):
         realistic_topology(num_services=5, archetype="mesh")
+
+
+def test_ba_zero_appeal_rejected():
+    import numpy as np
+    import pytest
+
+    from isotope_tpu.models.generators import barabasi_albert_edges
+
+    with pytest.raises(ValueError, match="zero_appeal"):
+        barabasi_albert_edges(10, 0.9, 0.0, np.random.default_rng(0))
+
+
+def test_ba_parent_child_invariant_many_seeds():
+    import numpy as np
+
+    from isotope_tpu.models.generators import barabasi_albert_edges
+
+    for seed in range(10):
+        e = barabasi_albert_edges(
+            2000, 0.05, 0.01, np.random.default_rng(seed)
+        )
+        assert (e[:, 0] < e[:, 1]).all()

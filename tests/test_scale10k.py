@@ -35,3 +35,14 @@ def test_10k_simulates_through_scan_path(compiled10k):
     # client latency is thousands of network+service legs
     assert 1.0 < s.mean_latency_s < 30.0
     assert not bool(s.unstable.any())
+
+
+def test_100k_generates_and_compiles_host_side():
+    # BASELINE configs[4]: generation is O(n log n) (Fenwick sampler)
+    # and the BFS unroll stays linear; the on-chip run is validated on
+    # TPU (README "Scale") — jit at this size is too slow for CI
+    doc = realistic_topology(100_000, archetype="multitier", seed=0)
+    compiled = compile_graph(ServiceGraph.decode(doc))
+    assert compiled.num_services == 100_000
+    assert compiled.num_hops == 100_000
+    assert len(compiled.levels) < 50
