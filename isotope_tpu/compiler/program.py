@@ -132,16 +132,21 @@ class CompiledGraph:
     def depth(self) -> int:
         return len(self.levels)
 
-    def expected_visits(self) -> np.ndarray:
+    def expected_visits(self, hop_multiplier=None) -> np.ndarray:
         """Expected hops per root request, per service (f64, shape (S,)).
 
         Offered load at service s under root rate R is ``R *
         expected_visits()[s]`` — the simulator's replacement for measuring
         per-service request rates off live Prometheus counters
-        (service/pkg/srv/prometheus/handler.go:37-49).
+        (service/pkg/srv/prometheus/handler.go:37-49).  ``hop_multiplier``
+        (shape (H,)) scales each hop's static reach — e.g. time-averaged
+        traffic-split weights.
         """
+        weights = self.hop_reach
+        if hop_multiplier is not None:
+            weights = weights * hop_multiplier
         return np.bincount(
             self.hop_service,
-            weights=self.hop_reach,
+            weights=weights,
             minlength=self.num_services,
         )

@@ -47,10 +47,11 @@ class ShardedSimulator:
         mesh: Mesh,
         params: SimParams = SimParams(),
         chaos=(),
+        churn=(),
     ):
         self.compiled = compiled
         self.mesh = mesh
-        self.sim = Simulator(compiled, params, chaos)
+        self.sim = Simulator(compiled, params, chaos, churn)
         self.collector = MetricsCollector(compiled)
         if SVC_AXIS not in mesh.axis_names:
             raise ValueError(

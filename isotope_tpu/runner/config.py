@@ -23,6 +23,7 @@ from isotope_tpu.sim.config import (
     LoadModel,
     NetworkModel,
     SimParams,
+    TrafficSplit,
 )
 from isotope_tpu.utils import duration as dur
 
@@ -122,6 +123,7 @@ class ExperimentConfig:
     mesh_svc: int = 1
     labels: str = ""
     chaos: Tuple[ChaosEvent, ...] = ()
+    churn: Tuple[TrafficSplit, ...] = ()
 
     def sim_params(self) -> SimParams:
         return SimParams(
@@ -237,6 +239,17 @@ def load_toml(path) -> ExperimentConfig:
             )
         )
 
+    # [[churn]]: the config-churner analogue (rotating traffic weights)
+    churn: List[TrafficSplit] = []
+    for ts in doc.get("churn", []):
+        churn.append(
+            TrafficSplit(
+                service=ts["service"],
+                period_s=dur.parse_duration_seconds(ts["period"]),
+                weights=tuple(float(w) for w in ts["weights"]),
+            )
+        )
+
     sim = doc.get("sim", {})
     defaults = SimParams()
     return ExperimentConfig(
@@ -261,4 +274,5 @@ def load_toml(path) -> ExperimentConfig:
         mesh_svc=int(sim.get("mesh_svc", 1)),
         labels=doc.get("labels", ""),
         chaos=tuple(chaos),
+        churn=tuple(churn),
     )

@@ -108,7 +108,8 @@ class _LazyTopology:
         """(Simulator, ShardedSimulator | None) for an environment."""
         if env.name not in self._sims:
             params = env.apply(self.config.sim_params())
-            sim = Simulator(self.compiled, params, self.config.chaos)
+            sim = Simulator(self.compiled, params, self.config.chaos,
+                            self.config.churn)
             use_mesh = self.mesh_data * self.mesh_svc > 1
             sharded = (
                 ShardedSimulator(
@@ -116,6 +117,7 @@ class _LazyTopology:
                     make_mesh(self.mesh_data, self.mesh_svc),
                     params,
                     self.config.chaos,
+                    self.config.churn,
                 )
                 if use_mesh
                 else None

@@ -121,6 +121,38 @@ class ChaosEvent:
             raise ValueError("replicas_down must be positive (or None=all)")
 
 
+@dataclasses.dataclass(frozen=True)
+class TrafficSplit:
+    """Time-varying traffic weight toward one service.
+
+    The simulation analogue of the reference's config churner
+    (perf/load/templates/config-map.yaml:40-60): an in-cluster
+    ``rollout.sh`` rotates VirtualService v1/v2 weights through
+    100/70/40/20 forever, producing steady-state control-plane churn
+    that actually shifts traffic.  Here every call targeting
+    ``service`` has its send probability multiplied by
+    ``weights[floor(t / period_s) mod len(weights)]`` — model a canary
+    as two services (v1/v2) with complementary weight schedules.
+    """
+
+    service: str
+    period_s: float
+    weights: "tuple[float, ...]"
+
+    def __post_init__(self):
+        if self.period_s <= 0:
+            raise ValueError("churn period_s must be positive")
+        if not self.weights:
+            raise ValueError("churn weights must be non-empty")
+        if any(not 0.0 <= w <= 1.0 for w in self.weights):
+            raise ValueError("churn weights must lie in [0, 1]")
+        object.__setattr__(self, "weights", tuple(self.weights))
+
+    @property
+    def mean_weight(self) -> float:
+        return sum(self.weights) / len(self.weights)
+
+
 OPEN_LOOP = "open"
 CLOSED_LOOP = "closed"
 

@@ -51,6 +51,7 @@ from isotope_tpu.sim.config import (
     ChaosEvent,
     LoadModel,
     SimParams,
+    TrafficSplit,
 )
 
 
@@ -107,6 +108,7 @@ class _Level:
     call_timeout: jax.Array     # (K,) f32
     att_child: np.ndarray       # (maxA, K) i32 — static gather indices
     att_valid: np.ndarray       # (maxA, K) bool — static masks
+    child_churn_entry: Optional[np.ndarray] = None  # (C,) i32 static
 
     @property
     def num_children(self) -> int:
@@ -129,6 +131,7 @@ class Simulator:
         compiled: CompiledGraph,
         params: SimParams = SimParams(),
         chaos: Sequence[ChaosEvent] = (),
+        churn: Sequence[TrafficSplit] = (),
     ):
         self.compiled = compiled
         self.params = params
@@ -136,11 +139,67 @@ class Simulator:
         net = params.network
 
         self._k_max = int(t.replicas.max())
-        self._visits = jnp.asarray(compiled.expected_visits(), jnp.float32)
         self._mu = 1.0 / params.cpu_time_s
 
-        # -- chaos phases: piecewise-constant effective replica counts -----
+        # -- traffic splits (config churner): per-hop schedule ids ---------
+        # Each churned call's send probability is multiplied by its
+        # schedule's current weight; descendants inherit through the
+        # sent-propagation pass.  Offered load uses the time-averaged
+        # weight, propagated down the unroll (a churned call scales its
+        # whole subtree's reach).
         name_to_idx = {n: i for i, n in enumerate(t.names)}
+        self._churn = tuple(churn)
+        hop_mult = None
+        if churn:
+            entry_of_svc = np.full(compiled.num_services, -1, np.int64)
+            for e_i, ts in enumerate(churn):
+                if ts.service not in name_to_idx:
+                    raise ValueError(
+                        f"traffic split for unknown service: "
+                        f"{ts.service!r}"
+                    )
+                if entry_of_svc[name_to_idx[ts.service]] >= 0:
+                    raise ValueError(
+                        f"multiple traffic splits target "
+                        f"{ts.service!r}"
+                    )
+                entry_of_svc[name_to_idx[ts.service]] = e_i
+            entry_of_hop = entry_of_svc[compiled.hop_service]
+            entry_of_hop[0] = -1  # the client's edge is never churned
+            for ts in churn:
+                if not (entry_of_hop == entry_of_svc[
+                        name_to_idx[ts.service]]).any():
+                    # only the root targets it (or nothing does): the
+                    # split would be a silent no-op
+                    raise ValueError(
+                        f"traffic split for {ts.service!r} matches no "
+                        "callable edge (the client -> entrypoint edge "
+                        "cannot be churned)"
+                    )
+            # sentinel column E holds weight 1.0 for unchurned calls
+            self._hop_churn_entry = np.where(
+                entry_of_hop >= 0, entry_of_hop, len(churn)
+            ).astype(np.int32)
+            self._churn_periods = tuple(
+                float(ts.period_s) for ts in churn
+            )
+            self._churn_weights = tuple(
+                jnp.asarray(ts.weights, jnp.float32) for ts in churn
+            )
+            means = np.asarray([ts.mean_weight for ts in churn])
+            own = np.where(
+                entry_of_hop >= 0, means[np.clip(entry_of_hop, 0, None)],
+                1.0,
+            )
+            # hops are in BFS order, so parents precede children
+            hop_mult = np.ones(compiled.num_hops, np.float64)
+            for h in range(1, compiled.num_hops):
+                hop_mult[h] = hop_mult[compiled.hop_parent[h]] * own[h]
+        self._visits = jnp.asarray(
+            compiled.expected_visits(hop_mult), jnp.float32
+        )
+
+        # -- chaos phases: piecewise-constant effective replica counts -----
         for ev in chaos:
             if ev.service not in name_to_idx:
                 raise ValueError(f"chaos for unknown service: {ev.service!r}")
@@ -219,6 +278,9 @@ class Simulator:
                     call_timeout=jnp.asarray(lvl.call_timeout),
                     att_child=lvl.att_child,
                     att_valid=lvl.att_valid,
+                    child_churn_entry=(
+                        self._hop_churn_entry[cids] if churn else None
+                    ),
                 )
             )
             offset += lvl.num_hops
@@ -606,6 +668,23 @@ class Simulator:
             )
             arrivals = None  # closed-loop arrivals derive from latencies
 
+        # ---- traffic-split weights at each request's arrival time --------
+        # (N, E+1): one column per schedule + a sentinel 1.0 column for
+        # unchurned calls; the nominal arrival places closed-loop
+        # requests like the chaos phases do
+        if self._churn:
+            cols = [
+                wts[
+                    jnp.floor(nominal_arrivals / p).astype(jnp.int32)
+                    % len(wts)
+                ]
+                for p, wts in zip(self._churn_periods,
+                                  self._churn_weights)
+            ]
+            churn_w = jnp.stack(
+                cols + [jnp.ones_like(nominal_arrivals)], axis=1
+            )
+
         # ---- queueing parameters, per chaos phase ------------------------
         # (P, S): offered load is per-service; replicas vary by phase.
         qp = queueing.mmk_params(
@@ -687,9 +766,11 @@ class Simulator:
                 rtt_child = jnp.pad(lvl.child_rtt, (0, 1))
 
                 a0 = lvl.att_child[0]  # (K,) attempt-0 local child index
-                coin = (
-                    u_send[:, csl][:, a0] < lvl.child_send_prob[a0]
-                )  # (N, K)
+                prob = lvl.child_send_prob[a0]
+                if self._churn:
+                    # current schedule weight scales the send probability
+                    prob = prob * churn_w[:, lvl.child_churn_entry[a0]]
+                coin = u_send[:, csl][:, a0] < prob  # (N, K)
                 dur_call = jnp.zeros((n, lvl.num_calls))
                 final_transport = jnp.zeros((n, lvl.num_calls), bool)
                 used = jnp.zeros((n, C + 1), bool)

@@ -1,0 +1,160 @@
+"""Config churner: time-varying traffic splits (config-map.yaml:40-60
+rollout.sh parity — VirtualService weight rotation as send-probability
+schedules)."""
+import jax
+import numpy as np
+import pytest
+import yaml
+
+from isotope_tpu.compiler import compile_graph
+from isotope_tpu.models.graph import ServiceGraph
+from isotope_tpu.runner.config import load_toml
+from isotope_tpu.sim import LoadModel, SimParams, Simulator
+from isotope_tpu.sim.config import TrafficSplit
+
+CANARY = """
+services:
+- name: entry
+  isEntrypoint: true
+  script:
+  - call: v1
+  - call: v2
+- name: v1
+  script: [{sleep: 1ms}]
+- name: v2
+  script: [{sleep: 1ms}]
+"""
+
+KEY = jax.random.PRNGKey(7)
+
+
+def sim_with(churn, doc=CANARY, **params):
+    g = ServiceGraph.decode(yaml.safe_load(doc))
+    return Simulator(compile_graph(g), SimParams(**params), churn=churn)
+
+
+def hop_fraction(res, compiled, service):
+    """Fraction of requests that actually hit ``service``."""
+    svc = list(compiled.services.names).index(service)
+    cols = np.asarray(compiled.hop_service) == svc
+    sent = np.asarray(res.hop_sent)[:, cols].any(axis=1)
+    return sent, np.asarray(res.client_start)
+
+
+def test_square_wave_split_follows_schedule():
+    # v1 on for the first second of every 2s cycle, off for the second
+    churn = (TrafficSplit(service="v1", period_s=1.0,
+                          weights=(1.0, 0.0)),)
+    sim = sim_with(churn)
+    res = sim.run(LoadModel(kind="open", qps=500.0), 4000, KEY)
+    sent, starts = hop_fraction(res, sim.compiled, "v1")
+    phase = np.floor(starts).astype(int) % 2
+    assert sent[phase == 0].mean() == pytest.approx(1.0)
+    assert sent[phase == 1].mean() == pytest.approx(0.0)
+    # v2 is not churned: always called
+    sent2, _ = hop_fraction(res, sim.compiled, "v2")
+    assert sent2.all()
+
+
+def test_canary_rotation_mean_traffic():
+    # the reference's canary weights 100/70/40/20 over the cycle
+    churn = (
+        TrafficSplit(service="v1", period_s=0.5,
+                     weights=(1.0, 0.7, 0.4, 0.2)),
+        TrafficSplit(service="v2", period_s=0.5,
+                     weights=(0.0, 0.3, 0.6, 0.8)),
+    )
+    sim = sim_with(churn)
+    res = sim.run(LoadModel(kind="open", qps=2000.0), 20000, KEY)
+    sent1, _ = hop_fraction(res, sim.compiled, "v1")
+    sent2, _ = hop_fraction(res, sim.compiled, "v2")
+    assert sent1.mean() == pytest.approx(np.mean([1.0, 0.7, 0.4, 0.2]),
+                                         abs=0.03)
+    assert sent2.mean() == pytest.approx(np.mean([0.0, 0.3, 0.6, 0.8]),
+                                         abs=0.03)
+
+
+def test_churn_scales_offered_load_and_subtree():
+    # churning a mid service scales its whole subtree's utilization
+    doc = """
+services:
+- name: entry
+  isEntrypoint: true
+  script: [{call: mid}]
+- name: mid
+  script: [{call: leaf}]
+- name: leaf
+"""
+    churn = (TrafficSplit(service="mid", period_s=1.0,
+                          weights=(0.5,)),)
+    base = sim_with((), doc=doc)
+    split = sim_with(churn, doc=doc)
+    v_base = np.asarray(base._visits)
+    v_split = np.asarray(split._visits)
+    names = list(base.compiled.services.names)
+    for svc in ("mid", "leaf"):
+        i = names.index(svc)
+        assert v_split[i] == pytest.approx(0.5 * v_base[i])
+    assert v_split[names.index("entry")] == v_base[names.index("entry")]
+
+
+def test_churn_through_scan_path_continuous_timeline():
+    # blocks must see one continuous clock: with 1s on / 1s off at
+    # 500 qps and 1024-request blocks (~2s each), a restarted clock
+    # would put every block's requests in the "on" phase
+    churn = (TrafficSplit(service="v1", period_s=1.0,
+                          weights=(1.0, 0.0)),)
+    sim = sim_with(churn)
+    s = sim.run_summary(LoadModel(kind="open", qps=500.0), 4096, KEY,
+                        block_size=1024)
+    # entry + v2 always run; v1 half the time => 2.5 hops/request
+    assert float(s.hop_events) / 4096 == pytest.approx(2.5, abs=0.05)
+
+
+def test_churn_validation():
+    with pytest.raises(ValueError, match="period"):
+        TrafficSplit(service="x", period_s=0.0, weights=(1.0,))
+    with pytest.raises(ValueError, match="weights"):
+        TrafficSplit(service="x", period_s=1.0, weights=())
+    with pytest.raises(ValueError, match="weights"):
+        TrafficSplit(service="x", period_s=1.0, weights=(1.5,))
+    with pytest.raises(ValueError, match="unknown service"):
+        sim_with((TrafficSplit(service="nosuch", period_s=1.0,
+                               weights=(1.0,)),))
+    with pytest.raises(ValueError, match="multiple traffic splits"):
+        sim_with(
+            (
+                TrafficSplit(service="v1", period_s=1.0, weights=(1.0,)),
+                TrafficSplit(service="v1", period_s=2.0, weights=(0.5,)),
+            )
+        )
+    # churning the entrypoint would be a silent no-op: reject it
+    with pytest.raises(ValueError, match="no callable edge"):
+        sim_with((TrafficSplit(service="entry", period_s=1.0,
+                               weights=(0.5,)),))
+
+
+def test_churn_toml_plumbing(tmp_path):
+    topo = tmp_path / "t.yaml"
+    topo.write_text(CANARY)
+    cfg = tmp_path / "exp.toml"
+    cfg.write_text(
+        f"""
+topology_paths = ["{topo}"]
+environments = ["NONE"]
+
+[client]
+qps = [100]
+load_kind = "open"
+
+[[churn]]
+service = "v1"
+period = "30s"
+weights = [1.0, 0.7, 0.4, 0.2]
+"""
+    )
+    config = load_toml(cfg)
+    assert len(config.churn) == 1
+    assert config.churn[0].service == "v1"
+    assert config.churn[0].period_s == 30.0
+    assert config.churn[0].weights == (1.0, 0.7, 0.4, 0.2)
