@@ -8,9 +8,10 @@ def register_all(sub) -> None:
     convert_cmd.register(sub)
     generate_cmd.register(sub)
     report_cmd.register(sub)
-    # simulate_cmd defers its jax-dependent imports into the handlers (so
-    # --help stays instant); a jax-less environment gets a clean error at
-    # run time from _require_jax, not a hidden subcommand.
-    from isotope_tpu.commands import simulate_cmd
+    # simulate_cmd/suite_cmd defer their jax-dependent imports into the
+    # handlers (so --help stays instant); a jax-less environment gets a
+    # clean error at run time from _require_jax, not a hidden subcommand.
+    from isotope_tpu.commands import simulate_cmd, suite_cmd
 
     simulate_cmd.register(sub)
+    suite_cmd.register(sub)

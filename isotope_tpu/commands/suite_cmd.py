@@ -1,0 +1,55 @@
+"""``isotope-tpu suite`` — the CI benchmark-job pipeline.
+
+The run_benchmark_job.sh analogue: run every given experiment config,
+collect artifacts under one ``<date>_<loadgen>_<branch>_<ver>`` publish
+id, evaluate the stability alarms on every run into a monitor-status
+sink, and render per-config reports plus a manifest.
+"""
+from __future__ import annotations
+
+import sys
+
+
+def register(sub) -> None:
+    s = sub.add_parser(
+        "suite",
+        help="run a set of experiment configs as one published "
+             "benchmark job",
+    )
+    s.add_argument("configs", nargs="+",
+                   help="experiment TOML files to run, in order")
+    s.add_argument("--out", "-o", default="publish",
+                   help="publish root (default: ./publish)")
+    s.add_argument("--id", default=None,
+                   help="publish id (default: <date>_sim_<labels>_dev)")
+    s.add_argument("--labels", default="master")
+    s.add_argument("--cpu-limit", type=float, default=50.0,
+                   help="alarm threshold, milli-cores")
+    s.add_argument("--mem-limit", type=float, default=64.0,
+                   help="alarm threshold, MiB")
+    s.add_argument("--fresh", action="store_true",
+                   help="ignore existing per-config checkpoints")
+    s.set_defaults(func=run_suite_cmd)
+
+
+def run_suite_cmd(args) -> int:
+    from isotope_tpu.runner.suite import run_suite
+
+    result = run_suite(
+        args.configs,
+        args.out,
+        id=args.id,
+        labels=args.labels,
+        cpu_limit_mcores=args.cpu_limit,
+        mem_limit_mib=args.mem_limit,
+        progress=lambda label: print(f"running {label}", file=sys.stderr),
+        resume=not args.fresh,
+    )
+    m = result.manifest
+    print(
+        f"suite {m['id']}: {m['total_runs']} runs across "
+        f"{len(m['configs'])} configs, {m['total_alarms']} alarms -> "
+        f"{result.publish_dir}",
+        file=sys.stderr,
+    )
+    return 1 if m["total_alarms"] else 0

@@ -40,11 +40,9 @@ def store_from_summary(collector, summary) -> MetricStore:
         raise ValueError(
             "summary has no metrics; run with a MetricsCollector"
         )
-    duration_s = float(summary.end_max)
-    text = collector.to_text(summary.metrics) + collector.resource_text(
-        summary.metrics, summary.utilization, duration_s
+    return MetricStore.from_text(
+        collector.full_text(summary), float(summary.end_max)
     )
-    return MetricStore.from_text(text, duration_s)
 
 
 def standard_queries(

@@ -172,6 +172,14 @@ class MetricsCollector:
 
     # -- host-side exposition ----------------------------------------------
 
+    def full_text(self, summary) -> str:
+        """The complete exposition for a run summary: the five service
+        series plus the sim-side resource series — what a scraper (and
+        the alarm queries) should see."""
+        return self.to_text(summary.metrics) + self.resource_text(
+            summary.metrics, summary.utilization, float(summary.end_max)
+        )
+
     def resource_text(self, m: ServiceMetrics, utilization,
                       duration_s: float) -> str:
         """Render the sim-side resource series — the counterpart of the

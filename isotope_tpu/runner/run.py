@@ -278,7 +278,9 @@ def run_experiment(
                             for name, v in window.cpu_cores.items()
                         }
                     )
-                    prom_text = topo.collector.to_text(summary.metrics)
+                    # full exposition: the five service series plus the
+                    # sim-side resource series the alarm queries read
+                    prom_text = topo.collector.full_text(summary)
                     result = RunResult(
                         label=label,
                         topology=topo_path,

@@ -1,0 +1,116 @@
+"""The benchmark-suite pipeline: run configs -> collect -> publish.
+
+The reference's CI entry (perf/benchmark/run_benchmark_job.sh) stands a
+cluster up, runs every enabled config (run_perf_test.conf toggles),
+collects CSVs and flame graphs, and uploads the artifact tree to
+``gs://istio-build/perf/<date>_<loadgen>_<branch>_<ver>/`` — the id
+format the dashboard scrapes (perf_dashboard/helpers/download.py:56-62).
+
+The simulation suite keeps the same pipeline shape without the cluster:
+each experiment TOML runs (checkpointed, resumable) into its own
+subdirectory of one publish id, every run's metrics are evaluated
+against the standard alarm suite into a monitor-status sink, and a
+per-config HTML report plus a manifest round out the artifact tree.
+"""
+from __future__ import annotations
+
+import dataclasses
+import json
+import pathlib
+from datetime import datetime, timezone
+from typing import List, Optional, Sequence
+
+from isotope_tpu.metrics.alarms import (
+    requests_sanity,
+    standard_queries,
+)
+from isotope_tpu.metrics.monitor import MonitorSink, monitor_run
+from isotope_tpu.metrics.query import MetricStore
+from isotope_tpu.runner.config import load_toml
+from isotope_tpu.runner.run import run_experiment
+
+
+def suite_id(
+    labels: str = "master",
+    loadgen: str = "sim",
+    version: str = "dev",
+    date: Optional[datetime] = None,
+) -> str:
+    """``<date>_<loadgen>_<branch>_<ver>`` (download.py:56-62 format)."""
+    date = date or datetime.now(timezone.utc)
+    return f"{date:%Y%m%d}_{loadgen}_{labels}_{version}"
+
+
+@dataclasses.dataclass
+class SuiteResult:
+    publish_dir: pathlib.Path
+    manifest: dict
+
+
+def run_suite(
+    config_paths: Sequence[str],
+    out_root,
+    id: Optional[str] = None,
+    labels: str = "master",
+    cpu_limit_mcores: float = 50.0,
+    mem_limit_mib: float = 64.0,
+    progress=None,
+    resume: bool = True,
+) -> SuiteResult:
+    """Run every config, publish one artifact tree, monitor every run."""
+    sid = id or suite_id(labels=labels)
+    publish = pathlib.Path(out_root) / sid
+    publish.mkdir(parents=True, exist_ok=True)
+    sink = MonitorSink(publish / "monitor_status.jsonl")
+
+    configs_out: List[dict] = []
+    total_runs = 0
+    for cfg_path in config_paths:
+        stem = pathlib.Path(cfg_path).stem
+        cfg = load_toml(cfg_path)
+        out_dir = publish / stem
+        results = run_experiment(
+            cfg, out_dir=str(out_dir), progress=progress, resume=resume
+        )
+        queries = standard_queries(
+            stem, cpu_lim=cpu_limit_mcores, mem_lim=mem_limit_mib
+        ) + [requests_sanity(stem)]
+        alarm_count = 0
+        for r in results:
+            if not r.prometheus_text:
+                continue
+            duration = float(r.flat.get("ActualDuration", 0) or 0)
+            store = MetricStore.from_text(r.prometheus_text, duration)
+            rows = monitor_run(store, sink, queries, run_label=r.label)
+            alarm_count += sum(1 for row in rows if row.status == "ALARM")
+
+        # per-config dashboard page
+        from isotope_tpu.report import write_report
+
+        write_report(
+            out_dir, out_dir / "report.html",
+            title=f"{sid} — {stem}",
+        )
+        configs_out.append(
+            {
+                "config": str(cfg_path),
+                "name": stem,
+                "runs": len(results),
+                "discarded": sum(
+                    1 for r in results if r.window.discarded
+                ),
+                "alarms": alarm_count,
+            }
+        )
+        total_runs += len(results)
+
+    manifest = {
+        "id": sid,
+        "loadgen": "sim",
+        "configs": configs_out,
+        "total_runs": total_runs,
+        "total_alarms": sum(c["alarms"] for c in configs_out),
+    }
+    with open(publish / "manifest.json", "w") as f:
+        json.dump(manifest, f, indent=2)
+    return SuiteResult(publish_dir=publish, manifest=manifest)

@@ -195,6 +195,48 @@ class Simulator:
             hop_mult = np.ones(compiled.num_hops, np.float64)
             for h in range(1, compiled.num_hops):
                 hop_mult[h] = hop_mult[compiled.hop_parent[h]] * own[h]
+
+            # Per-combo offered load: queueing waits must see the load
+            # of the CURRENT schedule position, not the time average —
+            # a square-wave split would otherwise report the averaged
+            # (stable) latency in both its phases.  The combo space is
+            # the product of the schedules' cycle positions; combined
+            # with the chaos cuts it reuses the piecewise-phase
+            # machinery below.
+            import itertools
+
+            ks = [len(ts.weights) for ts in churn]
+            n_combos = int(np.prod(ks))
+            if n_combos > 256:
+                raise ValueError(
+                    f"traffic-split cycle product is {n_combos} "
+                    "combinations (> 256); shorten or align the "
+                    "weight schedules"
+                )
+            combo_visits = np.empty(
+                (n_combos, compiled.num_services), np.float64
+            )
+            mult = np.empty((n_combos, compiled.num_hops), np.float64)
+            w_combo = np.asarray(
+                [
+                    [churn[e].weights[combo[e]] for e in range(len(churn))]
+                    for combo in itertools.product(*map(range, ks))
+                ]
+            )  # (C, E)
+            own_c = np.where(
+                entry_of_hop >= 0,
+                w_combo[:, np.clip(entry_of_hop, 0, None)],
+                1.0,
+            )  # (C, H)
+            mult[:, 0] = 1.0
+            for h in range(1, compiled.num_hops):
+                mult[:, h] = mult[:, compiled.hop_parent[h]] * own_c[:, h]
+            for c_i in range(n_combos):
+                combo_visits[c_i] = compiled.expected_visits(mult[c_i])
+            self._visits_combo = jnp.asarray(combo_visits, jnp.float32)
+            self._num_combos = n_combos
+        else:
+            self._num_combos = 1
         self._visits = jnp.asarray(
             compiled.expected_visits(hop_mult), jnp.float32
         )
@@ -671,49 +713,77 @@ class Simulator:
         # ---- traffic-split weights at each request's arrival time --------
         # (N, E+1): one column per schedule + a sentinel 1.0 column for
         # unchurned calls; the nominal arrival places closed-loop
-        # requests like the chaos phases do
+        # requests like the chaos phases do.  ``combo_idx`` linearizes
+        # the schedules' cycle positions for the queueing-phase tables.
+        combo_idx = None
         if self._churn:
-            cols = [
-                wts[
+            cols = []
+            combo_idx = jnp.zeros(n, jnp.int32)
+            for p, wts in zip(self._churn_periods, self._churn_weights):
+                idx = (
                     jnp.floor(nominal_arrivals / p).astype(jnp.int32)
                     % len(wts)
-                ]
-                for p, wts in zip(self._churn_periods,
-                                  self._churn_weights)
-            ]
+                )
+                cols.append(wts[idx])
+                combo_idx = combo_idx * len(wts) + idx
             churn_w = jnp.stack(
                 cols + [jnp.ones_like(nominal_arrivals)], axis=1
             )
 
-        # ---- queueing parameters, per chaos phase ------------------------
-        # (P, S): offered load is per-service; replicas vary by phase.
-        qp = queueing.mmk_params(
-            offered_qps * self._visits,
-            self._mu,
-            self._eff_replicas,
-            self._k_max,
-        )
+        # ---- queueing parameters, per (chaos x churn) phase --------------
+        # Offered load is per-service; replicas vary by chaos phase and
+        # visit rates by churn-schedule combo — the phase axis is the
+        # product of both.
+        P = int(self._phase_starts.shape[0])
+        Cc = self._num_combos
+        S = self.compiled.num_services
+        if self._churn:
+            lam = offered_qps * self._visits_combo  # (Cc, S)
+            lam = jnp.broadcast_to(lam[None], (P, Cc, S))
+            reps = jnp.broadcast_to(
+                self._eff_replicas[:, None, :], (P, Cc, S)
+            )
+            qp = queueing.mmk_params(lam, self._mu, reps, self._k_max)
+            qp = jax.tree.map(lambda x: x.reshape(P * Cc, S), qp)
+            svc_down_pc = jnp.repeat(self._svc_down, Cc, axis=0)
+        else:
+            qp = queueing.mmk_params(
+                offered_qps * self._visits,
+                self._mu,
+                self._eff_replicas,
+                self._k_max,
+            )
+            svc_down_pc = self._svc_down
         hop_svc = self._hop_service  # (H,)
-        # Per-hop parameter tables are tiny (P, H); expanding them over the
-        # request axis with a direct (N, H) 2D gather is catastrophically
+        # Per-hop parameter tables are tiny (P*Cc, H); expanding them over
+        # the request axis with a direct (N, H) 2D gather is catastrophically
         # slow on TPU (~2 GiB/s element gathers — 90% of step time in r1).
-        # Instead: no-chaos runs broadcast the single phase row for free,
-        # chaos runs expand via a one-hot (N, P) @ (P, H) matmul on the MXU.
-        p_wait_ph = qp.p_wait[:, hop_svc]        # (P, H)
-        wait_rate_ph = qp.wait_rate[:, hop_svc]  # (P, H)
-        down_ph = self._svc_down[:, hop_svc]     # (P, H) bool
-        num_phases = int(self._phase_starts.shape[0])
+        # Instead: single-phase runs broadcast the one row for free, phased
+        # runs expand via a one-hot (N, P*Cc) @ (P*Cc, H) matmul on the MXU.
+        p_wait_ph = qp.p_wait[:, hop_svc]        # (P*Cc, H)
+        wait_rate_ph = qp.wait_rate[:, hop_svc]  # (P*Cc, H)
+        down_ph = svc_down_pc[:, hop_svc]        # (P*Cc, H) bool
+        num_phases = P * Cc
         if num_phases == 1:
             p_wait_nh = p_wait_ph[0][None, :]
             wait_rate_nh = wait_rate_ph[0][None, :]
             down = jnp.broadcast_to(down_ph[0][None, :], (n, H))
         else:
+            if P > 1:
+                chaos_idx = (
+                    jnp.searchsorted(
+                        self._phase_starts, nominal_arrivals,
+                        side="right",
+                    ).astype(jnp.int32)
+                    - 1
+                )  # (N,)
+            else:
+                chaos_idx = jnp.zeros(n, jnp.int32)
             phase_idx = (
-                jnp.searchsorted(
-                    self._phase_starts, nominal_arrivals, side="right"
-                ).astype(jnp.int32)
-                - 1
-            )  # (N,)
+                chaos_idx * Cc + combo_idx
+                if combo_idx is not None
+                else chaos_idx
+            )
             oh = jax.nn.one_hot(phase_idx, num_phases, dtype=jnp.float32)
             # HIGHEST keeps the f32 tables exact (default TPU matmul
             # precision rounds operands through bfloat16)
@@ -729,8 +799,8 @@ class Simulator:
         )  # (N, H)
         # a fully-down service does no work: report zero utilization for
         # those phases instead of the clamped-to-1-replica saturation
-        util_phase = jnp.where(self._svc_down, 0.0, qp.utilization)
-        unstable_phase = jnp.where(self._svc_down, False, qp.unstable)
+        util_phase = jnp.where(svc_down_pc, 0.0, qp.utilization)
+        unstable_phase = jnp.where(svc_down_pc, False, qp.unstable)
 
         svc_time = self._sample_service_time(k_svc, (n, H))
 

@@ -158,3 +158,39 @@ weights = [1.0, 0.7, 0.4, 0.2]
     assert config.churn[0].service == "v1"
     assert config.churn[0].period_s == 30.0
     assert config.churn[0].weights == (1.0, 0.7, 0.4, 0.2)
+
+
+def test_churn_queueing_sees_per_phase_load():
+    # a square-wave split at near-capacity load: the ON phase must show
+    # the heavy-traffic waits, not the time-averaged (stable) ones
+    doc = """
+services:
+- name: entry
+  isEntrypoint: true
+  script:
+  - call: {service: hot, probability: 100}
+- name: hot
+"""
+    from isotope_tpu.sim.config import SimParams
+
+    mu = 1.0 / SimParams().cpu_time_s
+    churn = (TrafficSplit(service="hot", period_s=2.0,
+                          weights=(1.0, 0.0)),)
+    sim = sim_with(churn, doc=doc)
+    # offered 0.9*mu while ON; time-average only 0.45*mu
+    res = sim.run(LoadModel(kind="open", qps=0.9 * mu), 60000, KEY)
+    sent, starts = hop_fraction(res, sim.compiled, "hot")
+    lat = np.asarray(res.client_latency)
+    phase = np.floor(starts / 2.0).astype(int) % 2
+    on = lat[(phase == 0) & sent]
+    # ON-phase waits must match an unchurned run at the SAME rate
+    base = sim_with((), doc=doc)
+    res_b = base.run(LoadModel(kind="open", qps=0.9 * mu), 60000,
+                     jax.random.fold_in(KEY, 1))
+    lat_b = np.asarray(res_b.client_latency)
+    assert np.mean(on) == pytest.approx(np.mean(lat_b), rel=0.05)
+    # and they are far above what the 0.45*mu average would predict
+    avg_sim = sim_with((), doc=doc)
+    res_a = avg_sim.run(LoadModel(kind="open", qps=0.45 * mu), 60000,
+                        jax.random.fold_in(KEY, 2))
+    assert np.mean(on) > 1.5 * np.mean(np.asarray(res_a.client_latency))

@@ -124,7 +124,8 @@ def test_cli_simulate_flat(tmp_path, capsys):
     flat = json.loads(cap.out)
     assert flat["RequestedQPS"] == 200
     assert flat["p99"] >= flat["p50"] > 0
-    assert (tmp_path / "m.prom").read_text().count("# TYPE") == 5
+    # the five service series + the two sim-side resource series
+    assert (tmp_path / "m.prom").read_text().count("# TYPE") == 7
 
 
 def test_cli_sweep(tmp_path, capsys):

@@ -1,0 +1,140 @@
+"""Suite pipeline + monitor sink (run_benchmark_job.sh / webhook.go
+parity): run configs -> publish tree -> monitor rows -> manifest."""
+import json
+import pathlib
+
+import pytest
+
+from isotope_tpu import cli
+from isotope_tpu.metrics.alarms import Alarm, Query
+from isotope_tpu.metrics.monitor import (
+    STATUS_ALARM,
+    STATUS_OK,
+    MonitorSink,
+    evaluate,
+    monitor_run,
+)
+from isotope_tpu.metrics.query import MetricStore
+from isotope_tpu.runner.suite import run_suite, suite_id
+
+TOPO = pathlib.Path(__file__).parent.parent / "examples/topologies/canonical.yaml"
+
+
+def write_cfg(tmp_path, name, qps):
+    cfg = tmp_path / name
+    cfg.write_text(
+        f"""
+topology_paths = ["{TOPO}"]
+environments = ["NONE"]
+
+[client]
+qps = [{qps}]
+num_concurrent_connections = [8]
+duration = "60s"
+load_kind = "open"
+
+[sim]
+num_requests = 2000
+seed = 3
+"""
+    )
+    return cfg
+
+
+# -- monitor sink ----------------------------------------------------------
+
+EXPO = 'errs_total{service="a"} 5\nok_total{service="a"} 100\n'
+STORE = MetricStore.from_text(EXPO, duration_s=10.0)
+
+
+def q(expr, fires, msg="bad"):
+    return Query("check", expr, Alarm(fires, msg), None)
+
+
+def test_monitor_rows_ok_and_alarm(tmp_path):
+    sink = MonitorSink(tmp_path / "status.jsonl")
+    rows = monitor_run(
+        STORE,
+        sink,
+        [
+            q("rate(errs_total[1m])", lambda v: v > 0, "errors!"),
+            q("rate(ok_total[1m])", lambda v: v <= 0, "no traffic"),
+        ],
+        run_label="r1",
+    )
+    assert [r.status for r in rows] == [STATUS_ALARM, STATUS_OK]
+    assert rows[0].value == pytest.approx(0.5)
+    assert rows[0].detail == "errors!"
+    # persisted and readable
+    assert [r.status for r in sink.read()] == [STATUS_ALARM, STATUS_OK]
+    assert len(sink.alarms()) == 1
+
+
+def test_monitor_running_query_gate():
+    rows = evaluate(
+        [
+            Query(
+                "gated", "rate(errs_total[1m])",
+                Alarm(lambda v: True, "x"),
+                'sum(ok_total{service="nosuch"})',
+            )
+        ],
+        STORE,
+    )
+    assert rows == []
+
+
+def test_suite_id_format():
+    from datetime import datetime, timezone
+
+    d = datetime(2026, 7, 30, tzinfo=timezone.utc)
+    assert suite_id("master", "sim", "dev", d) == "20260730_sim_master_dev"
+
+
+def test_suite_publishes_tree_and_manifest(tmp_path):
+    # both below the 50-mcore standard CPU limit (the busiest service
+    # sees 2x the entry rate at ~77us/req)
+    c1 = write_cfg(tmp_path, "latency.toml", 200)
+    c2 = write_cfg(tmp_path, "cpu_mem.toml", 250)
+    result = run_suite([str(c1), str(c2)], tmp_path / "pub",
+                       id="20260730_sim_master_dev")
+    pub = result.publish_dir
+    assert pub == tmp_path / "pub" / "20260730_sim_master_dev"
+    for stem in ("latency", "cpu_mem"):
+        assert (pub / stem / "benchmark.csv").exists()
+        assert (pub / stem / "results.jsonl").exists()
+        assert (pub / stem / "report.html").exists()
+    manifest = json.loads((pub / "manifest.json").read_text())
+    assert manifest["total_runs"] == 2
+    assert [c["name"] for c in manifest["configs"]] == [
+        "latency", "cpu_mem"
+    ]
+    # the clean canonical runs raise no alarms
+    assert manifest["total_alarms"] == 0
+    status = (pub / "monitor_status.jsonl").read_text().splitlines()
+    # 4 standard checks per run x 2 runs
+    assert len(status) == 8
+    assert all(json.loads(s)["status"] == STATUS_OK for s in status)
+
+
+def test_suite_resumes_completed_configs(tmp_path):
+    c1 = write_cfg(tmp_path, "latency.toml", 200)
+    run_suite([str(c1)], tmp_path / "pub", id="x")
+    ran = []
+    run_suite([str(c1)], tmp_path / "pub", id="x", progress=ran.append)
+    assert ran == []  # checkpointed sweep replays
+
+
+def test_suite_cli_exit_code_on_alarm(tmp_path, capsys):
+    c1 = write_cfg(tmp_path, "latency.toml", 200)
+    rc = cli.main(
+        ["suite", str(c1), "-o", str(tmp_path / "pub"), "--id", "y"]
+    )
+    assert rc == 0
+    assert "1 runs across 1 configs" in capsys.readouterr().err
+    # an absurd CPU limit makes the standard CPU check fire
+    rc = cli.main(
+        ["suite", str(c1), "-o", str(tmp_path / "pub2"), "--id", "z",
+         "--cpu-limit", "0.0001"]
+    )
+    assert rc == 1
