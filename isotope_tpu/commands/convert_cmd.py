@@ -42,6 +42,23 @@ def register(sub) -> None:
     )
     gv.set_defaults(func=run_graphviz)
 
+    sec = sub.add_parser(
+        "security-policies",
+        help="generate large-scale AuthorizationPolicy / PeerAuthentication"
+             " / RequestAuthentication manifests from a JSON config "
+             "(perf/benchmark/security/generate_policies parity)",
+    )
+    sec.add_argument(
+        "config", nargs="?",
+        help="JSON config (README 'Config file' schema); default: "
+             "empty config",
+    )
+    sec.add_argument("-o", "--output",
+                     help="manifest output file (default: stdout)")
+    sec.add_argument("--token-out", metavar="FILE",
+                     help="write the signed bearer token here")
+    sec.set_defaults(func=run_security)
+
 
 def run_kubernetes(args) -> int:
     with open(args.topology) as f:
@@ -67,4 +84,31 @@ def run_graphviz(args) -> int:
             f.write(dot)
     else:
         sys.stdout.write(dot)
+    return 0
+
+
+def run_security(args) -> int:
+    from isotope_tpu.convert.security import (
+        SecurityPolicyConfig,
+        generate_policies,
+    )
+
+    if args.config:
+        with open(args.config) as f:
+            cfg = SecurityPolicyConfig.from_json(f.read())
+    else:
+        cfg = SecurityPolicyConfig()
+    manifests, token = generate_policies(cfg)
+    if args.output:
+        with open(args.output, "w") as f:
+            f.write(manifests)
+    else:
+        sys.stdout.write(manifests)
+    if args.token_out:
+        if token is None:
+            print("no RequestAuthentication policies: no token generated",
+                  file=sys.stderr)
+        else:
+            with open(args.token_out, "w") as f:
+                f.write(token)
     return 0

@@ -87,6 +87,10 @@ def register(sub) -> None:
     w.add_argument("--fresh", action="store_true",
                    help="ignore an existing checkpoint and rerun "
                         "everything (default: resume a killed sweep)")
+    w.add_argument("--profile", metavar="DIR",
+                   help="capture a jax.profiler trace per run into "
+                        "DIR/<label>/ (the reference's per-run flame "
+                        "capture, runner.py:405-417)")
     w.set_defaults(func=run_sweep)
 
     p = sub.add_parser(
@@ -271,6 +275,7 @@ def run_sweep(args) -> int:
         out_dir=args.out,
         progress=lambda label: print(f"running {label}", file=sys.stderr),
         resume=not args.fresh,
+        profile_dir=args.profile,
     )
     discarded = [r.label for r in results if r.window.discarded]
     print(

@@ -17,6 +17,7 @@ persistent disk + raw Fortio JSONs copied off-pod
 """
 from __future__ import annotations
 
+import contextlib
 import dataclasses
 import hashlib
 import json
@@ -188,7 +189,12 @@ def run_experiment(
     out_dir: Optional[str] = None,
     progress=None,
     resume: bool = True,
+    profile_dir: Optional[str] = None,
 ) -> List[RunResult]:
+    """``profile_dir`` captures a ``jax.profiler`` trace per executed run
+    into ``<profile_dir>/<label>/`` — the analogue of the reference's
+    per-run ``perf record`` flame capture (runner.py:405-417), readable
+    in TensorBoard/XProf."""
     results: List[RunResult] = []
     key = jax.random.PRNGKey(config.seed)
     mesh_svc = max(config.mesh_svc, 1)
@@ -252,15 +258,24 @@ def run_experiment(
                         load.kind == OPEN_LOOP
                         or load.connections % sharded.n_shards == 0
                     )
-                    if use_sharded:
-                        summary = sharded.run(
-                            load, n, run_key, block_size=block, trim=True
+                    if profile_dir is not None:
+                        prof_ctx = jax.profiler.trace(
+                            str(pathlib.Path(profile_dir) / label)
                         )
                     else:
-                        summary = sim.run_summary(
-                            load, n, run_key, block_size=block,
-                            collector=topo.collector, trim=True,
-                        )
+                        prof_ctx = contextlib.nullcontext()
+                    with prof_ctx:
+                        if use_sharded:
+                            summary = sharded.run(
+                                load, n, run_key, block_size=block,
+                                trim=True,
+                            )
+                        else:
+                            summary = sim.run_summary(
+                                load, n, run_key, block_size=block,
+                                collector=topo.collector, trim=True,
+                            )
+                        jax.block_until_ready(summary.count)
                     doc = fortio_result_from_summary(
                         summary, load, labels=label,
                         response_size_bytes=topo.entry_response_size,

@@ -155,3 +155,21 @@ def test_heavy_tail_toml_plumbing(tmp_path):
                              service_time_param=2.0, num_requests=500))
     )
     assert results and results[0].flat["p50"] > 0
+
+
+def test_sweep_profile_captures_traces(tmp_path):
+    import glob
+
+    from isotope_tpu.runner import load_toml, run_experiment
+
+    cfg = small_toml(tmp_path, num_requests=500)
+    prof = tmp_path / "prof"
+    run_experiment(load_toml(cfg), profile_dir=str(prof))
+    # one trace directory per run, each with an xplane dump
+    runs = sorted(p.name for p in prof.iterdir())
+    assert runs == [
+        "canonical_istio_500qps_8c", "canonical_none_500qps_8c"
+    ]
+    for r in runs:
+        assert glob.glob(str(prof / r / "**" / "*.xplane.pb"),
+                         recursive=True)

@@ -1,0 +1,184 @@
+"""Security policy generator (generate_policies parity)."""
+import base64
+import json
+
+import pytest
+import yaml
+
+from isotope_tpu import cli
+from isotope_tpu.convert.security import (
+    AuthZ,
+    RequestAuthN,
+    SecurityPolicyConfig,
+    generate_policies,
+)
+
+CONFIG_JSON = """
+{
+  "authZ": {
+    "action": "ALLOW",
+    "numPolicies": 2,
+    "numPaths": 3,
+    "numSourceIP": 1,
+    "numValues": 2,
+    "numRequestPrincipals": 2
+  },
+  "namespace": "twopods-istio",
+  "peerAuthN": {"mtlsMode": "STRICT", "numPolicies": 1},
+  "requestAuthN": {"numPolicies": 1, "numJwks": 2}
+}
+"""
+
+
+def test_config_schema_round_trip():
+    cfg = SecurityPolicyConfig.from_json(CONFIG_JSON)
+    assert cfg.authz.action == "ALLOW"
+    assert cfg.authz.num_policies == 2
+    assert cfg.authz.num_paths == 3
+    assert cfg.peer_authn.mtls_mode == "STRICT"
+    assert cfg.request_authn.num_jwks == 2
+
+
+def test_generated_manifests_shapes():
+    cfg = SecurityPolicyConfig.from_json(CONFIG_JSON)
+    text, token = generate_policies(cfg)
+    docs = list(yaml.safe_load_all(text))
+    kinds = [d["kind"] for d in docs]
+    assert kinds.count("AuthorizationPolicy") == 2
+    assert kinds.count("PeerAuthentication") == 1
+    assert kinds.count("RequestAuthentication") == 1
+
+    authz = docs[0]
+    (rule,) = authz["spec"]["rules"]
+    assert authz["spec"]["action"] == "ALLOW"
+    # generate.go's synthetic values, verbatim
+    (to,) = rule["to"]
+    assert to["operation"]["paths"] == [
+        "/invalid-path-0", "/invalid-path-1", "/invalid-path-2"
+    ]
+    ips = rule["from"][0]["source"]["ipBlocks"]
+    assert ips == ["0.0.0.0"]
+    # only the LAST request principal is valid (generate.go:119-126)
+    rp = rule["from"][1]["source"]["requestPrincipals"]
+    assert rp == ["invalid-issuer/subject", "issuer-2/subject"]
+    # ALLOW puts "admin" last in the condition values (generate.go:55-70)
+    (when,) = rule["when"]
+    assert when["key"] == "request.headers[x-token]"
+    assert when["values"] == ["guest", "admin"]
+
+    ra = docs[-1]
+    rules = ra["spec"]["jwtRules"]
+    assert [r["issuer"] for r in rules] == ["issuer-1", "issuer-2"]
+    assert token is not None
+
+
+def test_token_verifies_against_jwks():
+    from cryptography.hazmat.primitives import hashes
+    from cryptography.hazmat.primitives.asymmetric import (
+        padding,
+        rsa,
+    )
+
+    cfg = SecurityPolicyConfig(
+        request_authn=RequestAuthN(num_policies=1, num_jwks=1)
+    )
+    text, token = generate_policies(cfg)
+    (doc,) = list(yaml.safe_load_all(text))
+    jwks = json.loads(doc["spec"]["jwtRules"][0]["jwks"])
+    (jwk,) = jwks["keys"]
+
+    def unb64(s):
+        return base64.urlsafe_b64decode(s + "=" * (-len(s) % 4))
+
+    n = int.from_bytes(unb64(jwk["n"]), "big")
+    e = int.from_bytes(unb64(jwk["e"]), "big")
+    pub = rsa.RSAPublicNumbers(e, n).public_key()
+
+    header, payload, sig = token.split(".")
+    pub.verify(  # raises on mismatch
+        unb64(sig), f"{header}.{payload}".encode(),
+        padding.PKCS1v15(), hashes.SHA256(),
+    )
+    claims = json.loads(unb64(payload))
+    assert claims == {"iss": "issuer-1", "sub": "subject"}
+
+
+def test_invalid_token_does_not_verify():
+    from cryptography.hazmat.primitives import hashes
+    from cryptography.hazmat.primitives.asymmetric import padding, rsa
+    from cryptography.exceptions import InvalidSignature
+
+    cfg = SecurityPolicyConfig(
+        request_authn=RequestAuthN(
+            num_policies=1, num_jwks=1, invalid_token=True
+        )
+    )
+    text, token = generate_policies(cfg)
+    (doc,) = list(yaml.safe_load_all(text))
+    jwk = json.loads(doc["spec"]["jwtRules"][0]["jwks"])["keys"][0]
+
+    def unb64(s):
+        return base64.urlsafe_b64decode(s + "=" * (-len(s) % 4))
+
+    pub = rsa.RSAPublicNumbers(
+        int.from_bytes(unb64(jwk["e"]), "big"),
+        int.from_bytes(unb64(jwk["n"]), "big"),
+    ).public_key()
+    header, payload, sig = token.split(".")
+    with pytest.raises(InvalidSignature):
+        pub.verify(
+            unb64(sig), f"{header}.{payload}".encode(),
+            padding.PKCS1v15(), hashes.SHA256(),
+        )
+
+
+def test_dry_run_annotation():
+    cfg = SecurityPolicyConfig(authz=AuthZ(num_policies=1, dry_run=True))
+    text, _ = generate_policies(cfg)
+    (doc,) = list(yaml.safe_load_all(text))
+    assert doc["metadata"]["annotations"] == {"istio.io/dry-run": "true"}
+
+
+def test_cli_security_policies(tmp_path, capsys):
+    cfg = tmp_path / "c.json"
+    cfg.write_text(CONFIG_JSON)
+    out = tmp_path / "policies.yaml"
+    tok = tmp_path / "token.txt"
+    rc = cli.main(
+        ["security-policies", str(cfg), "-o", str(out),
+         "--token-out", str(tok)]
+    )
+    assert rc == 0
+    assert len(list(yaml.safe_load_all(out.read_text()))) == 4
+    assert tok.read_text().count(".") == 2
+
+
+def test_token_issuer_matches_rules_when_numjwks_zero():
+    # numJwks omitted: jwtRules carry issuer-1, the token must too
+    cfg = SecurityPolicyConfig(
+        authz=AuthZ(num_policies=1, num_request_principals=2),
+        request_authn=RequestAuthN(num_policies=1),
+    )
+    text, token = generate_policies(cfg)
+    docs = list(yaml.safe_load_all(text))
+    rules = docs[-1]["spec"]["jwtRules"]
+    assert [r["issuer"] for r in rules] == ["issuer-1"]
+    payload = token.split(".")[1]
+    claims = json.loads(
+        base64.urlsafe_b64decode(payload + "=" * (-len(payload) % 4))
+    )
+    assert claims["iss"] == "issuer-1"
+    rp = docs[0]["spec"]["rules"][0]["from"][0]["source"][
+        "requestPrincipals"
+    ]
+    assert rp[-1] == "issuer-1/subject"
+
+
+def test_jwks_base64url_is_unpadded():
+    cfg = SecurityPolicyConfig(
+        request_authn=RequestAuthN(num_policies=1, num_jwks=1)
+    )
+    text, _ = generate_policies(cfg)
+    (doc,) = list(yaml.safe_load_all(text))
+    jwk = json.loads(doc["spec"]["jwtRules"][0]["jwks"])["keys"][0]
+    assert "=" not in jwk["n"] and "=" not in jwk["e"]
