@@ -7,6 +7,7 @@ def register_all(sub) -> None:
 
     convert_cmd.register(sub)
     generate_cmd.register(sub)
+    generate_cmd.register_pilot(sub)
     report_cmd.register(sub)
     # simulate_cmd/suite_cmd defer their jax-dependent imports into the
     # handlers (so --help stays instant); a jax-less environment gets a

@@ -31,6 +31,11 @@ def register(sub) -> None:
         "--num-services", type=int, default=None,
         help="cap the tree at exactly this many services",
     )
+    tree.add_argument(
+        "--instances", type=int, default=1,
+        help="replicate the topology N times with namespaced service "
+             "names (perf/load/common.sh's N-namespace fan-out)",
+    )
     tree.add_argument("-o", "--output", default=None)
     tree.set_defaults(func=run_tree)
 
@@ -48,6 +53,11 @@ def register(sub) -> None:
     real.add_argument("--response-size", type=int, default=128)
     real.add_argument("--num-replicas", type=int, default=1)
     real.add_argument("--seed", type=int, default=0)
+    real.add_argument(
+        "--instances", type=int, default=1,
+        help="replicate the topology N times with namespaced service "
+             "names (perf/load/common.sh's N-namespace fan-out)",
+    )
     real.add_argument("-o", "--output", default=None)
     real.set_defaults(func=run_realistic)
 
@@ -63,29 +73,68 @@ def _emit(doc: dict, output) -> int:
 
 
 def run_tree(args) -> int:
+    doc = generators.tree_topology(
+        num_levels=args.levels,
+        num_branches=args.branches,
+        request_size=args.request_size,
+        response_size=args.response_size,
+        num_replicas=args.num_replicas,
+        sleep=args.sleep,
+        num_services=args.num_services,
+    )
     return _emit(
-        generators.tree_topology(
-            num_levels=args.levels,
-            num_branches=args.branches,
-            request_size=args.request_size,
-            response_size=args.response_size,
-            num_replicas=args.num_replicas,
-            sleep=args.sleep,
-            num_services=args.num_services,
-        ),
-        args.output,
+        generators.replicate_topology(doc, args.instances), args.output
     )
 
 
 def run_realistic(args) -> int:
-    return _emit(
-        generators.realistic_topology(
-            num_services=args.services,
-            archetype=args.archetype,
-            request_size=args.request_size,
-            response_size=args.response_size,
-            num_replicas=args.num_replicas,
-            seed=args.seed,
-        ),
-        args.output,
+    doc = generators.realistic_topology(
+        num_services=args.services,
+        archetype=args.archetype,
+        request_size=args.request_size,
+        response_size=args.response_size,
+        num_replicas=args.num_replicas,
+        seed=args.seed,
     )
+    return _emit(
+        generators.replicate_topology(doc, args.instances), args.output
+    )
+
+
+def register_pilot(sub) -> None:
+    p = sub.add_parser(
+        "pilot-load",
+        help="model config-push convergence vs ServiceEntry count "
+             "(perf/load/pilot/load_test.py analogue)",
+    )
+    p.add_argument("--entries", default="10,100,1000",
+                   help="comma-separated ServiceEntry counts")
+    p.add_argument("--endpoints", type=int, default=10,
+                   help="endpoints per entry")
+    p.add_argument("--proxies", type=int, default=100,
+                   help="number of sidecars receiving pushes")
+    p.add_argument("--push-throttle", type=int, default=100)
+    p.add_argument("--seed", type=int, default=0)
+    p.set_defaults(func=run_pilot_load)
+
+
+def run_pilot_load(args) -> int:
+    import json as _json
+    import sys as _sys
+
+    from isotope_tpu.sim.controlplane import (
+        PilotModel,
+        convergence_sweep,
+    )
+
+    model = PilotModel(push_throttle=args.push_throttle)
+    rows = convergence_sweep(
+        model,
+        [int(x) for x in args.entries.split(",") if x.strip()],
+        args.endpoints,
+        args.proxies,
+        seed=args.seed,
+    )
+    for row in rows:
+        _sys.stdout.write(_json.dumps(row) + "\n")
+    return 0

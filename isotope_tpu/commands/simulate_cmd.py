@@ -45,6 +45,9 @@ def register(sub) -> None:
                    help='per-request CPU demand, e.g. "77us"')
     s.add_argument("--seed", type=int, default=0)
     s.add_argument("--labels", default="")
+    s.add_argument("--entry", default=None,
+                   help="entrypoint service (for multi-instance "
+                        "topologies; default: the first entrypoint)")
     s.add_argument("--flat", action="store_true",
                    help="print the flattened single-line record instead "
                         "of the full Fortio JSON")
@@ -151,6 +154,7 @@ def run_simulate(args) -> int:
         seed=args.seed,
         labels=args.labels,
         service_time=args.service_time,
+        entry=args.entry,
         **extra,
     )
     (result,) = run_experiment(config)

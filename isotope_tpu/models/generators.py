@@ -198,3 +198,57 @@ def realistic_topology(
         },
         "services": services,
     }
+
+
+def replicate_topology(
+    doc: dict,
+    instances: int,
+    prefix: str = "ns",
+) -> dict:
+    """N disjoint copies of a topology in one graph — the shape of the
+    reference's large-scale load test (perf/load/common.sh:68-90: N
+    namespaces each running its own service-graph instance with its own
+    load client).  Service ``svc`` of instance ``i`` becomes
+    ``<prefix><i>-svc``; every instance keeps its own entrypoint, so a
+    driver can target any instance (``compile_graph(entry=...)``) or
+    deploy all of them (the converter emits every service).
+    """
+    if instances < 1:
+        raise ValueError("instances must be >= 1")
+    if instances == 1:
+        return doc
+
+    def rename(name: str, i: int) -> str:
+        return f"{prefix}{i}-{name}"
+
+    def rewrite_command(cmd, i):
+        if isinstance(cmd, list):
+            return [rewrite_command(c, i) for c in cmd]
+        if isinstance(cmd, dict) and "call" in cmd:
+            call = cmd["call"]
+            if isinstance(call, dict):
+                call = dict(call, service=rename(call["service"], i))
+            else:
+                call = rename(call, i)
+            return {**cmd, "call": call}
+        return cmd
+
+    # a defaults-level script would be inherited with UN-prefixed call
+    # targets; materialize it per instance instead
+    defaults = dict(doc.get("defaults", {}))
+    default_script = defaults.pop("script", None)
+
+    services = []
+    for i in range(instances):
+        for svc in doc.get("services", []):
+            copy = dict(svc, name=rename(svc["name"], i))
+            script = svc.get("script", default_script)
+            if script is not None:
+                copy["script"] = [
+                    rewrite_command(c, i) for c in script
+                ]
+            services.append(copy)
+    out = dict(doc, services=services)
+    if "defaults" in doc:
+        out["defaults"] = defaults
+    return out

@@ -124,6 +124,9 @@ class ExperimentConfig:
     labels: str = ""
     chaos: Tuple[ChaosEvent, ...] = ()
     churn: Tuple[TrafficSplit, ...] = ()
+    # entrypoint override: pick one instance of a multi-entry topology
+    # (replicate_topology); None = the graph's first entrypoint
+    entry: Optional[str] = None
 
     def sim_params(self) -> SimParams:
         return SimParams(
@@ -275,4 +278,5 @@ def load_toml(path) -> ExperimentConfig:
         labels=doc.get("labels", ""),
         chaos=tuple(chaos),
         churn=tuple(churn),
+        entry=sim.get("entry"),
     )

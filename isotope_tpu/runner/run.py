@@ -87,11 +87,12 @@ class _LazyTopology:
     def compiled(self):
         if self._compiled is None:
             graph = ServiceGraph.from_yaml_file(self.path)
-            eps = graph.entrypoints()
-            self._entry_resp = (
-                float(int(eps[0].response_size)) if eps else 0.0
+            self._compiled = compile_graph(graph, entry=self.config.entry)
+            self._entry_resp = float(
+                self._compiled.services.response_size[
+                    self._compiled.entry_service
+                ]
             )
-            self._compiled = compile_graph(graph)
             self._collector = MetricsCollector(self._compiled)
         return self._compiled
 

@@ -1,0 +1,122 @@
+"""Control-plane config-push convergence model (pilot load test).
+
+The reference's pilot scale test (perf/load/pilot/load_test.py) creates
+N ServiceEntries x M endpoints and measures how long until every Envoy's
+cluster count reflects them (:33-44 polls config dumps) — convergence
+time as a function of config size and fleet size.
+
+The simulation model is the xDS push pipeline as a queueing system:
+
+- a debounce window, then pilot generates the pushed config (cost grows
+  with N x M — endpoints dominate memory/CPU);
+- pushes fan out to P proxies through a bounded concurrent-push budget
+  (istiod's PILOT_PUSH_THROTTLE), each push taking a sampled
+  transfer+ACK latency that also grows with config size;
+- a proxy has converged when its push ACKs.  Convergence quantiles are
+  read off the completion times, vectorized with ``lax.scan`` over the
+  push queue (the greedy earliest-free-channel assignment).
+"""
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@dataclasses.dataclass(frozen=True)
+class PilotModel:
+    """Pilot/istiod push-pipeline parameters."""
+
+    debounce_s: float = 0.1            # PILOT_DEBOUNCE_AFTER
+    push_throttle: int = 100           # concurrent pushes
+    gen_s_per_endpoint: float = 2e-6   # config generation CPU
+    push_base_s: float = 5e-3          # per-push floor (RTT + ACK)
+    push_s_per_endpoint: float = 1e-6  # transfer cost per endpoint
+    push_jitter: float = 0.3           # lognormal sigma on push latency
+
+    def __post_init__(self):
+        if self.push_throttle <= 0:
+            raise ValueError("push_throttle must be positive")
+
+
+@dataclasses.dataclass(frozen=True)
+class ConvergenceResult:
+    ack_times_s: np.ndarray  # (P,) per-proxy convergence times
+
+    def quantile_s(self, q) -> np.ndarray:
+        return np.quantile(self.ack_times_s, q)
+
+    @property
+    def max_s(self) -> float:
+        return float(self.ack_times_s.max())
+
+    def converged_fraction(self, t: float) -> float:
+        return float((self.ack_times_s <= t).mean())
+
+
+def push_convergence(
+    model: PilotModel,
+    num_entries: int,
+    endpoints_per_entry: int,
+    num_proxies: int,
+    key=None,
+) -> ConvergenceResult:
+    """Convergence times for one config push to ``num_proxies`` Envoys."""
+    if num_proxies <= 0:
+        raise ValueError("num_proxies must be positive")
+    endpoints = num_entries * endpoints_per_entry
+    ready = model.debounce_s + endpoints * model.gen_s_per_endpoint
+    mean_push = model.push_base_s + endpoints * model.push_s_per_endpoint
+
+    if key is None:
+        key = jax.random.PRNGKey(0)
+    sigma = model.push_jitter
+    z = jax.random.normal(key, (num_proxies,))
+    # lognormal with the configured mean
+    durations = mean_push * jnp.exp(sigma * z - 0.5 * sigma * sigma)
+
+    c = min(model.push_throttle, num_proxies)
+
+    def assign(free, dur):
+        # greedy: the next push takes the earliest-free channel
+        idx = jnp.argmin(free)
+        end = jnp.maximum(free[idx], ready) + dur
+        return free.at[idx].set(end), end
+
+    free0 = jnp.full((c,), ready, jnp.float32)
+    _, acks = jax.lax.scan(assign, free0, durations)
+    return ConvergenceResult(
+        ack_times_s=np.asarray(acks, np.float64)
+    )
+
+
+def convergence_sweep(
+    model: PilotModel,
+    entry_counts,
+    endpoints_per_entry: int,
+    num_proxies: int,
+    seed: int = 0,
+):
+    """The reference test's measurement: convergence vs ServiceEntry
+    count (load_test.py's N axis).  Returns rows of p50/p99/max."""
+    rows = []
+    key = jax.random.PRNGKey(seed)
+    for i, n in enumerate(entry_counts):
+        res = push_convergence(
+            model, n, endpoints_per_entry, num_proxies,
+            key=jax.random.fold_in(key, i),
+        )
+        p50, p99 = res.quantile_s([0.5, 0.99])
+        rows.append(
+            {
+                "num_entries": int(n),
+                "endpoints": int(n * endpoints_per_entry),
+                "proxies": int(num_proxies),
+                "p50_s": float(p50),
+                "p99_s": float(p99),
+                "max_s": res.max_s,
+            }
+        )
+    return rows

@@ -1,0 +1,65 @@
+"""Pilot config-push convergence model (load_test.py analogue)."""
+import json
+
+import numpy as np
+import pytest
+
+from isotope_tpu import cli
+from isotope_tpu.sim.controlplane import (
+    PilotModel,
+    convergence_sweep,
+    push_convergence,
+)
+
+
+def test_deterministic_closed_form():
+    # no jitter: batches of push_throttle finish in lockstep
+    m = PilotModel(push_throttle=4, push_jitter=0.0,
+                   debounce_s=0.1, gen_s_per_endpoint=0.0,
+                   push_base_s=1.0, push_s_per_endpoint=0.0)
+    res = push_convergence(m, 1, 1, 10)
+    # 10 proxies over 4 channels: batches end at 1.1, 2.1, 3.1
+    want = [1.1] * 4 + [2.1] * 4 + [3.1] * 2
+    np.testing.assert_allclose(np.sort(res.ack_times_s), want, rtol=1e-6)
+    assert res.converged_fraction(1.2) == pytest.approx(0.4)
+    assert res.converged_fraction(3.2) == 1.0
+
+
+def test_convergence_grows_with_config_and_fleet():
+    m = PilotModel()
+    small = push_convergence(m, 10, 10, 50)
+    big_cfg = push_convergence(m, 1000, 10, 50)
+    big_fleet = push_convergence(m, 10, 10, 5000)
+    assert big_cfg.max_s > small.max_s
+    assert big_fleet.max_s > small.max_s
+    # throttle binds: more concurrency converges faster
+    wide = PilotModel(push_throttle=1000)
+    assert (
+        push_convergence(wide, 10, 10, 5000).max_s < big_fleet.max_s
+    )
+
+
+def test_sweep_rows_monotone():
+    rows = convergence_sweep(PilotModel(), [10, 100, 1000], 10, 100)
+    assert [r["num_entries"] for r in rows] == [10, 100, 1000]
+    p99s = [r["p99_s"] for r in rows]
+    assert p99s[0] < p99s[1] < p99s[2]
+
+
+def test_cli_pilot_load(capsys):
+    rc = cli.main(
+        ["pilot-load", "--entries", "10,100", "--proxies", "20"]
+    )
+    assert rc == 0
+    lines = capsys.readouterr().out.strip().splitlines()
+    rows = [json.loads(line) for line in lines]
+    assert len(rows) == 2
+    assert rows[0]["proxies"] == 20
+    assert rows[1]["p99_s"] >= rows[0]["p50_s"]
+
+
+def test_validation():
+    with pytest.raises(ValueError):
+        PilotModel(push_throttle=0)
+    with pytest.raises(ValueError):
+        push_convergence(PilotModel(), 1, 1, 0)

@@ -101,3 +101,61 @@ def test_ba_parent_child_invariant_many_seeds():
             2000, 0.05, 0.01, np.random.default_rng(seed)
         )
         assert (e[:, 0] < e[:, 1]).all()
+
+
+def test_replicate_topology_instances():
+    import yaml as _yaml
+
+    from isotope_tpu.compiler import compile_graph
+    from isotope_tpu.models.generators import (
+        replicate_topology,
+        tree_topology,
+    )
+    from isotope_tpu.models.graph import ServiceGraph
+
+    doc = replicate_topology(tree_topology(num_levels=2, num_branches=2), 3)
+    g = ServiceGraph.decode(doc)
+    assert len(g.services) == 3 * 3
+    # each instance keeps its own entrypoint
+    eps = [s.name for s in g.entrypoints()]
+    assert eps == ["ns0-svc-0", "ns1-svc-0", "ns2-svc-0"]
+    # calls stay within the instance
+    c1 = compile_graph(g, entry="ns1-svc-0")
+    names = {c1.services.names[i] for i in set(c1.hop_service.tolist())}
+    assert names == {"ns1-svc-0", "ns1-svc-0-0", "ns1-svc-0-1"}
+    # round-trips as YAML
+    assert ServiceGraph.from_yaml(_yaml.safe_dump(doc))
+
+
+def test_replicate_identity_and_validation():
+    import pytest as _pytest
+
+    from isotope_tpu.models.generators import (
+        replicate_topology,
+        tree_topology,
+    )
+
+    doc = tree_topology(num_levels=2, num_branches=2)
+    assert replicate_topology(doc, 1) is doc
+    with _pytest.raises(ValueError):
+        replicate_topology(doc, 0)
+
+
+def test_replicate_materializes_defaults_script():
+    from isotope_tpu.models.generators import replicate_topology
+    from isotope_tpu.models.graph import ServiceGraph
+
+    doc = {
+        "defaults": {"script": [{"call": "leaf"}], "responseSize": 64},
+        "services": [
+            {"name": "root", "isEntrypoint": True},
+            {"name": "leaf", "script": []},
+        ],
+    }
+    out = replicate_topology(doc, 2)
+    g = ServiceGraph.decode(out)  # would raise on un-prefixed targets
+    assert "script" not in out["defaults"]
+    by_name = {s.name: s for s in g.services}
+    call = by_name["ns1-root"].script[0]
+    assert call.service_name == "ns1-leaf"
+    assert int(by_name["ns0-leaf"].response_size) == 64
