@@ -173,6 +173,7 @@ def compile_graph(
     hop_parent: List[int] = [-1]
     hop_depth: List[int] = [0]
     hop_step: List[int] = [-1]
+    hop_attempt: List[int] = [0]
     hop_send_prob: List[float] = [1.0]
     hop_request_size: List[float] = [0.0]
     hop_reach: List[float] = [1.0]
@@ -214,6 +215,7 @@ def compile_graph(
                         hop_parent.append(h)
                         hop_depth.append(hop_depth[h] + 1)
                         hop_step.append(step_idx)
+                        hop_attempt.append(a)
                         hop_send_prob.append(call.send_prob)
                         hop_request_size.append(call.size)
                         hop_reach.append(
@@ -259,6 +261,7 @@ def compile_graph(
         hop_parent=np.asarray(hop_parent, np.int32),
         hop_depth=np.asarray(hop_depth, np.int32),
         hop_step=np.asarray(hop_step, np.int32),
+        hop_attempt=np.asarray(hop_attempt, np.int32),
         hop_send_prob=np.asarray(hop_send_prob, np.float32),
         hop_request_size=np.asarray(hop_request_size, np.float32),
         hop_reach=np.asarray(hop_reach, np.float64),

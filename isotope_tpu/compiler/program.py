@@ -111,6 +111,7 @@ class CompiledGraph:
     hop_parent: np.ndarray     # (H,) int32 — -1 for the root
     hop_depth: np.ndarray      # (H,) int32
     hop_step: np.ndarray       # (H,) int32 — step index in parent's script
+    hop_attempt: np.ndarray    # (H,) int32 — retry attempt index (0 first)
     hop_send_prob: np.ndarray  # (H,) f32 — this hop's own coin, [0, 1]
     hop_request_size: np.ndarray  # (H,) f32 — bytes sent to the hop
     # P(hop is reached) = prod over path of send_prob * (1 - parent error

