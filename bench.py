@@ -70,8 +70,11 @@ def main() -> None:
     from isotope_tpu.sim.engine import Simulator
 
     on_tpu = jax.devices()[0].platform != "cpu"
-    blk = 65_536 if on_tpu else 4_096
-    blocks = 8 if on_tpu else 2
+    # Measured per-topology sweet spots (r4 block sweep): per-dispatch
+    # overhead through the tunneled chip dominates small blocks, so each
+    # workload runs at ~2*16M elements / H per (block, H) tensor.
+    blk = 262_144 if on_tpu else 4_096
+    blocks = 4 if on_tpu else 2
     open_load = LoadModel(kind="open", qps=100_000.0)
 
     tree = Simulator(_flagship())
@@ -83,7 +86,7 @@ def main() -> None:
             doc = yaml.safe_load(f)
         svc1000 = Simulator(compile_graph(ServiceGraph.decode(doc)))
         extra["svc1000"] = _rate(
-            svc1000, LoadModel(kind="open", qps=10_000.0), 131_072, 8_192
+            svc1000, LoadModel(kind="open", qps=10_000.0), 65_536, 16_384
         )
 
         real = Simulator(
@@ -93,7 +96,8 @@ def main() -> None:
                 )
             )
         )
-        extra["realistic50"] = _rate(real, open_load, blk * 4, blk)
+        blk_real = real.default_block_size()
+        extra["realistic50"] = _rate(real, open_load, blk_real * 4, blk_real)
 
         # BASELINE configs[3]: 10k services, realistic shape (deep
         # sequential scripts — the unfavorable geometry)

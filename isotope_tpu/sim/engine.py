@@ -109,6 +109,15 @@ class _Level:
     att_child: np.ndarray       # (maxA, K) i32 — static gather indices
     att_valid: np.ndarray       # (maxA, K) bool — static masks
     child_churn_entry: Optional[np.ndarray] = None  # (C,) i32 static
+    # -- static structure flags (trace-time specialization) ---------------
+    # single-attempt levels where call k's only child is child k: the
+    # attempt loop degenerates to elementwise ops (no scatters)
+    ident_attempts: bool = False
+    # any call with a finite timeout (else timeouts can't fire)
+    finite_timeout: bool = False
+    # c when call_seg == repeat(arange(size*pmax), c): the per-step
+    # aggregation is a reshape-reduce instead of a scatter
+    uniform_calls: Optional[int] = None
 
     @property
     def num_children(self) -> int:
@@ -121,6 +130,29 @@ class _Level:
     @property
     def max_attempts(self) -> int:
         return self.att_child.shape[0]
+
+
+def _call_outcome(t, timeout, down_child):
+    """(transport_failure, duration) of one call attempt.
+
+    ``t`` is the attempt's would-be round trip; a finite ``timeout``
+    clamps it and fails the call past it (executable.go's http client
+    timeout); a down callee (``down_child``) transport-fails at ~zero
+    cost — the connection is refused, nothing runs.  ``None`` inputs
+    mean the failure mode is statically impossible, and a ``None``
+    transport result means no transport failure can occur at all.
+    """
+    transport = None
+    dur = t
+    if timeout is not None:
+        transport = t > timeout
+        dur = jnp.minimum(t, timeout)
+    if down_child is not None:
+        transport = (
+            down_child if transport is None else (down_child | transport)
+        )
+        dur = jnp.where(down_child, 0.0, dur)
+    return transport, dur
 
 
 class Simulator:
@@ -301,6 +333,18 @@ class Simulator:
         # payload-free entry one-way: root start offset + refused-conn cost
         self._entry_one_way = net.entry_one_way(0.0)
 
+        # -- static RNG elimination -----------------------------------------
+        # The reference's hot path only flips coins that can land both ways:
+        # a topology with no sub-1 send probabilities needs no send RNG, one
+        # with no errorRate needs no error RNG (executable.go:84-90 — the
+        # coins exist, but p=0/p=100 make them deterministic).  Skipping the
+        # (N, H) draws at trace time removes whole threefry invocations and
+        # lets the downstream boolean algebra constant-fold.
+        self._need_send = bool(churn) or bool(
+            (compiled.hop_send_prob[1:] < 1.0).any()
+        )
+        self._need_err = bool((t.error_rate[hs] > 0.0).any())
+
         levels: List[_Level] = []
         offset = 0
         for lvl in compiled.levels:
@@ -315,6 +359,24 @@ class Simulator:
             child_step = lvl.child_seg % compiled.max_steps
             call_local = lvl.call_seg // compiled.max_steps
             call_step = lvl.call_seg % compiled.max_steps
+            n_calls = len(lvl.call_seg)
+            ident = (
+                lvl.att_child.shape[0] == 1
+                and n_calls == len(cids)
+                and bool(lvl.att_valid.all())
+                and np.array_equal(
+                    lvl.att_child[0], np.arange(n_calls, dtype=np.int32)
+                )
+            )
+            call_seg_p = call_local * pmax + call_step
+            slots = lvl.num_hops * pmax  # > 0: every level has >= 1 hop
+            uniform: Optional[int] = None
+            if n_calls > 0 and n_calls % slots == 0:
+                c = n_calls // slots
+                if np.array_equal(
+                    call_seg_p, np.repeat(np.arange(slots), c)
+                ):
+                    uniform = c
             levels.append(
                 _Level(
                     offset=offset,
@@ -334,7 +396,7 @@ class Simulator:
                     child_send_prob=jnp.asarray(
                         compiled.hop_send_prob[cids]
                     ),
-                    call_seg=jnp.asarray(call_local * pmax + call_step),
+                    call_seg=jnp.asarray(call_seg_p),
                     call_step=jnp.asarray(call_step),
                     call_timeout=jnp.asarray(lvl.call_timeout),
                     att_child=lvl.att_child,
@@ -342,6 +404,11 @@ class Simulator:
                     child_churn_entry=(
                         self._hop_churn_entry[cids] if churn else None
                     ),
+                    ident_attempts=ident,
+                    finite_timeout=bool(
+                        np.isfinite(lvl.call_timeout).any()
+                    ),
+                    uniform_calls=uniform,
                 )
             )
             offset += lvl.num_hops
@@ -591,13 +658,15 @@ class Simulator:
             jnp.float32(window[0]), jnp.float32(window[1]),
         )
 
-    def default_block_size(self, budget_elems: int = 16_777_216) -> int:
+    def default_block_size(self, budget_elems: int = 33_554_432) -> int:
         """A block size keeping each (block, H) event tensor near
-        ``budget_elems`` elements (~64 MiB at f32) — the HBM knob of the
-        scan path.  bench.py's measured sweet spot: 65536 blocks for the
-        121-hop tree, 8192 for the ~2000-hop fan-out."""
+        ``budget_elems`` elements (~128 MiB at f32) — the HBM knob of
+        the scan path.  Measured sweet spots on a v5e chip scale as
+        ~budget/H: 262k for the 121-hop tree, 16-32k for the 1000-hop
+        fan-out — big blocks amortize per-dispatch overhead, which
+        dominates small-H topologies."""
         h = max(self.compiled.num_hops, 1)
-        return int(max(256, min(65_536, budget_elems // h)))
+        return int(max(256, min(524_288, budget_elems // h)))
 
     def capacity_qps(self) -> float:
         """Saturation throughput: the bottleneck station's capacity."""
@@ -741,8 +810,14 @@ class Simulator:
              k_wait2) = jax.random.split(key, 6)
         else:
             k_send, k_err, k_wait_u, k_svc, k_arr = jax.random.split(key, 5)
-        u_send = jax.random.uniform(k_send, (n, H))
-        u_err = jax.random.uniform(k_err, (n, H))
+        # deterministic coins are not drawn (see __init__): the key split
+        # layout stays fixed so the OTHER streams are unchanged either way
+        u_send = (
+            jax.random.uniform(k_send, (n, H)) if self._need_send else None
+        )
+        u_err = (
+            jax.random.uniform(k_err, (n, H)) if self._need_err else None
+        )
         if self._copula_active:
             # Gaussian copula over sibling groups: exact U(0,1) marginals
             # (the M/M/k wait law is untouched), pairwise correlation r
@@ -828,7 +903,11 @@ class Simulator:
         if num_phases == 1:
             p_wait_nh = p_wait_ph[0][None, :]
             wait_rate_nh = wait_rate_ph[0][None, :]
-            down = jnp.broadcast_to(down_ph[0][None, :], (n, H))
+            down = (
+                jnp.broadcast_to(down_ph[0][None, :], (n, H))
+                if self.has_chaos
+                else None
+            )
         else:
             if P > 1:
                 chaos_idx = (
@@ -854,6 +933,8 @@ class Simulator:
             down = (
                 jnp.matmul(oh, down_ph.astype(jnp.float32), precision=hi)
                 > 0.5
+                if self.has_chaos
+                else None
             )
         wait = queueing.sample_wait_conditional(
             p_wait_nh, wait_rate_nh, u_wait
@@ -865,7 +946,10 @@ class Simulator:
 
         svc_time = self._sample_service_time(k_svc, (n, H))
 
-        err_coin = u_err < self._hop_err_rate  # (N, H)
+        # None == "statically no 500s" (all error rates are zero)
+        err_coin = (
+            u_err < self._hop_err_rate if u_err is not None else None
+        )  # (N, H) or None
 
         # ---- upward pass: outcomes + server-side durations ---------------
         # Processed deepest-first so every call site sees its callees'
@@ -877,6 +961,11 @@ class Simulator:
         #     that step (fail_step), a 500 does not (executable.go:132-143),
         #   - which attempt hops would actually run (``used``), and each
         #     attempt's time offset inside its step (for start times).
+        # ``None`` sentinels carry static knowledge through the sweep so
+        # impossible branches vanish from the compiled program entirely:
+        # err_lvls[d] is None when no hop can 500, fail_lvls[d] is None
+        # when no call can transport-fail, used_lvls[d] is None when every
+        # call is deterministically sent.
         lat_lvls: List[Optional[jax.Array]] = [None] * len(self._levels)
         err_lvls: List[Optional[jax.Array]] = [None] * len(self._levels)
         fail_lvls: List[Optional[jax.Array]] = [None] * len(self._levels)
@@ -885,123 +974,215 @@ class Simulator:
         for d in reversed(range(len(self._levels))):
             lvl = self._levels[d]
             sl = slice(lvl.offset, lvl.offset + lvl.size)
+            P = lvl.pmax
+            fail_step = None
             if lvl.num_children > 0:
                 nxt = self._levels[d + 1]
                 csl = slice(nxt.offset, nxt.offset + nxt.size)
                 C = lvl.num_children
-                # dummy column C absorbs invalid attempt slots
-                pad = lambda x: jnp.pad(x, ((0, 0), (0, 1)))  # noqa: E731
-                lat_child = pad(lat_lvls[d + 1])
-                err_child = pad(err_lvls[d + 1].astype(jnp.float32)) > 0
-                down_child = pad(down[:, csl].astype(jnp.float32)) > 0
-                rtt_child = jnp.pad(lvl.child_rtt, (0, 1))
+                child_err = err_lvls[d + 1]
+                if lvl.ident_attempts:
+                    # single attempt, call k <-> child k: the whole attempt
+                    # loop reduces to elementwise ops — no scatters
+                    tt = lvl.child_rtt + lat_lvls[d + 1]  # (N, C)
+                    down_child = down[:, csl] if down is not None else None
+                    transport_a, dur_a = _call_outcome(
+                        tt,
+                        lvl.call_timeout if lvl.finite_timeout else None,
+                        down_child,
+                    )
+                    if self._need_send:
+                        prob = lvl.child_send_prob
+                        if self._churn:
+                            prob = prob * churn_w[:, lvl.child_churn_entry]
+                        coin = u_send[:, csl] < prob  # (N, C)
+                        used_lvls[d] = coin
+                        dur_call = jnp.where(coin, dur_a, 0.0)
+                        # an unsent call cannot fail anything
+                        final_transport = (
+                            coin & transport_a
+                            if transport_a is not None
+                            else None
+                        )
+                    else:
+                        dur_call = dur_a
+                        final_transport = transport_a
+                    att_off = None
+                else:
+                    # general path: serial retry attempts.  dummy column C
+                    # absorbs invalid attempt slots
+                    pad = lambda x: jnp.pad(x, ((0, 0), (0, 1)))  # noqa: E731
+                    lat_child = pad(lat_lvls[d + 1])
+                    err_child = (
+                        pad(child_err.astype(jnp.float32)) > 0
+                        if child_err is not None
+                        else None
+                    )
+                    down_child = (
+                        pad(down[:, csl].astype(jnp.float32)) > 0
+                        if down is not None
+                        else None
+                    )
+                    rtt_child = jnp.pad(lvl.child_rtt, (0, 1))
 
-                a0 = lvl.att_child[0]  # (K,) attempt-0 local child index
-                prob = lvl.child_send_prob[a0]
-                if self._churn:
-                    # current schedule weight scales the send probability
-                    prob = prob * churn_w[:, lvl.child_churn_entry[a0]]
-                coin = u_send[:, csl][:, a0] < prob  # (N, K)
-                dur_call = jnp.zeros((n, lvl.num_calls))
-                final_transport = jnp.zeros((n, lvl.num_calls), bool)
-                used = jnp.zeros((n, C + 1), bool)
-                att_off = jnp.zeros((n, C + 1))
-                used_a = coin
-                for a in range(lvl.max_attempts):
-                    idx = lvl.att_child[a]       # (K,) in [0, C]
-                    valid = lvl.att_valid[a]     # (K,) static
-                    use = used_a & valid
-                    t = rtt_child[idx] + lat_child[:, idx]
-                    timed_out = t > lvl.call_timeout
-                    transport_a = down_child[:, idx] | timed_out
-                    failed_a = transport_a | err_child[:, idx]
-                    dur_a = jnp.where(
-                        down_child[:, idx],
-                        0.0,
-                        jnp.minimum(t, lvl.call_timeout),
+                    a0 = lvl.att_child[0]  # (K,) attempt-0 local child idx
+                    if self._need_send:
+                        prob = lvl.child_send_prob[a0]
+                        if self._churn:
+                            # current schedule weight scales the send prob
+                            prob = prob * churn_w[
+                                :, lvl.child_churn_entry[a0]
+                            ]
+                        coin = u_send[:, csl][:, a0] < prob  # (N, K)
+                    else:
+                        coin = jnp.ones((n, lvl.num_calls), bool)
+                    transportable = (
+                        down_child is not None or lvl.finite_timeout
                     )
-                    att_off = att_off.at[:, idx].set(
-                        jnp.where(use, dur_call, 0.0)
+                    dur_call = jnp.zeros((n, lvl.num_calls))
+                    final_transport = (
+                        jnp.zeros((n, lvl.num_calls), bool)
+                        if transportable
+                        else None
                     )
-                    used = used.at[:, idx].set(use)
-                    dur_call = dur_call + jnp.where(use, dur_a, 0.0)
-                    final_transport = jnp.where(
-                        use, transport_a, final_transport
-                    )
-                    used_a = use & failed_a
-                used_lvls[d] = used[:, :C]
+                    used = jnp.zeros((n, C + 1), bool)
+                    att_off = jnp.zeros((n, C + 1))
+                    used_a = coin
+                    for a in range(lvl.max_attempts):
+                        idx = lvl.att_child[a]       # (K,) in [0, C]
+                        valid = lvl.att_valid[a]     # (K,) static
+                        use = used_a & valid
+                        t = rtt_child[idx] + lat_child[:, idx]
+                        transport_a, dur_a = _call_outcome(
+                            t,
+                            lvl.call_timeout if lvl.finite_timeout else None,
+                            down_child[:, idx]
+                            if down_child is not None
+                            else None,
+                        )
+                        failed_a = transport_a
+                        if err_child is not None:
+                            ec = err_child[:, idx]
+                            failed_a = (
+                                ec if failed_a is None else failed_a | ec
+                            )
+                        att_off = att_off.at[:, idx].set(
+                            jnp.where(use, dur_call, 0.0)
+                        )
+                        used = used.at[:, idx].set(use)
+                        dur_call = dur_call + jnp.where(use, dur_a, 0.0)
+                        if final_transport is not None:
+                            final_transport = jnp.where(
+                                use, transport_a, final_transport
+                            )
+                        used_a = (
+                            use & failed_a
+                            if failed_a is not None
+                            else jnp.zeros_like(use)
+                        )
+                    used_lvls[d] = used[:, :C]
 
-                P = lvl.pmax
-                agg = (
-                    jnp.zeros((n, lvl.size * P))
-                    .at[:, lvl.call_seg]
-                    .max(dur_call)
-                    .reshape(n, lvl.size, P)
-                )
+                # -- aggregate calls into (parent, step) slots -------------
+                if lvl.uniform_calls is not None:
+                    # call_seg == repeat(arange(size*P), c): reshape-reduce
+                    agg = dur_call.reshape(
+                        n, lvl.size, P, lvl.uniform_calls
+                    ).max(-1)
+                else:
+                    agg = (
+                        jnp.zeros((n, lvl.size * P))
+                        .at[:, lvl.call_seg]
+                        .max(dur_call)
+                        .reshape(n, lvl.size, P)
+                    )
                 step_dur = jnp.maximum(lvl.step_base, agg) * lvl.step_mask
-                # the call's coin gates the failure too: an unsent call
-                # cannot fail anything (used_a starts from coin)
-                fail_contrib = jnp.where(
-                    final_transport, lvl.call_step, P
-                ).astype(jnp.int32)
-                fail_step = (
-                    jnp.full((n, lvl.size), P, jnp.int32)
-                    .at[:, lvl.call_seg // P]
-                    .min(fail_contrib)
-                )
+                if final_transport is not None:
+                    fail_contrib = jnp.where(
+                        final_transport, lvl.call_step, P
+                    ).astype(jnp.int32)
+                    if lvl.uniform_calls is not None:
+                        fail_step = fail_contrib.reshape(
+                            n, lvl.size, P * lvl.uniform_calls
+                        ).min(-1)
+                    else:
+                        fail_step = (
+                            jnp.full((n, lvl.size), P, jnp.int32)
+                            .at[:, lvl.call_seg // P]
+                            .min(fail_contrib)
+                        )
             else:
-                P = lvl.pmax
                 step_dur = (
                     jnp.broadcast_to(lvl.step_base, (n, lvl.size, P))
                     * lvl.step_mask
                 )
-                fail_step = jnp.full((n, lvl.size), P, jnp.int32)
             fail_lvls[d] = fail_step
             # executed-step mask: errorRate 500s skip the whole script;
             # transport errors truncate it after the failing step
-            executed = (
-                jnp.arange(P, dtype=jnp.int32) <= fail_step[:, :, None]
-            ) & ~err_coin[:, sl][:, :, None]
-            step_dur = step_dur * executed
+            if fail_step is not None:
+                executed = (
+                    jnp.arange(P, dtype=jnp.int32) <= fail_step[:, :, None]
+                )
+                if err_coin is not None:
+                    executed = executed & ~err_coin[:, sl][:, :, None]
+                step_dur = step_dur * executed
+            elif err_coin is not None:
+                step_dur = step_dur * ~err_coin[:, sl][:, :, None]
             busy = step_dur.sum(-1)
             lat_lvls[d] = wait[:, sl] + svc_time[:, sl] + busy
             # this hop's own response status: 500 iff errorRate coin or a
             # transport-failed step
-            err_lvls[d] = err_coin[:, sl] | (fail_step < P)
+            if err_coin is not None and fail_step is not None:
+                err_lvls[d] = err_coin[:, sl] | (fail_step < P)
+            elif err_coin is not None:
+                err_lvls[d] = err_coin[:, sl]
+            elif fail_step is not None:
+                err_lvls[d] = fail_step < P
             if lvl.num_children > 0:
                 prefix = jnp.cumsum(step_dur, axis=-1) - step_dur
-                off_lvls[d] = (
-                    prefix.reshape(n, -1)[:, lvl.child_seg]
-                    + used_lvls[d] * att_off[:, : lvl.num_children]
-                )
+                off = prefix.reshape(n, -1)[:, lvl.child_seg]
+                if att_off is not None:
+                    off = off + (
+                        used_lvls[d] * att_off[:, : lvl.num_children]
+                    )
+                off_lvls[d] = off
 
         # ---- downward pass: which hops actually execute ------------------
         # a down ENTRY service refuses the client's connection itself
-        root_down = down[:, 0]
-        sent_lvls: List[jax.Array] = [~root_down[:, None]]
+        if down is not None:
+            root_down = down[:, 0]
+            sent_lvls: List[jax.Array] = [~root_down[:, None]]
+        else:
+            root_down = None
+            sent_lvls = [jnp.ones((n, 1), bool)]
         for d, lvl in enumerate(self._levels[:-1]):
             sl = slice(lvl.offset, lvl.offset + lvl.size)
             nxt = self._levels[d + 1]
             csl = slice(nxt.offset, nxt.offset + nxt.size)
-            parent_sent = sent_lvls[d][:, lvl.child_parent_local]
-            parent_err = err_coin[:, sl][:, lvl.child_parent_local]
-            parent_fail = fail_lvls[d][:, lvl.child_parent_local]
-            sent_lvls.append(
-                parent_sent
-                & ~parent_err
-                & (lvl.child_step <= parent_fail)
-                & used_lvls[d]
-                & ~down[:, csl]
-            )
+            sent = sent_lvls[d][:, lvl.child_parent_local]
+            if err_coin is not None:
+                sent = sent & ~err_coin[:, sl][:, lvl.child_parent_local]
+            if fail_lvls[d] is not None:
+                sent = sent & (
+                    lvl.child_step
+                    <= fail_lvls[d][:, lvl.child_parent_local]
+                )
+            if used_lvls[d] is not None:
+                sent = sent & used_lvls[d]
+            if down is not None:
+                sent = sent & ~down[:, csl]
+            sent_lvls.append(sent)
         err_hop_lvls = err_lvls
 
         # ---- closed-loop arrivals (need latencies) -----------------------
         # a refused connection to the entry costs one wire round trip
-        root_lat = jnp.where(
-            root_down,
-            2 * self._entry_one_way,
-            self._root_net + lat_lvls[0][:, 0],
-        )
+        if root_down is not None:
+            root_lat = jnp.where(
+                root_down,
+                2 * self._entry_one_way,
+                self._root_net + lat_lvls[0][:, 0],
+            )
+        else:
+            root_lat = self._root_net + lat_lvls[0][:, 0]
         if kind == CLOSED_LOOP:
             c = max(connections, 1)
             per = n // c
@@ -1036,11 +1217,20 @@ class Simulator:
         hop_sent = jnp.concatenate(sent_lvls, axis=1)
         hop_lat = jnp.concatenate(lat_lvls, axis=1)
         hop_start = jnp.concatenate(start_lvls, axis=1)
-        err_hop = jnp.concatenate(err_hop_lvls, axis=1)
+        err_hop = jnp.concatenate(
+            [
+                e if e is not None else jnp.zeros((n, lvl.size), bool)
+                for e, lvl in zip(err_hop_lvls, self._levels)
+            ],
+            axis=1,
+        )
+        client_error = err_hop[:, 0]
+        if root_down is not None:
+            client_error = client_error | root_down
         res = SimResults(
             client_start=arrivals,
             client_latency=root_lat,
-            client_error=err_hop[:, 0] | root_down,
+            client_error=client_error,
             hop_sent=hop_sent,
             hop_error=err_hop & hop_sent,
             hop_latency=hop_lat,
