@@ -127,7 +127,14 @@ class ShardedSimulator:
             )
         else:
             window = (0.0, float("inf"))
-        return self._get(block, num_blocks, load.kind, conns_local, trim)(
+        # saturated (-qps max): the finite-population wait law uses the
+        # TOTAL connection count — every shard's requests share the same
+        # service stations
+        sat_conns = (
+            load.connections if self.sim._saturated(load) else 0
+        )
+        return self._get(block, num_blocks, load.kind, conns_local, trim,
+                         sat_conns)(
             key, offered, gap, nominal_gap,
             jnp.float32(window[0]), jnp.float32(window[1]),
         )
@@ -135,11 +142,11 @@ class ShardedSimulator:
     # ------------------------------------------------------------------
 
     def _get(self, block: int, num_blocks: int, kind: str,
-             conns_local: int, trim: bool = False):
-        cache_key = (block, num_blocks, kind, conns_local, trim)
+             conns_local: int, trim: bool = False, sat_conns: int = 0):
+        cache_key = (block, num_blocks, kind, conns_local, trim, sat_conns)
         if cache_key not in self._fns:
             body = partial(self._body, block, num_blocks, kind, conns_local,
-                           trim)
+                           trim, sat_conns)
             mapped = jax.shard_map(
                 body,
                 mesh=self.mesh,
@@ -184,6 +191,7 @@ class ShardedSimulator:
         kind: str,
         conns_local: int,
         trim: bool,
+        sat_conns: int,
         key: jax.Array,
         offered_qps: jax.Array,
         pace_gap: jax.Array,
@@ -217,6 +225,7 @@ class ShardedSimulator:
                 t0,
                 conn_t0,
                 req_off,
+                sat_conns=sat_conns,
             )
             return (t_end, conn_end, req_off + per), summarize(
                 res, self.collector,

@@ -333,6 +333,48 @@ class Simulator:
         # payload-free entry one-way: root start offset + refused-conn cost
         self._entry_one_way = net.entry_one_way(0.0)
 
+        # -- closed-network (finite-population) model inputs ---------------
+        # The saturated closed loop (-qps max) is modeled by exact MVA
+        # over one station per service plus one delay station aggregating
+        # wire time and sleeps (sim/closed.py).  Tables are built lazily
+        # per connection count.
+        # fork-join cycle factors: each member of an m-wide concurrent
+        # group overlaps its siblings, contributing ~H_m/m of its
+        # response to the request's cycle (H_m = harmonic number:
+        # E[max of m iid Exp] = H_m * E[one]); factors multiply down
+        # the unroll.  Utilization keeps the FULL visits — every branch
+        # really executes (see sim/closed.py).
+        hop_rtt = net_out + net_back  # (H,) f64
+        fj = np.ones(compiled.num_hops)
+        for lvl in compiled.levels:
+            if not len(lvl.child_ids):
+                continue
+            seg_calls: Dict[int, int] = {}
+            for seg in lvl.call_seg:
+                seg_calls[int(seg)] = seg_calls.get(int(seg), 0) + 1
+            factor = {
+                seg: sum(1.0 / i for i in range(1, m + 1)) / m
+                for seg, m in seg_calls.items()
+            }
+            parent_global = lvl.hop_ids[lvl.child_seg // compiled.max_steps]
+            fj[lvl.child_ids] = fj[parent_global] * np.asarray(
+                [factor[int(s)] for s in lvl.child_seg]
+            )
+        self._fj_factors = fj
+        reach_f = compiled.hop_reach * fj
+        sleep_s = 0.0
+        for lvl in compiled.levels:
+            r = reach_f[lvl.hop_ids]
+            sleep_s += float(
+                (lvl.step_base * lvl.step_is_real * r[:, None]).sum()
+            )
+        self._delay_s = float((reach_f * hop_rtt).sum()) + sleep_s
+        self._cycle_visits = np.bincount(
+            hs, weights=reach_f, minlength=compiled.num_services
+        )
+        self._closed_cache: Dict[int, tuple] = {}
+        self._sat_pilot_fns: Dict[int, "jax.stages.Wrapped"] = {}
+
         # -- static RNG elimination -----------------------------------------
         # The reference's hot path only flips coins that can land both ways:
         # a topology with no sub-1 send probabilities needs no send RNG, one
@@ -442,6 +484,11 @@ class Simulator:
         self._sib_group = group.astype(np.int32)
         self._num_sib_groups = len(gid)
         self._copula_active = n_multi > 0 and params.sibling_copula_r > 0.0
+        # the finite-population law replaces the open-loop wait law only
+        # when the whole run is one stationary phase (no chaos/churn cuts)
+        self._single_phase = (
+            int(self._phase_starts.shape[0]) * self._num_combos == 1
+        )
         self._fns: Dict[Tuple[int, str, bool], "jax.stages.Wrapped"] = {}
         self._summary_fns: Dict[tuple, "jax.stages.Wrapped"] = {}
         self._rate_cache: Dict[tuple, float] = {}
@@ -499,6 +546,121 @@ class Simulator:
                     m[h] = 0.0
         return out
 
+    def _closed_tables(self, connections: int):
+        """Saturated-closed-loop sampling tables at ``connections``:
+        (throughput, p_zero_per_hop, coef_per_hop, active_mask,
+        center_c, var_scale) — lazily built, cached per C.
+
+        ``center_c``/``var_scale`` realize the population copula:
+        z' = scale * (z - c * mask * mean_active(z)) has exact unit
+        marginals and pairwise correlation rho (sim/closed.py) among
+        the active hops.
+        """
+        if connections not in self._closed_cache:
+            from isotope_tpu.sim import closed
+
+            hs = self.compiled.hop_service
+            visits = np.asarray(self._visits, np.float64)
+            reps = np.asarray(self.compiled.services.replicas, np.float64)
+            rho = 0.0
+            if bool((self._fj_factors < 1.0).any()):
+                # fork-join: self-consistent fixed point — the cycle is
+                # re-measured from the ENGINE's own composition (max
+                # over siblings, copula) so Little's law closes:
+                # E[sampled latency] = C / lambda.
+                lam, pi, cycle = closed.fork_join_decomposition(
+                    visits, self._cycle_visits, reps, self._mu,
+                    self._delay_s, connections,
+                )
+                w = np.full(len(visits), 1.0 / self._mu)
+                pilot = self._sat_pilot(connections)
+                key = jax.random.PRNGKey(20_260_730)
+                for it in range(12):
+                    p0, coef, _ = closed.tables_from_pi(
+                        pi, reps, self._mu
+                    )
+                    e = float(
+                        pilot(
+                            jax.random.fold_in(key, it),
+                            jnp.float32(cycle / connections),
+                            jnp.asarray(p0[hs], jnp.float32),
+                            jnp.asarray(coef[:, hs], jnp.float32),
+                        )
+                    )
+                    new_cycle = 0.5 * cycle + 0.5 * e
+                    done = abs(new_cycle - cycle) < 2e-3 * cycle
+                    cycle = new_cycle
+                    pi, w = closed.repairman_marginals(
+                        visits, reps, self._mu, cycle, w, connections
+                    )
+                    if done:
+                        break
+                p0, coef, _ = closed.tables_from_pi(pi, reps, self._mu)
+                throughput = connections / cycle
+                sigma = None
+                var_d = 0.0
+            else:
+                tabs = closed.closed_network_tables(
+                    visits, self._cycle_visits, reps, self._mu,
+                    self._delay_s, connections,
+                )
+                p0, coef = tabs.p_zero, tabs.coef
+                throughput = tabs.throughput
+                sigma, var_d = tabs.sigma, tabs.var_delay
+            p0_h = p0[hs]
+            # population copula: linearize j_s ~ mean + sigma_s * z_s;
+            # the census constraint sum_s j_s + j_d = C-1 means the
+            # sigma-weighted z-combination must carry Var(j_delay), not
+            # the independent sum Sigma sigma^2 — shrink its projection:
+            # z' = (z - c * e * (e . z)) / norm, c = 1 - sqrt(Vd/Ss^2).
+            c_center = 0.0
+            e_h = np.zeros(len(hs), np.float32)
+            scale_h = np.ones(len(hs), np.float32)
+            if sigma is not None:
+                # a station's weight spreads over its hops (independent
+                # draws): sigma/m per hop keeps multi-visit stations from
+                # dominating the projection
+                n_hops_s = np.bincount(hs, minlength=len(sigma))
+                sig_h = sigma[hs] / np.maximum(n_hops_s[hs], 1)
+                ss = float((sig_h**2).sum())
+                if ss > 1e-18 and var_d < ss:
+                    c_center = 1.0 - float(np.sqrt(max(var_d, 0.0) / ss))
+                    e_h = (sig_h / np.sqrt(ss)).astype(np.float32)
+                    shrink = (2 * c_center - c_center**2) * e_h**2
+                    scale_h = (1.0 / np.sqrt(1.0 - shrink)).astype(
+                        np.float32
+                    )
+            self._closed_cache[connections] = (
+                throughput,
+                jnp.asarray(p0_h, jnp.float32),
+                jnp.asarray(coef[:, hs], jnp.float32),
+                jnp.asarray(e_h),
+                c_center,
+                jnp.asarray(scale_h),
+            )
+        return self._closed_cache[connections]
+
+    def _sat_pilot(self, connections: int, n: int = 8192):
+        """Jitted mean-latency probe for the fork-join fixed point: the
+        quantile tables are ARGUMENTS (not baked constants) so the one
+        compilation serves every iteration."""
+        if connections not in self._sat_pilot_fns:
+            c = max(connections, 1)
+
+            def fn(key, nominal_gap, p0_h, coef_h):
+                res, _, _ = self._simulate_core(
+                    n, CLOSED_LOOP, connections, key,
+                    jnp.float32(1.0), jnp.float32(0.0), jnp.float32(1.0),
+                    nominal_gap, jnp.float32(0.0),
+                    jnp.zeros((c,), jnp.float32), jnp.float32(0.0),
+                    sat_conns=connections,
+                    sat_override=(p0_h, coef_h),
+                )
+                return res.client_latency.mean()
+
+            self._sat_pilot_fns[connections] = jax.jit(fn)
+        return self._sat_pilot_fns[connections]
+
     # -- public entry points ----------------------------------------------
 
     def run(
@@ -532,8 +694,18 @@ class Simulator:
         # issue at the solved throughput, so placing every request at t=0
         # would silently skip chaos phases.
         nominal_gap = jnp.float32(load.connections / lam)
-        return self._get(num_requests, CLOSED_LOOP, load.connections)(
+        return self._get(num_requests, CLOSED_LOOP, load.connections,
+                         sat=self._saturated(load))(
             key, jnp.float32(lam), gap, jnp.float32(lam), nominal_gap
+        )
+
+    def _saturated(self, load: LoadModel) -> bool:
+        """True when the run uses the finite-population (MVA) wait law:
+        ``-qps max`` over a single stationary phase."""
+        return (
+            load.kind == CLOSED_LOOP
+            and load.qps is None
+            and self._single_phase
         )
 
     def solve_closed_rate(
@@ -557,6 +729,10 @@ class Simulator:
         The solved rate is a physical property of (load, topology), not of
         the RNG key, so it is memoized per load shape.
         """
+        if self._saturated(load):
+            # the closed network's throughput is what MVA computes exactly
+            # (product-form) — no pilot runs needed
+            return self._closed_tables(load.connections)[0]
         cache_key = (load.qps, load.connections, min(num_requests, 2048),
                      fixed_point_iters)
         if cache_key in self._rate_cache:
@@ -651,7 +827,7 @@ class Simulator:
         else:
             window = (0.0, np.inf)
         fn = self._get_summary(block, num_blocks, load.kind, conns,
-                               collector, trim)
+                               collector, trim, sat=self._saturated(load))
         return fn(
             key, jnp.float32(offered), jnp.float32(pace),
             jnp.float32(offered), jnp.float32(nominal),
@@ -682,21 +858,23 @@ class Simulator:
 
     # -- jit plumbing ------------------------------------------------------
 
-    def _get(self, n: int, kind: str, connections: int = 0):
-        key = (n, kind, connections)
+    def _get(self, n: int, kind: str, connections: int = 0,
+             sat: bool = False):
+        key = (n, kind, connections, sat)
         if key not in self._fns:
             self._fns[key] = jax.jit(
-                partial(self._simulate, n, kind, connections)
+                partial(self._simulate, n, kind, connections, sat)
             )
         return self._fns[key]
 
     def _get_summary(self, block: int, num_blocks: int, kind: str,
-                     connections: int, collector, trim: bool = False):
+                     connections: int, collector, trim: bool = False,
+                     sat: bool = False):
         """Jitted scan-over-blocks program producing a RunSummary."""
         from isotope_tpu.sim import summary as summary_mod
 
         cache_key = (block, num_blocks, kind, connections,
-                     collector is not None, trim)
+                     collector is not None, trim, sat)
         if cache_key not in self._summary_fns:
             c = max(connections, 1)
             per = block // c
@@ -712,6 +890,7 @@ class Simulator:
                         block, kind, connections, kb, offered_qps,
                         pace_gap, arrival_qps, nominal_gap, t0, conn_t0,
                         req_off,
+                        sat_conns=connections if sat else 0,
                     )
                     s = summary_mod.summarize(
                         res, collector,
@@ -762,6 +941,7 @@ class Simulator:
         n: int,
         kind: str,
         connections: int,
+        sat: bool,
         key: jax.Array,
         offered_qps: jax.Array,
         pace_gap: jax.Array,
@@ -776,6 +956,7 @@ class Simulator:
             n, kind, connections, key, offered_qps, pace_gap, arrival_qps,
             nominal_gap, jnp.float32(0.0), jnp.zeros((c,), jnp.float32),
             jnp.float32(0.0),
+            sat_conns=connections if sat else 0,
         )
         return res
 
@@ -792,6 +973,8 @@ class Simulator:
         t0: jax.Array,
         conn_t0: jax.Array,
         req_offset: jax.Array,
+        sat_conns: int = 0,
+        sat_override: Optional[Tuple[jax.Array, jax.Array]] = None,
     ) -> Tuple[SimResults, jax.Array, jax.Array]:
         """``offered_qps`` drives the queueing model (the rate the whole
         fleet of services sees); ``arrival_qps`` paces this batch's
@@ -803,7 +986,12 @@ class Simulator:
         ``pace_gap`` is 0, i.e. ``-qps max``).  ``t0`` / ``conn_t0`` /
         ``req_offset`` are the block's starting clocks so scanned blocks
         form one continuous timeline; returns ``(results, t_end,
-        conn_end)`` for the next block's carry."""
+        conn_end)`` for the next block's carry.
+
+        ``sat_conns > 0`` switches the wait law to the finite-population
+        closed-network model (sim/closed.py) with that TOTAL connection
+        count — the ``-qps max`` mode where the open-loop M/M/k law
+        misrepresents the C-bounded sojourn tail (ORACLE.md)."""
         H = self.compiled.num_hops
         if self._copula_active:
             (k_send, k_err, k_wait_u, k_svc, k_arr,
@@ -818,20 +1006,28 @@ class Simulator:
         u_err = (
             jax.random.uniform(k_err, (n, H)) if self._need_err else None
         )
+        # Wait draws: the saturated path (sat_conns > 0) consumes unit
+        # NORMALS (its copulas compose in normal space); the open-loop
+        # law consumes uniforms.  Either way the sibling copula — exact
+        # U(0,1) marginals, pairwise correlation r within a concurrent
+        # group, matching the measured backlog correlation of parallel
+        # stations fed by common arrivals — is applied here, once.
+        z_wait = None
+        u_wait = None
         if self._copula_active:
-            # Gaussian copula over sibling groups: exact U(0,1) marginals
-            # (the M/M/k wait law is untouched), pairwise correlation r
-            # within a concurrent group — matching the measured backlog
-            # correlation of parallel stations fed by common arrivals.
             r = self.params.sibling_copula_r
             z_h = jax.random.normal(k_wait_u, (n, H))
             z_small = jax.random.normal(
                 k_wait2, (n, self._num_sib_groups)
             )
-            z_g = z_small[:, self._sib_group]
-            u_wait = jax.scipy.special.ndtr(
-                np.sqrt(r) * z_g + np.sqrt(1.0 - r) * z_h
+            z_wait = (
+                np.sqrt(r) * z_small[:, self._sib_group]
+                + np.sqrt(1.0 - r) * z_h
             )
+            if not sat_conns:
+                u_wait = jax.scipy.special.ndtr(z_wait)
+        elif sat_conns:
+            z_wait = jax.random.normal(k_wait_u, (n, H))
         else:
             u_wait = jax.random.uniform(k_wait_u, (n, H))
 
@@ -936,9 +1132,41 @@ class Simulator:
                 if self.has_chaos
                 else None
             )
-        wait = queueing.sample_wait_conditional(
-            p_wait_nh, wait_rate_nh, u_wait
-        )  # (N, H)
+        if sat_conns:
+            # finite-population law: per-hop quantile polynomial in
+            # v = -log(1 - u') — Horner with per-hop coefficient rows,
+            # zero gathers (coefficients broadcast over the request axis).
+            # The wait draws stay in normal space: the sibling copula
+            # (if active) correlates concurrent branches positively, and
+            # the population copula (negative equicorrelation from the
+            # fixed in-flight census, chains only) centers across hops.
+            if sat_override is not None:
+                # fixed-point pilot: tables are traced arguments, no
+                # population centering (fork-join graphs have none)
+                p0_h, coef_h = sat_override
+                c_center, e_h, scale_h = 0.0, None, None
+            else:
+                (_, p0_h, coef_h, e_h, c_center,
+                 scale_h) = self._closed_tables(sat_conns)
+            z = z_wait
+            if c_center > 0.0:
+                zproj = (z * e_h).sum(-1, keepdims=True)
+                z = (z - c_center * e_h * zproj) * scale_h
+            u_sat = jax.scipy.special.ndtr(z)
+            u_c = jnp.clip(
+                (u_sat - p0_h) / jnp.maximum(1.0 - p0_h, 1e-9),
+                0.0,
+                1.0 - 1e-7,
+            )
+            v = -jnp.log1p(-u_c)
+            w = coef_h[-1]
+            for ci in range(coef_h.shape[0] - 2, -1, -1):
+                w = w * v + coef_h[ci]
+            wait = jnp.where(u_sat < p0_h, 0.0, jnp.maximum(w, 0.0))
+        else:
+            wait = queueing.sample_wait_conditional(
+                p_wait_nh, wait_rate_nh, u_wait
+            )  # (N, H)
         # a fully-down service does no work: report zero utilization for
         # those phases instead of the clamped-to-1-replica saturation
         util_phase = jnp.where(svc_down_pc, 0.0, qp.utilization)

@@ -24,7 +24,8 @@ def main():
 
     @jax.jit
     def step(key):
-        res = sim._simulate(n, OPEN_LOOP, 0, key, qps, jnp.float32(0.0), qps)
+        res = sim._simulate(n, OPEN_LOOP, 0, False, key, qps,
+                            jnp.float32(0.0), qps)
         return res.hop_events, latency_histogram(res.client_latency)
 
     key = jax.random.PRNGKey(0)
