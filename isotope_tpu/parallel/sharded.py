@@ -137,6 +137,7 @@ class ShardedSimulator:
                          sat_conns)(
             key, offered, gap, nominal_gap,
             jnp.float32(window[0]), jnp.float32(window[1]),
+            self.sim._vis_arg(float(offered)),
         )
 
     # ------------------------------------------------------------------
@@ -150,7 +151,7 @@ class ShardedSimulator:
             mapped = jax.shard_map(
                 body,
                 mesh=self.mesh,
-                in_specs=tuple(P() for _ in range(6)),
+                in_specs=tuple(P() for _ in range(7)),
                 out_specs=RunSummary(
                     count=P(),
                     error_count=P(),
@@ -198,6 +199,7 @@ class ShardedSimulator:
         nominal_gap: jax.Array,
         win_lo: jax.Array,
         win_hi: jax.Array,
+        visits_pc: jax.Array,
     ) -> RunSummary:
         both = tuple(self.mesh.axis_names)
         shard = jnp.int32(0)
@@ -226,6 +228,7 @@ class ShardedSimulator:
                 conn_t0,
                 req_off,
                 sat_conns=sat_conns,
+                visits_pc=visits_pc,
             )
             return (t_end, conn_end, req_off + per), summarize(
                 res, self.collector,

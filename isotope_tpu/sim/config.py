@@ -71,6 +71,14 @@ class SimParams:
     # see ORACLE.md), and r=0.4 brings fork-join quantiles within ~1%
     # of the DES oracle.  0 disables (iid draws, exact for chains).
     sibling_copula_r: float = 0.4
+    # Extra correlation among the serial RETRY attempts of one call, on
+    # top of the sibling term: attempt n+1 re-enters the same station
+    # milliseconds after attempt n timed out, so it sees nearly the same
+    # backlog — with independent draws the engine misses the
+    # timeout-cascade tail entirely (one timeout predicts the next).
+    # Total attempt-attempt correlation = sibling_copula_r +
+    # retry_copula_r; fit against the DES oracle (ORACLE.md).
+    retry_copula_r: float = 0.5
 
     def __post_init__(self):
         if self.service_time not in (
@@ -93,6 +101,12 @@ class SimParams:
             raise ValueError("lognormal sigma must be positive")
         if not 0.0 <= self.sibling_copula_r < 1.0:
             raise ValueError("sibling_copula_r must be in [0, 1)")
+        if not 0.0 <= self.retry_copula_r < 1.0:
+            raise ValueError("retry_copula_r must be in [0, 1)")
+        if self.sibling_copula_r + self.retry_copula_r >= 1.0:
+            raise ValueError(
+                "sibling_copula_r + retry_copula_r must be < 1"
+            )
 
 
 @dataclasses.dataclass(frozen=True)

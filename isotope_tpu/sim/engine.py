@@ -265,9 +265,11 @@ class Simulator:
                     mult_combo[:, compiled.hop_parent[h]] * own_c[:, h]
                 )
             self._num_combos = n_combos
+            own_combo_np = own_c
         else:
             self._num_combos = 1
             mult_combo = np.ones((1, compiled.num_hops), np.float64)
+            own_combo_np = np.ones((1, compiled.num_hops), np.float64)
         self._visits = jnp.asarray(
             compiled.expected_visits(hop_mult), jnp.float32
         )
@@ -317,6 +319,30 @@ class Simulator:
         self._visits_pc = jnp.asarray(visits_pc, jnp.float32)
         self._eff_replicas_pc = jnp.repeat(self._eff_replicas, Cc, axis=0)
         self._svc_down_pc = jnp.repeat(self._svc_down, Cc, axis=0)
+
+        # -- retry-storm feedback (load-dependent visits) ------------------
+        # With finite call timeouts the retry/truncation probabilities are
+        # load-dependent (timeouts trip more as waits grow), so the visit
+        # tables become a per-rate fixed point (sim/feedback.py).  Without
+        # finite timeouts the static tables are already exact and the
+        # solver is skipped entirely.
+        self._feedback = None
+        if any(
+            bool(np.isfinite(l.call_timeout).any()) for l in compiled.levels
+        ):
+            from isotope_tpu.sim.feedback import RetryFeedback
+
+            self._feedback = RetryFeedback(
+                compiled,
+                params,
+                self._mu,
+                np.repeat(np.maximum(eff, 1), Cc, axis=0),
+                np.repeat(svc_down_np, Cc, axis=0),
+                own_combo_np,
+                visits_pc,
+            )
+            if not self._feedback.active:  # pragma: no cover - guard match
+                self._feedback = None
 
         # Per-hop gathers are resolved at trace time (static indices).
         hs = compiled.hop_service
@@ -484,6 +510,35 @@ class Simulator:
         self._sib_group = group.astype(np.int32)
         self._num_sib_groups = len(gid)
         self._copula_active = n_multi > 0 and params.sibling_copula_r > 0.0
+
+        # -- retry copula: static hop -> call-group map ---------------------
+        # Serial retry attempts of ONE call get an extra shared normal on
+        # top of the sibling term: attempt n+1 re-enters the same queue
+        # right after attempt n failed, so consecutive attempts see nearly
+        # the same backlog (the timeout-cascade correlation; see
+        # SimParams.retry_copula_r).  Hops outside any multi-attempt call
+        # carry weight 0 and gather a sentinel column.
+        rg = np.zeros(compiled.num_hops, np.int64)
+        in_rg = np.zeros(compiled.num_hops, bool)
+        n_rg = 0
+        for lvl in compiled.levels:
+            if not len(lvl.call_seg):
+                continue
+            att_counts = lvl.att_valid.sum(0)
+            for k in np.nonzero(att_counts > 1)[0]:
+                gids = lvl.child_ids[
+                    lvl.att_child[lvl.att_valid[:, k], k]
+                ]
+                rg[gids] = n_rg
+                in_rg[gids] = True
+                n_rg += 1
+        self._retry_group = np.where(in_rg, rg, n_rg).astype(np.int32)
+        self._num_retry_groups = n_rg
+        self._retry_active = n_rg > 0 and params.retry_copula_r > 0.0
+        # per-hop weight of the retry-group normal (0 outside any group)
+        self._retry_w = np.where(
+            in_rg, np.sqrt(params.retry_copula_r), 0.0
+        ).astype(np.float32)
         # the finite-population law replaces the open-loop wait law only
         # when the whole run is one stationary phase (no chaos/churn cuts)
         self._single_phase = (
@@ -663,6 +718,16 @@ class Simulator:
 
     # -- public entry points ----------------------------------------------
 
+    def _vis_arg(self, offered: float) -> jax.Array:
+        """The (P*Cc, S) visit table the queues should see at ``offered``:
+        the static table, or the retry-feedback fixed point at that rate
+        when finite timeouts make failure probabilities load-dependent."""
+        if self._feedback is None:
+            return self._visits_pc
+        return jnp.asarray(
+            self._feedback.visits_pc(float(offered)), jnp.float32
+        )
+
     def run(
         self,
         load: LoadModel,
@@ -681,6 +746,7 @@ class Simulator:
             return self._get(num_requests, OPEN_LOOP)(
                 key, jnp.float32(load.qps), jnp.float32(0.0),
                 jnp.float32(load.qps), jnp.float32(0.0),
+                visits_pc=self._vis_arg(load.qps),
             )
         lam = self.solve_closed_rate(load, num_requests, key,
                                      fixed_point_iters)
@@ -696,7 +762,8 @@ class Simulator:
         nominal_gap = jnp.float32(load.connections / lam)
         return self._get(num_requests, CLOSED_LOOP, load.connections,
                          sat=self._saturated(load))(
-            key, jnp.float32(lam), gap, jnp.float32(lam), nominal_gap
+            key, jnp.float32(lam), gap, jnp.float32(lam), nominal_gap,
+            visits_pc=self._vis_arg(lam),
         )
 
     def _saturated(self, load: LoadModel) -> bool:
@@ -751,6 +818,7 @@ class Simulator:
             res = pilot(
                 jax.random.fold_in(key, i), jnp.float32(lam), gap,
                 jnp.float32(lam), jnp.float32(load.connections / lam),
+                visits_pc=self._vis_arg(lam),
             )
             mean_lat = float(res.client_latency.mean())
             out = load.connections / max(mean_lat, 1e-9)
@@ -832,6 +900,7 @@ class Simulator:
             key, jnp.float32(offered), jnp.float32(pace),
             jnp.float32(offered), jnp.float32(nominal),
             jnp.float32(window[0]), jnp.float32(window[1]),
+            self._vis_arg(offered),
         )
 
     def default_block_size(self, budget_elems: int = 33_554_432) -> int:
@@ -880,7 +949,7 @@ class Simulator:
             per = block // c
 
             def scanfn(key, offered_qps, pace_gap, arrival_qps,
-                       nominal_gap, win_lo, win_hi):
+                       nominal_gap, win_lo, win_hi, visits_pc):
                 def body(carry, b):
                     t0, conn_t0, req_off = carry
                     # disjoint fold domain: the closed-loop rate solver's
@@ -891,6 +960,7 @@ class Simulator:
                         pace_gap, arrival_qps, nominal_gap, t0, conn_t0,
                         req_off,
                         sat_conns=connections if sat else 0,
+                        visits_pc=visits_pc,
                     )
                     s = summary_mod.summarize(
                         res, collector,
@@ -947,6 +1017,7 @@ class Simulator:
         pace_gap: jax.Array,
         arrival_qps: jax.Array,
         nominal_gap: Optional[jax.Array] = None,
+        visits_pc: Optional[jax.Array] = None,
     ) -> SimResults:
         """One self-contained block starting at t=0 (see _simulate_core)."""
         if nominal_gap is None:
@@ -957,6 +1028,7 @@ class Simulator:
             nominal_gap, jnp.float32(0.0), jnp.zeros((c,), jnp.float32),
             jnp.float32(0.0),
             sat_conns=connections if sat else 0,
+            visits_pc=visits_pc,
         )
         return res
 
@@ -975,6 +1047,7 @@ class Simulator:
         req_offset: jax.Array,
         sat_conns: int = 0,
         sat_override: Optional[Tuple[jax.Array, jax.Array]] = None,
+        visits_pc: Optional[jax.Array] = None,
     ) -> Tuple[SimResults, jax.Array, jax.Array]:
         """``offered_qps`` drives the queueing model (the rate the whole
         fleet of services sees); ``arrival_qps`` paces this batch's
@@ -993,9 +1066,10 @@ class Simulator:
         count — the ``-qps max`` mode where the open-loop M/M/k law
         misrepresents the C-bounded sojourn tail (ORACLE.md)."""
         H = self.compiled.num_hops
-        if self._copula_active:
-            (k_send, k_err, k_wait_u, k_svc, k_arr,
-             k_wait2) = jax.random.split(key, 6)
+        any_copula = self._copula_active or self._retry_active
+        if any_copula:
+            (k_send, k_err, k_wait_u, k_svc, k_arr, k_wait2,
+             k_wait3) = jax.random.split(key, 7)
         else:
             k_send, k_err, k_wait_u, k_svc, k_arr = jax.random.split(key, 5)
         # deterministic coins are not drawn (see __init__): the key split
@@ -1008,22 +1082,37 @@ class Simulator:
         )
         # Wait draws: the saturated path (sat_conns > 0) consumes unit
         # NORMALS (its copulas compose in normal space); the open-loop
-        # law consumes uniforms.  Either way the sibling copula — exact
-        # U(0,1) marginals, pairwise correlation r within a concurrent
-        # group, matching the measured backlog correlation of parallel
-        # stations fed by common arrivals — is applied here, once.
+        # law consumes uniforms.  Either way the copulas — exact U(0,1)
+        # marginals; pairwise correlation r within a concurrent group
+        # (the backlog correlation of parallel stations fed by common
+        # arrivals) plus an extra retry term among one call's serial
+        # attempts (consecutive attempts see nearly the same queue) —
+        # are applied here, once.
         z_wait = None
         u_wait = None
-        if self._copula_active:
-            r = self.params.sibling_copula_r
+        if any_copula:
+            r = (
+                self.params.sibling_copula_r
+                if self._copula_active
+                else 0.0
+            )
             z_h = jax.random.normal(k_wait_u, (n, H))
-            z_small = jax.random.normal(
-                k_wait2, (n, self._num_sib_groups)
-            )
-            z_wait = (
-                np.sqrt(r) * z_small[:, self._sib_group]
-                + np.sqrt(1.0 - r) * z_h
-            )
+            z_wait = 0.0
+            w_own_sq = 1.0 - r
+            if self._copula_active:
+                z_small = jax.random.normal(
+                    k_wait2, (n, self._num_sib_groups)
+                )
+                z_wait = z_wait + np.sqrt(r) * z_small[:, self._sib_group]
+            if self._retry_active:
+                z_call = jax.random.normal(
+                    k_wait3, (n, self._num_retry_groups + 1)
+                )
+                z_wait = z_wait + (
+                    self._retry_w * z_call[:, self._retry_group]
+                )
+                w_own_sq = w_own_sq - self._retry_w**2
+            z_wait = z_wait + np.sqrt(w_own_sq) * z_h
             if not sat_conns:
                 u_wait = jax.scipy.special.ndtr(z_wait)
         elif sat_conns:
@@ -1079,8 +1168,10 @@ class Simulator:
         # truncation) and effective replica counts.
         P = int(self._phase_starts.shape[0])
         Cc = self._num_combos
+        if visits_pc is None:
+            visits_pc = self._visits_pc
         qp = queueing.mmk_params(
-            offered_qps * self._visits_pc,
+            offered_qps * visits_pc,
             self._mu,
             self._eff_replicas_pc,
             self._k_max,
