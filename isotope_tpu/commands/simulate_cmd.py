@@ -174,9 +174,12 @@ def run_simulate(args) -> int:
         from isotope_tpu.models.graph import ServiceGraph
         from isotope_tpu.sim.engine import Simulator
 
-        # identical model to the main run: same compiled graph shape,
-        # same env-applied params, same load grid (of one), same chaos
-        compiled = compile_graph(ServiceGraph.from_yaml_file(args.topology))
+        # identical model to the main run: same compiled graph shape
+        # (including the entrypoint override), same env-applied params,
+        # same load grid (of one), same chaos
+        compiled = compile_graph(
+            ServiceGraph.from_yaml_file(args.topology), entry=config.entry
+        )
         sim = Simulator(
             compiled,
             config.environments[0].apply(config.sim_params()),

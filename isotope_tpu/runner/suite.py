@@ -61,7 +61,12 @@ def run_suite(
     sid = id or suite_id(labels=labels)
     publish = pathlib.Path(out_root) / sid
     publish.mkdir(parents=True, exist_ok=True)
-    sink = MonitorSink(publish / "monitor_status.jsonl")
+    # the sink is append-only and every invocation re-evaluates all runs
+    # (checkpoint-restored included), so a re-run with the same publish
+    # id must start from a fresh file or rows duplicate
+    sink_path = publish / "monitor_status.jsonl"
+    sink_path.unlink(missing_ok=True)
+    sink = MonitorSink(sink_path)
 
     configs_out: List[dict] = []
     total_runs = 0
@@ -79,7 +84,12 @@ def run_suite(
         for r in results:
             if not r.prometheus_text:
                 continue
-            duration = float(r.flat.get("ActualDuration", 0) or 0)
+            # the fortio JSON carries nanoseconds; the flat CSV field is
+            # truncated to integer seconds, which zeroes every rate()
+            # for sub-second runs (and with it the CPU/mem alarms)
+            duration = (
+                float(r.fortio_json.get("ActualDuration", 0) or 0) / 1e9
+            )
             store = MetricStore.from_text(r.prometheus_text, duration)
             rows = monitor_run(store, sink, queries, run_label=r.label)
             alarm_count += sum(1 for row in rows if row.status == "ALARM")

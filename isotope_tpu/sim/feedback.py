@@ -42,8 +42,7 @@ from typing import List, Optional
 import numpy as np
 
 from isotope_tpu.compiler.program import CompiledGraph
-
-_MAX_RHO = 0.9999  # mirror of sim.queueing._MAX_RHO
+from isotope_tpu.sim.queueing import _MAX_RHO
 
 
 def np_mmk(lam, mu, k):
@@ -161,14 +160,12 @@ class RetryFeedback:
             if K:
                 first_local = lvl.att_child[0]
                 g0 = lvl.child_ids[first_local]
-                maxA = lvl.att_child.shape[0]
                 att_global = lvl.child_ids[
                     np.clip(lvl.att_child, 0, max(len(lvl.child_ids) - 1, 0))
                 ]
                 self.active |= bool(np.isfinite(lvl.call_timeout).any())
             else:
                 g0 = np.zeros(0, np.int64)
-                maxA = 1
                 att_global = np.zeros((1, 0), np.int64)
             self._levels.append(
                 _LevelCalls(
@@ -193,8 +190,14 @@ class RetryFeedback:
     # ------------------------------------------------------------------
 
     def visits_pc(self, offered: float) -> np.ndarray:
-        """(PC, S) visit counts at root rate ``offered``, with feedback."""
-        key = float(offered)
+        """(PC, S) visit counts at root rate ``offered``, with feedback.
+
+        The rate is quantized to 4 significant figures before keying the
+        cache: visits are a smooth function of the rate, and the
+        closed-loop bisection probes ~40 distinct rates per solve — raw
+        float keys would re-run the host fixed point for every probe.
+        """
+        key = float(f"{float(offered):.4g}")
         if key not in self._cache:
             rows = [
                 self._solve_row(key, i) for i in range(self.static.shape[0])
@@ -217,6 +220,7 @@ class RetryFeedback:
                 continue
             base = (
                 reach[lc.hop_ids[lc.parent_local]]
+                * (1.0 - self._err[lc.svc[lc.parent_local]])
                 * lc.send_prob
                 * own[lc.first_child]
             )
@@ -244,8 +248,6 @@ class RetryFeedback:
         branch wins (and its >= 1 utilization raises ``unstable``).
         """
         low = self._iterate_row(offered, row, self.static[row].copy())
-        if not self.active:
-            return low
         high = self._iterate_row(offered, row, self._upper_visits(row))
         gap = np.abs(high - low).max() / max(high.max(), 1e-12)
         return high if gap > 0.05 else low
@@ -276,7 +278,6 @@ class RetryFeedback:
             # -- bottom-up: subtree means + per-call failure probabilities
             mean_run = np.zeros(H)
             lvl_pf: List[Optional[np.ndarray]] = [None] * len(self._levels)
-            lvl_trunc: List[Optional[np.ndarray]] = [None] * len(self._levels)
             lvl_surv: List[Optional[np.ndarray]] = [None] * len(self._levels)
             lvl_send: List[Optional[np.ndarray]] = [None] * len(self._levels)
             for d in reversed(range(len(self._levels))):
@@ -335,7 +336,7 @@ class RetryFeedback:
                         ),
                         axis=1,
                     )
-                    lvl_pf[d], lvl_trunc[d] = pf, trunc
+                    lvl_pf[d] = pf
                     lvl_surv[d], lvl_send[d] = surv, send_eff
                     step_dur = np.maximum(
                         lc.step_base, slot_max.reshape(L, P)
@@ -357,8 +358,12 @@ class RetryFeedback:
                 K = len(lc.step)
                 if not K:
                     continue
+                # (1 - parent_err): a parent that 500s skips its script
+                # and sends nothing (the same factor static hop_reach
+                # carries, compiler/compile.py)
                 base = (
                     reach[lc.hop_ids[lc.parent_local]]
+                    * (1.0 - self._err[lc.svc[lc.parent_local]])
                     * lvl_surv[d][lc.parent_local, lc.step]
                     * lvl_send[d]
                 )

@@ -429,6 +429,29 @@ def test_retry_feedback_quiet_load_matches_static():
     np.testing.assert_allclose(dyn, static, rtol=0.02)
 
 
+def test_retry_feedback_respects_error_rate_reach():
+    # the dynamic reach must carry the (1 - parent_err) 500-skip factor
+    # static hop_reach has: a 20% entry errorRate means only 80% of
+    # requests reach the worker (and target_err discounts retries)
+    yaml_text = """
+services:
+- name: entry
+  isEntrypoint: true
+  errorRate: 20%
+  script:
+  - call: {service: worker, timeout: 10s, retries: 2}
+- name: worker
+  errorRate: 10%
+"""
+    graph = ServiceGraph.from_yaml(yaml_text)
+    engine = Simulator(compile_graph(graph))
+    dyn = engine._feedback.visits_pc(0.01 * MU)
+    static = np.asarray(engine._visits_pc, np.float64)
+    # worker static visits = 0.8 * (1 + 0.1 + 0.01) = 0.888
+    assert static[0, 1] == pytest.approx(0.888, rel=1e-6)
+    np.testing.assert_allclose(dyn, static, rtol=0.02)
+
+
 def test_error_rate_fidelity():
     # client-visible error fraction: entry 500s with its own rate;
     # downstream 500s do not propagate

@@ -120,9 +120,40 @@ def test_suite_publishes_tree_and_manifest(tmp_path):
 def test_suite_resumes_completed_configs(tmp_path):
     c1 = write_cfg(tmp_path, "latency.toml", 200)
     run_suite([str(c1)], tmp_path / "pub", id="x")
+    pub = tmp_path / "pub" / "x"
+    rows1 = (pub / "monitor_status.jsonl").read_text().splitlines()
     ran = []
     run_suite([str(c1)], tmp_path / "pub", id="x", progress=ran.append)
     assert ran == []  # checkpointed sweep replays
+    # re-running the same publish id must not append duplicate monitor
+    # rows (the sink restarts fresh each invocation)
+    rows2 = (pub / "monitor_status.jsonl").read_text().splitlines()
+    assert len(rows2) == len(rows1)
+
+
+def test_suite_subsecond_run_rates_are_finite(tmp_path):
+    # sub-second runs used to truncate ActualDuration to 0 s, zeroing
+    # every rate() so the requests-sanity alarm fired spuriously; the
+    # store must be built from the nanosecond duration instead
+    cfg = tmp_path / "short.toml"
+    cfg.write_text(
+        f"""
+topology_paths = ["{TOPO}"]
+environments = ["NONE"]
+
+[client]
+qps = [200]
+num_concurrent_connections = [8]
+duration = "500ms"
+load_kind = "open"
+
+[sim]
+num_requests = 100
+seed = 3
+"""
+    )
+    result = run_suite([str(cfg)], tmp_path / "pub", id="sub")
+    assert result.manifest["total_alarms"] == 0
 
 
 def test_suite_cli_exit_code_on_alarm(tmp_path, capsys):

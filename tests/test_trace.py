@@ -127,3 +127,31 @@ def test_cli_trace_export(tmp_path, capsys):
     assert rc == 0
     doc = json.loads(out2.read_text())
     assert len(doc["data"]) == 4
+
+
+def test_cli_trace_honors_entry_override(tmp_path):
+    # the --trace re-run must compile with the SAME entrypoint as the
+    # main run, or a multi-entry topology silently traces the wrong tree
+    topo = tmp_path / "multi.yaml"
+    topo.write_text(
+        """
+services:
+- name: e1
+  isEntrypoint: true
+  script: [{call: leaf}]
+- name: leaf
+- name: e2
+  isEntrypoint: true
+"""
+    )
+    out = tmp_path / "trace.json"
+    rc = cli.main(
+        ["simulate", str(topo), "--qps", "50", "--duration", "10s",
+         "--max-requests", "500", "--flat", "--entry", "e2",
+         "--trace", str(out), "--trace-requests", "3"]
+    )
+    assert rc == 0
+    doc = json.loads(out.read_text())
+    names = {e["name"] for e in doc["traceEvents"]}
+    assert any("e2" in n for n in names)
+    assert not any("e1" in n or "leaf" in n for n in names)
