@@ -31,6 +31,13 @@ def register(sub) -> None:
     k8s.add_argument(
         "--max-idle-connections-per-host", type=int, default=0
     )
+    k8s.add_argument(
+        "--cluster", default=None,
+        help="emit only this cluster's Deployments/Services (the "
+             "per-context apply of the reference's multicluster split, "
+             "perf/load/common.sh:36-42); the ConfigMap always embeds "
+             "the full topology",
+    )
     k8s.set_defaults(func=run_kubernetes)
 
     gv = sub.add_parser(
@@ -70,6 +77,7 @@ def run_kubernetes(args) -> int:
         client_image=args.client_image,
         environment_name=args.environment_name,
         max_idle_connections_per_host=args.max_idle_connections_per_host,
+        cluster=args.cluster,
     )
     manifests = k8s_mod.service_graph_to_manifests(graph, topology_yaml, opts)
     sys.stdout.write(k8s_mod.manifests_to_yaml(manifests))

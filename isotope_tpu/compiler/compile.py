@@ -148,6 +148,10 @@ def compile_graph(
             raise ValueError(f"unknown entry service: {entry!r}")
         entry_idx = name_to_idx[entry]
 
+    cluster_names = tuple(
+        sorted({getattr(s, "cluster", "") for s in graph.services})
+    )
+    cluster_idx = {c: i for i, c in enumerate(cluster_names)}
     table = ServiceTable(
         names=names,
         replicas=np.asarray(
@@ -162,6 +166,11 @@ def compile_graph(
         is_entrypoint=np.asarray(
             [s.is_entrypoint for s in graph.services], bool
         ),
+        cluster=np.asarray(
+            [cluster_idx[getattr(s, "cluster", "")] for s in graph.services],
+            np.int32,
+        ),
+        cluster_names=cluster_names,
     )
 
     programs = [_lower_script(s.script, name_to_idx) for s in graph.services]

@@ -36,10 +36,26 @@ class ServiceTable:
     error_rate: np.ndarray     # (S,) f32    — P(injected 500) in [0, 1]
     response_size: np.ndarray  # (S,) f32    — bytes
     is_entrypoint: np.ndarray  # (S,) bool
+    # multicluster placement (perf/load/templates/service-graph.gen.yaml
+    # :1-3): dense cluster id per service; edges between different ids
+    # pay the NetworkModel's cross-cluster class.  A single-cluster
+    # topology has all-zero ids.
+    cluster: np.ndarray = None          # (S,) int32
+    cluster_names: Tuple[str, ...] = ("",)
+
+    def __post_init__(self):
+        if self.cluster is None:
+            object.__setattr__(
+                self, "cluster", np.zeros(len(self.names), np.int32)
+            )
 
     @property
     def num_services(self) -> int:
         return len(self.names)
+
+    @property
+    def num_clusters(self) -> int:
+        return len(self.cluster_names)
 
     def index_of(self, name: str) -> int:
         return self.names.index(name)
@@ -151,3 +167,40 @@ class CompiledGraph:
             weights=weights,
             minlength=self.num_services,
         )
+
+
+def hop_wire_times(compiled: "CompiledGraph", net) -> Tuple[np.ndarray,
+                                                            np.ndarray]:
+    """Per-hop one-way (request, response) wire times, cluster-aware.
+
+    Intra-cluster edges pay ``base_latency_s`` + bytes/bandwidth; edges
+    whose caller and callee sit in different clusters additionally pay
+    ``cross_cluster_latency_s`` per direction (the egress+ingress
+    gateway traversal of the reference's multicluster split,
+    perf/load/common.sh:36-42) and ride
+    ``cross_cluster_bytes_per_second`` when set.  The client is
+    co-located with the entrypoint (the reference deploys one
+    loadclient per namespace), so hop 0 is never cross-cluster; the
+    entry edge's ingress-gateway tax (``entry_extra_latency_s``) is
+    applied here as before.
+    """
+    hs = compiled.hop_service
+    resp = compiled.services.response_size.astype(np.float64)
+    req = compiled.hop_request_size.astype(np.float64)
+    cl = compiled.services.cluster
+    cross = np.zeros(compiled.num_hops, bool)
+    if compiled.services.num_clusters > 1:
+        parent = compiled.hop_parent
+        cross[1:] = cl[hs[parent[1:]]] != cl[hs[1:]]
+    extra = float(getattr(net, "cross_cluster_latency_s", 0.0))
+    cross_bps = getattr(net, "cross_cluster_bytes_per_second", None)
+    bps = np.where(
+        cross, cross_bps if cross_bps else net.bytes_per_second,
+        net.bytes_per_second,
+    )
+    lat = net.base_latency_s + np.where(cross, extra, 0.0)
+    net_out = lat + req / bps
+    net_back = lat + resp[hs] / bps
+    net_out[0] += net.entry_extra_latency_s
+    net_back[0] += net.entry_extra_latency_s
+    return net_out, net_back

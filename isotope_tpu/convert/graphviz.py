@@ -39,30 +39,48 @@ def _dot_id(name: str) -> str:
     return f'"{escaped}"'
 
 
+def _node_lines(svc, indent: str) -> str:
+    rows = [
+        f'    <tr><td bgcolor="#9cbae8"><b>{_html_escape(svc.name)}</b>'
+        f" ({svc.type.encode()}, x{svc.num_replicas})</td></tr>"
+    ]
+    if float(svc.error_rate):
+        rows.append(
+            f"    <tr><td>errorRate: {_html_escape(str(svc.error_rate))}</td></tr>"
+        )
+    for i, cmd in enumerate(svc.script):
+        rows.append(
+            f'    <tr><td port="s{i}">{_html_escape(_step_label(i, cmd))}</td></tr>'
+        )
+    label = (
+        '<<table border="0" cellborder="1" cellspacing="0">\n'
+        + "\n".join(rows)
+        + "\n  </table>>"
+    )
+    return f"{indent}{_dot_id(svc.name)} [label={label}];"
+
+
 def to_dot(graph: ServiceGraph) -> str:
     lines = [
         "digraph {",
         "  node [shape=plaintext];",
     ]
-    for svc in graph.services:
-        rows = [
-            f'    <tr><td bgcolor="#9cbae8"><b>{_html_escape(svc.name)}</b>'
-            f" ({svc.type.encode()}, x{svc.num_replicas})</td></tr>"
-        ]
-        if float(svc.error_rate):
-            rows.append(
-                f"    <tr><td>errorRate: {_html_escape(str(svc.error_rate))}</td></tr>"
-            )
-        for i, cmd in enumerate(svc.script):
-            rows.append(
-                f'    <tr><td port="s{i}">{_html_escape(_step_label(i, cmd))}</td></tr>'
-            )
-        label = (
-            '<<table border="0" cellborder="1" cellspacing="0">\n'
-            + "\n".join(rows)
-            + "\n  </table>>"
-        )
-        lines.append(f"  {_dot_id(svc.name)} [label={label}];")
+    clusters = {getattr(s, "cluster", "") for s in graph.services}
+    if len(clusters) > 1:
+        # multicluster topology: group nodes into DOT cluster subgraphs,
+        # mirroring the reference's cluster1/cluster2 split
+        # (perf/load/templates/service-graph.gen.yaml:1-3)
+        for ci, cname in enumerate(sorted(clusters)):
+            shown = cname or "default"
+            lines.append(f'  subgraph "cluster_{ci}" {{')
+            lines.append(f"    label={_dot_id(shown)};")
+            for svc in graph.services:
+                if getattr(svc, "cluster", "") == cname:
+                    lines.append(_node_lines(svc, "    "))
+            lines.append("  }")
+    else:
+        for svc in graph.services:
+            lines.append(_node_lines(svc, "  "))
     for svc in graph.services:
         for i, cmd in enumerate(svc.script):
             for callee in _callees(cmd):

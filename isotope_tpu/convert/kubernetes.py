@@ -45,6 +45,13 @@ class ConvertOptions:
     service_node_selector: Optional[dict] = None
     client_node_selector: Optional[dict] = None
     max_idle_connections_per_host: int = 0
+    # multicluster: emit only this cluster's Deployments/Services (the
+    # per-context apply of the reference's multicluster split,
+    # perf/load/common.sh:36-42); None = everything.  The ConfigMap
+    # always embeds the FULL topology — every pod needs the whole graph
+    # — and the load client deploys only alongside the entrypoint's
+    # cluster.
+    cluster: Optional[str] = None
 
 
 def service_graph_to_manifests(
@@ -53,14 +60,31 @@ def service_graph_to_manifests(
     opts: Optional[ConvertOptions] = None,
 ) -> List[dict]:
     opts = opts if opts is not None else ConvertOptions()
+    if opts.cluster is not None:
+        known = {getattr(s, "cluster", "") for s in graph.services}
+        if opts.cluster not in known:
+            raise ValueError(
+                f"no service is placed in cluster {opts.cluster!r}; "
+                f"topology clusters: {sorted(known)}"
+            )
     manifests: List[dict] = [
         _namespace(),
         _config_map(topology_yaml),
     ]
     for svc in graph.services:
+        if opts.cluster is not None and (
+            getattr(svc, "cluster", "") != opts.cluster
+        ):
+            continue
         manifests.append(_k8s_service(svc.name))
         manifests.append(_deployment(svc, opts))
-    manifests.extend(_fortio_client(opts))
+    entry_cluster = next(
+        (getattr(s, "cluster", "") for s in graph.services
+         if s.is_entrypoint),
+        "",
+    )
+    if opts.cluster is None or opts.cluster == entry_cluster:
+        manifests.extend(_fortio_client(opts))
     if opts.environment_name == "ISTIO":
         manifests.extend(_rbac_policies(graph))
     return manifests

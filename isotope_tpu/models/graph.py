@@ -20,7 +20,11 @@ from isotope_tpu.models.script import (
     RequestCommand,
     Script,
 )
-from isotope_tpu.models.service import Service, decode_strict_int
+from isotope_tpu.models.service import (
+    Service,
+    decode_cluster,
+    decode_strict_int,
+)
 from isotope_tpu.models.size import ByteSize
 from isotope_tpu.models.svctype import ServiceType
 
@@ -44,6 +48,7 @@ _DEFAULTS_FIELDS = {
     "requestSize",
     "numReplicas",
     "numRbacPolicies",
+    "cluster",
 }
 
 
@@ -170,6 +175,11 @@ def _effective_defaults(raw_defaults: dict):
             )
             if "numRbacPolicies" in raw_defaults
             else 0
+        ),
+        cluster=(
+            decode_cluster(raw_defaults["cluster"])
+            if "cluster" in raw_defaults
+            else ""
         ),
     )
     return default_service, default_request

@@ -29,6 +29,7 @@ _FIELDS = {
     "responseSize",
     "script",
     "numRbacPolicies",
+    "cluster",
 }
 
 
@@ -42,6 +43,14 @@ class Service:
     response_size: ByteSize = ByteSize(0)
     script: Script = dataclasses.field(default_factory=Script)
     num_rbac_policies: int = 0
+    # Extension beyond svc.Service: the reference splits one service
+    # graph across cluster1/cluster2 (+ VM workloads) at the helm layer
+    # (perf/load/templates/service-graph.gen.yaml:1-3, common.sh:36-42)
+    # so cross-cluster edges traverse egress/ingress gateways.  Here the
+    # placement is a first-class topology field; "" = the default
+    # cluster.  Cross-cluster edges pay NetworkModel's cross-cluster
+    # latency/bandwidth class.
+    cluster: str = ""
 
     @classmethod
     def decode(
@@ -91,6 +100,11 @@ class Service:
                 if "numRbacPolicies" in value
                 else default.num_rbac_policies
             ),
+            cluster=(
+                decode_cluster(value["cluster"])
+                if "cluster" in value
+                else default.cluster
+            ),
         )
 
     def encode(self, default: "Service | None" = None) -> dict:
@@ -117,6 +131,8 @@ class Service:
             out["script"] = self.script.encode()
         if self.num_rbac_policies != default.num_rbac_policies:
             out["numRbacPolicies"] = self.num_rbac_policies
+        if self.cluster != default.cluster:
+            out["cluster"] = self.cluster
         return out
 
 
@@ -124,6 +140,12 @@ def decode_strict_int(value, field: str) -> int:
     """Reject bools and non-integers (YAML typos should fail loudly)."""
     if isinstance(value, bool) or not isinstance(value, int):
         raise ValueError(f"{field} must be an integer: {value!r}")
+    return value
+
+
+def decode_cluster(value) -> str:
+    if not isinstance(value, str):
+        raise ValueError(f"cluster must be a string: {value!r}")
     return value
 
 

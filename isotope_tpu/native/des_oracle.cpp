@@ -45,6 +45,9 @@ struct Call {
   int target;
   double prob, size, timeout;
   int attempts;
+  // cross-cluster edge class: extra one-way latency (gateway traversal)
+  // and an edge-specific bandwidth (<= 0 means the default net_bps)
+  double extra, bps;
 };
 
 struct Step {
@@ -151,6 +154,13 @@ struct Sim {
   uint8_t* out_error;
 
   double one_way(double bytes) const { return net_base + bytes / net_bps; }
+
+  // per-edge wire time: cross-cluster calls pay the gateway extra and
+  // ride their own bandwidth (both legs of the call's edge)
+  double one_way_call(const Call& c, double bytes) const {
+    double bps = c.bps > 0.0 ? c.bps : net_bps;
+    return net_base + c.extra + bytes / bps;
+  }
 
   double uni() {
     return std::uniform_real_distribution<double>(0.0, 1.0)(rng);
@@ -273,7 +283,7 @@ struct Sim {
     ch->parent = a;
     ch->parent_gen = a->gen;
     ch->req = -1;
-    schedule(a->t_att + one_way(c.size), EV_ARRIVE, ch);
+    schedule(a->t_att + one_way_call(c, c.size), EV_ARRIVE, ch);
   }
 
   void resolve_attempt(Attempt* a, double dur, bool transport, bool err500,
@@ -347,8 +357,8 @@ struct Sim {
   void complete_job(Job* j, double t, bool err) {
     hops++;
     if (j->parent != nullptr) {
-      schedule(t + one_way(svcs[j->svc].resp), EV_ATT_RESP, j->parent,
-               err ? 1.0 : 0.0, j->parent_gen);
+      schedule(t + one_way_call(calls[j->parent->call], svcs[j->svc].resp),
+               EV_ATT_RESP, j->parent, err ? 1.0 : 0.0, j->parent_gen);
       delete j;
       return;
     }
@@ -490,7 +500,8 @@ int des_run(
     const int32_t* step_call_off, int32_t total_steps, int32_t total_calls,
     const int32_t* call_target, const double* call_prob,
     const double* call_size, const double* call_timeout,
-    const int32_t* call_attempts, int32_t entry,
+    const int32_t* call_attempts, const double* call_extra,
+    const double* call_bps, int32_t entry,
     // network + service-time model
     double net_base, double net_bps, int32_t st_kind, double cpu_mean,
     double st_param,
@@ -536,8 +547,10 @@ int des_run(
   sim.calls.resize(total_calls);
   for (int c = 0; c < total_calls; ++c) {
     if (call_target[c] < 0 || call_target[c] >= S) return -4;
-    sim.calls[c] = Call{call_target[c], call_prob[c], call_size[c],
-                        call_timeout[c], call_attempts[c]};
+    sim.calls[c] = Call{call_target[c],  call_prob[c], call_size[c],
+                        call_timeout[c], call_attempts[c],
+                        call_extra ? call_extra[c] : 0.0,
+                        call_bps ? call_bps[c] : 0.0};
   }
 
   // chaos -> piecewise-constant effective replica counts (mirrors

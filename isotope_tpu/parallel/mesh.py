@@ -3,11 +3,16 @@
 Axis conventions:
 
 - ``slice`` (optional, outermost): multi-slice scale-out — collectives
-  crossing it ride DCN, the analogue of the reference's
-  cluster1/cluster2 multicluster split
-  (perf/load/templates/service-graph.gen.yaml:1-3).  Per-request work
-  never crosses it; only the O(buckets) summary reduction does, so the
-  DCN traffic per run is a few KB regardless of request count;
+  crossing it ride DCN.  This is pure request-parallelism (more load
+  per wall-second); per-request work never crosses it and only the
+  O(buckets) summary reduction does, so the DCN traffic per run is a
+  few KB regardless of request count.  NOTE: it does NOT model the
+  reference's cluster1/cluster2 *topology* split — that is a property
+  of the simulated system, modeled by per-service ``cluster``
+  placement plus the cross-cluster NetworkModel edge class
+  (perf/load/templates/service-graph.gen.yaml:1-3; see
+  tests/test_multicluster.py), independent of how the simulation
+  itself is sharded;
 - ``data``: shards the request batch within a slice over ICI (every
   device simulates a disjoint slice of the arrival stream — the
   analogue of running more Fortio clients, perf/load/common.sh:68-90);

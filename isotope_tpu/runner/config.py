@@ -73,9 +73,9 @@ class EnvironmentModel:
         net = params.network
         return dataclasses.replace(
             params,
-            network=NetworkModel(
+            network=dataclasses.replace(
+                net,
                 base_latency_s=net.base_latency_s + extra,
-                bytes_per_second=net.bytes_per_second,
                 entry_extra_latency_s=(
                     net.entry_extra_latency_s + entry_extra
                 ),

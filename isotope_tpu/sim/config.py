@@ -34,11 +34,23 @@ class NetworkModel:
     ``entry_extra_latency_s`` is additional one-way latency on the
     client -> entrypoint edge only — the ingress-gateway traversal of
     the reference's "ingress" sidecar mode (runner.py:96,190-197).
+
+    ``cross_cluster_latency_s`` / ``cross_cluster_bytes_per_second``
+    form the cross-cluster edge class: the reference splits one service
+    graph across cluster1/cluster2 (+ VMs) so cross-cluster calls
+    traverse an egress gateway, inter-cluster network, and the remote
+    ingress gateway (perf/load/templates/service-graph.gen.yaml:1-3,
+    common.sh:36-42).  Edges between services with different
+    ``cluster`` fields pay the extra one-way latency and ride the
+    (usually lower) cross-cluster bandwidth; ``None`` bandwidth means
+    same as intra-cluster.
     """
 
     base_latency_s: float = 250e-6
     bytes_per_second: float = 1.25e9  # 10 Gbit/s
     entry_extra_latency_s: float = 0.0
+    cross_cluster_latency_s: float = 1e-3
+    cross_cluster_bytes_per_second: Optional[float] = None
 
     def one_way(self, size_bytes):
         return self.base_latency_s + size_bytes / self.bytes_per_second
@@ -103,10 +115,9 @@ class SimParams:
             raise ValueError("sibling_copula_r must be in [0, 1)")
         if not 0.0 <= self.retry_copula_r < 1.0:
             raise ValueError("retry_copula_r must be in [0, 1)")
-        if self.sibling_copula_r + self.retry_copula_r >= 1.0:
-            raise ValueError(
-                "sibling_copula_r + retry_copula_r must be < 1"
-            )
+        # (sibling_copula_r + retry_copula_r < 1 is required only for
+        # hops inside a multi-attempt call; the Simulator enforces it
+        # when such calls exist)
 
 
 @dataclasses.dataclass(frozen=True)

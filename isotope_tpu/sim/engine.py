@@ -40,7 +40,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from isotope_tpu.compiler.program import CompiledGraph
+from isotope_tpu.compiler.program import CompiledGraph, hop_wire_times
 from isotope_tpu.sim import queueing
 from isotope_tpu.sim.config import (
     CLOSED_LOOP,
@@ -348,13 +348,10 @@ class Simulator:
         hs = compiled.hop_service
         self._hop_service = jnp.asarray(hs)
         self._hop_err_rate = jnp.asarray(t.error_rate[hs])
-        resp = t.response_size.astype(np.float64)
-        req = compiled.hop_request_size.astype(np.float64)
-        net_out = net.base_latency_s + req / net.bytes_per_second
-        net_back = net.base_latency_s + resp[hs] / net.bytes_per_second
-        # the client -> entrypoint edge may traverse an ingress gateway
-        net_out[0] += net.entry_extra_latency_s
-        net_back[0] += net.entry_extra_latency_s
+        # cluster-aware wire times: cross-cluster edges pay the gateway
+        # class, and the client -> entrypoint edge may traverse an
+        # ingress gateway (compiler/program.py hop_wire_times)
+        net_out, net_back = hop_wire_times(compiled, net)
         self._root_net = float(net_out[0] + net_back[0])
         # payload-free entry one-way: root start offset + refused-conn cost
         self._entry_one_way = net.entry_one_way(0.0)
@@ -535,6 +532,14 @@ class Simulator:
         self._retry_group = np.where(in_rg, rg, n_rg).astype(np.int32)
         self._num_retry_groups = n_rg
         self._retry_active = n_rg > 0 and params.retry_copula_r > 0.0
+        if self._retry_active and (
+            params.sibling_copula_r + params.retry_copula_r >= 1.0
+        ):
+            raise ValueError(
+                "sibling_copula_r + retry_copula_r must be < 1 when the "
+                "topology has multi-attempt calls (both correlations "
+                "apply to retry hops)"
+            )
         # per-hop weight of the retry-group normal (0 outside any group)
         self._retry_w = np.where(
             in_rg, np.sqrt(params.retry_copula_r), 0.0

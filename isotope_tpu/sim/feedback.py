@@ -41,7 +41,7 @@ from typing import List, Optional
 
 import numpy as np
 
-from isotope_tpu.compiler.program import CompiledGraph
+from isotope_tpu.compiler.program import CompiledGraph, hop_wire_times
 from isotope_tpu.sim.queueing import _MAX_RHO
 
 
@@ -143,14 +143,8 @@ class RetryFeedback:
 
         t = compiled.services
         self._err = t.error_rate.astype(np.float64)
-        net = params.network
-        resp = t.response_size.astype(np.float64)
-        req = compiled.hop_request_size.astype(np.float64)
         hs = compiled.hop_service
-        net_out = net.base_latency_s + req / net.bytes_per_second
-        net_back = net.base_latency_s + resp[hs] / net.bytes_per_second
-        net_out[0] += net.entry_extra_latency_s
-        net_back[0] += net.entry_extra_latency_s
+        net_out, net_back = hop_wire_times(compiled, params.network)
 
         self.active = False
         self._levels: List[_LevelCalls] = []

@@ -56,7 +56,7 @@ def _bind():
         ctypes.c_int32, _i32p, _f64p, _f64p,                 # services
         _i32p, _f64p, _i32p,                                 # script offsets
         ctypes.c_int32, ctypes.c_int32,                      # totals
-        _i32p, _f64p, _f64p, _f64p, _i32p,                   # calls
+        _i32p, _f64p, _f64p, _f64p, _i32p, _f64p, _f64p,     # calls
         ctypes.c_int32,                                      # entry
         ctypes.c_double, ctypes.c_double,                    # network
         ctypes.c_int32, ctypes.c_double, ctypes.c_double,    # service time
@@ -142,11 +142,18 @@ class OracleSimulator:
             [float(int(s.response_size)) for s in graph.services], np.float64
         )
 
+        # cross-cluster edge class (NetworkModel cross_cluster_*): a call
+        # whose caller and callee have different ``cluster`` fields pays
+        # the gateway extra and rides the cross bandwidth
+        clusters = [getattr(s, "cluster", "") for s in graph.services]
+        net = params.network
+        cross_bps = net.cross_cluster_bytes_per_second or 0.0
+
         svc_step_off = [0]
         step_base: list = []
         step_call_off = [0]
-        ct, cp, cs, cto, ca = [], [], [], [], []
-        for s in graph.services:
+        ct, cp, cs, cto, ca, cex, cbp = [], [], [], [], [], [], []
+        for si, s in enumerate(graph.services):
             for step in _lower_script(s.script, idx):
                 step_base.append(step.base)
                 for call in step.calls:
@@ -158,6 +165,9 @@ class OracleSimulator:
                         else math.inf
                     )
                     ca.append(call.attempts)
+                    cross = clusters[si] != clusters[call.target]
+                    cex.append(net.cross_cluster_latency_s if cross else 0.0)
+                    cbp.append(cross_bps if cross else 0.0)
                 step_call_off.append(len(ct))
             svc_step_off.append(len(step_base))
         self._svc_step_off = np.asarray(svc_step_off, np.int32)
@@ -168,6 +178,8 @@ class OracleSimulator:
         self._call_size = np.asarray(cs, np.float64)
         self._call_timeout = np.asarray(cto, np.float64)
         self._call_attempts = np.asarray(ca, np.int32)
+        self._call_extra = np.asarray(cex, np.float64)
+        self._call_bps = np.asarray(cbp, np.float64)
 
         self._chaos_svc = np.asarray(
             [idx[ev.service] for ev in chaos], np.int32
@@ -216,7 +228,8 @@ class OracleSimulator:
             self._svc_step_off, self._step_base, self._step_call_off,
             len(self._step_base), len(self._call_target),
             self._call_target, self._call_prob, self._call_size,
-            self._call_timeout, self._call_attempts, self._entry,
+            self._call_timeout, self._call_attempts, self._call_extra,
+            self._call_bps, self._entry,
             float(net.base_latency_s), float(net.bytes_per_second),
             _ST_KIND[self.params.service_time],
             float(self.params.cpu_time_s),

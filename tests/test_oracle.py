@@ -263,6 +263,30 @@ def test_open_loop_fidelity(name, yaml_text, rho):
     fidelity_case(yaml_text, load, tol_p50=0.05, tol_p99=0.05)
 
 
+@pytest.mark.parametrize(
+    "name,yaml_text,rho,tol_p50,tol_p99",
+    [
+        # chains stay exact at high rho: each M/M/1 stage's departure
+        # process is Poisson (Burke), so the per-station stationary law
+        # composes without error (measured at <=1.4%)
+        ("chain3", CHAIN3, 0.85, 0.03, 0.03),
+        ("chain3", CHAIN3, 0.90, 0.03, 0.03),
+        # fork-join trees drift as rho -> 1: subtree compositions are
+        # hierarchically correlated in ways the flat sibling copula
+        # can't carry (measured +4.5%/+1.8% at 0.85, +7.7%/+3.6% at
+        # 0.9) — the documented envelope edge, CI-enforced here
+        ("tree13", TREE13, 0.85, 0.06, 0.04),
+        ("tree13", TREE13, 0.90, 0.10, 0.05),
+    ],
+)
+def test_open_loop_high_rho_envelope(name, yaml_text, rho, tol_p50, tol_p99):
+    load = LoadModel(kind="open", qps=rho * MU)
+    fidelity_case(
+        yaml_text, load, tol_p50=tol_p50, tol_p99=tol_p99,
+        n_engine=300_000, n_oracle=1_500_000, warmup=2.0,
+    )
+
+
 def test_closed_loop_paced_fidelity():
     # fortio's latency-benchmark mode: finite qps, many connections
     load = LoadModel(kind="closed", qps=0.5 * MU, connections=64)
