@@ -76,6 +76,17 @@ struct Job {  // one hop execution (one service invocation)
   int64_t req;
   double t_send;
   int conn;
+  // lifecycle (ungraceful-kill support): gen invalidates pending
+  // CPU_DONE/STEP_DONE events after an abort; refs counts pending job
+  // events + live child attempts so the struct outlives stale
+  // references; res_idx is the slot in the station's resident list
+  int gen = 0;
+  int refs = 0;
+  int res_idx = -1;
+  double t_cpu_end = 0.0;  // scheduled CPU completion (abort accounting)
+  bool in_cpu = false;
+  bool finished = false;
+  bool aborted = false;
 };
 
 struct Attempt {  // one call site's serial retry chain
@@ -119,6 +130,9 @@ struct Station {
   std::deque<Job*> q;
   double busy_time = 0.0;
   int64_t arrivals = 0;
+  // every job currently resident at this service (queued, in CPU, or
+  // awaiting downstream) — the set an ungraceful replica kill samples
+  std::vector<Job*> residents;
 };
 
 struct Sim {
@@ -136,6 +150,9 @@ struct Sim {
   // chaos phases
   std::vector<double> phase_starts;       // ascending, [0] == 0
   std::vector<std::vector<int>> phase_k;  // per phase, per service
+  // per phase: (service, kill fraction) for drain=false events starting
+  // at that cut — each resident dies with probability down / k_before
+  std::vector<std::vector<std::pair<int, double>>> phase_aborts;
   // load
   int load_kind;  // 0 open, 1 closed
   double qps;     // <= 0 => closed-loop "max"
@@ -191,17 +208,41 @@ struct Sim {
 
   // ---- stations --------------------------------------------------------
 
+  void maybe_free_job(Job* j) {
+    if (j->finished && j->refs == 0) delete j;
+  }
+
+  void residents_add(Job* j) {
+    Station& s = stations[j->svc];
+    j->res_idx = static_cast<int>(s.residents.size());
+    s.residents.push_back(j);
+  }
+
+  void residents_remove(Job* j) {
+    if (j->res_idx < 0) return;
+    Station& s = stations[j->svc];
+    Job* last = s.residents.back();
+    s.residents[j->res_idx] = last;
+    last->res_idx = j->res_idx;
+    s.residents.pop_back();
+    j->res_idx = -1;
+  }
+
   void dispatch(Job* j, double t) {
     Station& s = stations[j->svc];
     s.busy++;
+    j->in_cpu = true;
+    j->refs++;
     double d = cpu_draw();
     s.busy_time += d;
-    schedule(t + d, EV_CPU_DONE, j);
+    j->t_cpu_end = t + d;
+    schedule(t + d, EV_CPU_DONE, j, 0.0, j->gen);
   }
 
   void on_arrive(Job* j, double t) {
     Station& s = stations[j->svc];
     s.arrivals++;
+    residents_add(j);
     if (s.busy < s.k) {
       dispatch(j, t);
     } else {
@@ -209,7 +250,13 @@ struct Sim {
     }
   }
 
-  void on_cpu_done(Job* j, double t) {
+  void on_cpu_done(Job* j, double t, int gen) {
+    j->refs--;
+    if (gen != j->gen) {  // aborted mid-CPU: busy already released
+      maybe_free_job(j);
+      return;
+    }
+    j->in_cpu = false;
     Station& s = stations[j->svc];
     s.busy--;
     if (!s.q.empty() && s.busy < s.k) {
@@ -247,7 +294,8 @@ struct Sim {
       }
     }
     if (sent_calls.empty()) {
-      schedule(t + st.base, EV_STEP_DONE, j);
+      j->refs++;
+      schedule(t + st.base, EV_STEP_DONE, j, 0.0, j->gen);
       return;
     }
     j->outstanding = static_cast<int>(sent_calls.size());
@@ -255,6 +303,7 @@ struct Sim {
       Attempt* a = new Attempt{j,   c, calls[c].attempts, 0.0,
                                t,   0, -1,
                                0,   false};
+      j->refs++;  // the attempt holds a reference to its caller
       start_attempt(a);
       // an all-attempts-down chain resolves synchronously with no events
       // ever scheduled; this is its only chance to be freed
@@ -291,7 +340,9 @@ struct Sim {
     a->dur_acc += dur;
     a->remaining--;
     bool failed = transport || err500;
-    if (failed && a->remaining > 0) {
+    // a caller killed ungracefully can't issue new retries — only its
+    // already-running children continue
+    if (failed && a->remaining > 0 && !a->caller->aborted) {
       a->t_att = t_now;  // serial retry: next attempt starts immediately
       start_attempt(a);
       return;
@@ -304,7 +355,12 @@ struct Sim {
   }
 
   void maybe_free(Attempt* a) {
-    if (a->reported && a->pending == 0) delete a;
+    if (a->reported && a->pending == 0) {
+      Job* caller = a->caller;
+      delete a;
+      caller->refs--;
+      maybe_free_job(caller);
+    }
   }
 
   void on_att_timeout(Attempt* a, double t, int gen) {
@@ -317,28 +373,37 @@ struct Sim {
     maybe_free(a);
   }
 
-  void on_att_resp(Attempt* a, double t, int gen, bool child_err) {
+  void on_att_resp(Attempt* a, double t, int gen, int code) {
+    // code: 0 = ok, 1 = http 500 (retries, not transport), 2 = reset
+    // from an ungraceful kill (transport: truncates + retries)
     a->pending--;
     if (gen == a->gen && a->resolved_gen != a->gen) {
       a->resolved_gen = a->gen;
       // duration includes both wire legs + the child's sojourn; a 500
       // triggers a retry but is not a transport failure
-      resolve_attempt(a, t - a->t_att, false, child_err, t);
+      resolve_attempt(a, t - a->t_att, code == 2, code == 1, t);
     }
     maybe_free(a);
   }
 
   void finish_call(Job* j, double dur, bool transport) {
+    if (j->aborted) return;  // the killed job reported its reset already
     if (dur > j->step_call_max) j->step_call_max = dur;
     j->transport |= transport;
     if (--j->outstanding == 0) {
       const Step& st = steps[j->step];
       double base = st.base > j->step_call_max ? st.base : j->step_call_max;
-      schedule(j->t_step_start + base, EV_STEP_DONE, j);
+      j->refs++;
+      schedule(j->t_step_start + base, EV_STEP_DONE, j, 0.0, j->gen);
     }
   }
 
-  void on_step_done(Job* j, double t) {
+  void on_step_done(Job* j, double t, int gen) {
+    j->refs--;
+    if (gen != j->gen) {
+      maybe_free_job(j);
+      return;
+    }
     if (j->transport) {
       // transport failure truncates the script after the failing step
       // and the hop itself returns 500 upward (handler.go:66-76)
@@ -356,16 +421,55 @@ struct Sim {
 
   void complete_job(Job* j, double t, bool err) {
     hops++;
+    residents_remove(j);
+    j->finished = true;
     if (j->parent != nullptr) {
       schedule(t + one_way_call(calls[j->parent->call], svcs[j->svc].resp),
                EV_ATT_RESP, j->parent, err ? 1.0 : 0.0, j->parent_gen);
-      delete j;
+      maybe_free_job(j);
       return;
     }
     // root: client receives at t + one_way(entry response size)
     double lat = (t - j->t_send) + one_way(svcs[j->svc].resp);
     finish_request(j->req, j->t_send, lat, err, j->conn);
-    delete j;
+    maybe_free_job(j);
+  }
+
+  // ungraceful replica kill: the request dies where it stands with a
+  // connection reset — a TRANSPORT error at its caller (which truncates
+  // the caller's script and retries if attempts remain); its own
+  // outstanding downstream children keep running, uncancelled
+  void abort_job(Job* j, double t) {
+    hops++;  // the hop executed (partially) — it was really resident
+    residents_remove(j);
+    j->aborted = true;
+    j->gen++;  // invalidate pending CPU_DONE / STEP_DONE events
+    Station& s = stations[j->svc];
+    if (j->in_cpu) {
+      j->in_cpu = false;
+      s.busy--;
+      // un-credit the CPU time the kill prevented from being served
+      if (j->t_cpu_end > t) s.busy_time -= j->t_cpu_end - t;
+    } else {
+      // may be waiting in the dispatch queue: drop it there
+      for (auto it = s.q.begin(); it != s.q.end(); ++it) {
+        if (*it == j) {
+          s.q.erase(it);
+          break;
+        }
+      }
+    }
+    j->finished = true;
+    if (j->parent != nullptr) {
+      // the reset travels back one payload-free wire leg
+      schedule(t + one_way_call(calls[j->parent->call], 0.0), EV_ATT_RESP,
+               j->parent, 2.0, j->parent_gen);
+      maybe_free_job(j);
+      return;
+    }
+    finish_request(j->req, j->t_send, (t - j->t_send) + one_way(0.0), true,
+                   j->conn);
+    maybe_free_job(j);
   }
 
   // ---- client ----------------------------------------------------------
@@ -417,6 +521,16 @@ struct Sim {
   }
 
   void on_phase(double /*t*/, int phase, double t_now) {
+    // ungraceful kills first: each resident of the killed service dies
+    // with probability down / k_before (it sat on one of the killed
+    // replicas) — queued, in CPU, or awaiting downstream alike
+    for (const auto& ab : phase_aborts[phase]) {
+      Station& st = stations[ab.first];
+      std::vector<Job*> snap = st.residents;
+      for (Job* j : snap) {
+        if (uni() < ab.second) abort_job(j, t_now);
+      }
+    }
     for (size_t s = 0; s < stations.size(); ++s) {
       stations[s].k = phase_k[phase][s];
       Station& st = stations[s];
@@ -462,17 +576,17 @@ struct Sim {
           on_arrive(static_cast<Job*>(ev.p), ev.t);
           break;
         case EV_CPU_DONE:
-          on_cpu_done(static_cast<Job*>(ev.p), ev.t);
+          on_cpu_done(static_cast<Job*>(ev.p), ev.t, ev.iaux);
           break;
         case EV_STEP_DONE:
-          on_step_done(static_cast<Job*>(ev.p), ev.t);
+          on_step_done(static_cast<Job*>(ev.p), ev.t, ev.iaux);
           break;
         case EV_ATT_TIMEOUT:
           on_att_timeout(static_cast<Attempt*>(ev.p), ev.t, ev.iaux);
           break;
         case EV_ATT_RESP:
           on_att_resp(static_cast<Attempt*>(ev.p), ev.t, ev.iaux,
-                      ev.aux != 0.0);
+                      static_cast<int>(ev.aux + 0.5));
           break;
         case EV_PHASE:
           on_phase(ev.t, ev.iaux, ev.t);
@@ -505,9 +619,11 @@ int des_run(
     // network + service-time model
     double net_base, double net_bps, int32_t st_kind, double cpu_mean,
     double st_param,
-    // chaos events (replicas_down < 0 means all)
+    // chaos events (replicas_down < 0 means all; chaos_drain[i] == 0
+    // aborts the killed replicas' resident requests at the window start)
     int32_t n_chaos, const int32_t* chaos_svc, const double* chaos_start,
     const double* chaos_end, const int32_t* chaos_down,
+    const uint8_t* chaos_drain,
     // load
     int32_t load_kind, double qps, int32_t connections,
     double pace_jitter, int64_t n_requests, uint64_t seed,
@@ -564,6 +680,7 @@ int des_run(
   cuts.erase(std::unique(cuts.begin(), cuts.end()), cuts.end());
   sim.phase_starts = cuts;
   sim.phase_k.assign(cuts.size(), std::vector<int>(S));
+  sim.phase_aborts.assign(cuts.size(), {});
   for (size_t p = 0; p < cuts.size(); ++p) {
     for (int s = 0; s < S; ++s) sim.phase_k[p][s] = replicas[s];
     for (int i = 0; i < n_chaos; ++i) {
@@ -572,6 +689,18 @@ int des_run(
         int down = chaos_down[i] < 0 ? replicas[s] : chaos_down[i];
         sim.phase_k[p][s] -= down;
         if (sim.phase_k[p][s] < 0) sim.phase_k[p][s] = 0;
+      }
+      // an ungraceful event whose window STARTS at this cut kills its
+      // share of the service's residents (down / k in the prior phase)
+      if (chaos_drain && !chaos_drain[i] && chaos_start[i] == cuts[p] &&
+          p > 0) {
+        int s = chaos_svc[i];
+        int down = chaos_down[i] < 0 ? replicas[s] : chaos_down[i];
+        int k_before = sim.phase_k[p - 1][s];
+        if (k_before > 0) {
+          double frac = static_cast<double>(down) / k_before;
+          sim.phase_aborts[p].emplace_back(s, frac > 1.0 ? 1.0 : frac);
+        }
       }
     }
   }

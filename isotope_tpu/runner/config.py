@@ -20,6 +20,7 @@ from typing import Dict, List, Optional, Tuple
 
 from isotope_tpu.sim.config import (
     ChaosEvent,
+    bounce_schedule,
     LoadModel,
     NetworkModel,
     SimParams,
@@ -233,14 +234,39 @@ def load_toml(path) -> ExperimentConfig:
     chaos: List[ChaosEvent] = []
     for ev in doc.get("chaos", []):
         down = ev.get("replicas_down", "all")
-        chaos.append(
-            ChaosEvent(
-                service=ev["service"],
-                start_s=dur.parse_duration_seconds(ev["start"]),
-                end_s=dur.parse_duration_seconds(ev["end"]),
-                replicas_down=None if down == "all" else int(down),
+        down_n = None if down == "all" else int(down)
+        drain = bool(ev.get("drain", True))
+        start = dur.parse_duration_seconds(ev["start"])
+        end = dur.parse_duration_seconds(ev["end"])
+        if "period" in ev or "repeat" in ev:
+            # rolling-restart shorthand (gateway-bouncer): repeat the
+            # [start, end) window every `period` for `repeat` cycles
+            if "period" not in ev:
+                raise ValueError(
+                    f"[[chaos]] block for {ev['service']!r} sets "
+                    "'repeat' without 'period'"
+                )
+            chaos.extend(
+                bounce_schedule(
+                    service=ev["service"],
+                    period_s=dur.parse_duration_seconds(ev["period"]),
+                    down_s=end - start,
+                    count=int(ev.get("repeat", 1)),
+                    start_s=start,
+                    replicas_down=down_n,
+                    drain=drain,
+                )
             )
-        )
+        else:
+            chaos.append(
+                ChaosEvent(
+                    service=ev["service"],
+                    start_s=start,
+                    end_s=end,
+                    replicas_down=down_n,
+                    drain=drain,
+                )
+            )
 
     # [[churn]]: the config-churner analogue (rotating traffic weights)
     churn: List[TrafficSplit] = []

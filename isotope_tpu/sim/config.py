@@ -130,12 +130,26 @@ class ChaosEvent:
     them after chaosDurationMinutes).  ``replicas_down=None`` means all
     replicas (total outage: callers get transport errors, which — unlike
     downstream 500s — DO propagate, srv/handler.go:66-76).
+
+    ``drain`` selects the shutdown policy at the window's start — the
+    axis the reference's graceful-shutdown stability test exercises
+    (perf/stability/graceful-shutdown: a long in-flight request across
+    a replica kill):
+
+    - ``True`` (default, graceful): killed replicas finish their
+      in-flight requests; only *new* work sees the reduced capacity
+      (Kubernetes' default terminationGracePeriod behavior).
+    - ``False`` (ungraceful): requests resident on a killed replica at
+      the kill instant die with a connection reset — a transport error
+      at their caller.  Each resident request dies with probability
+      ``replicas_down / alive-replicas-before-the-kill``.
     """
 
     service: str
     start_s: float
     end_s: float
     replicas_down: Optional[int] = None  # None == all
+    drain: bool = True
 
     def __post_init__(self):
         if self.end_s <= self.start_s:
@@ -144,6 +158,41 @@ class ChaosEvent:
             raise ValueError("chaos window must start at t >= 0")
         if self.replicas_down is not None and self.replicas_down <= 0:
             raise ValueError("replicas_down must be positive (or None=all)")
+
+
+def bounce_schedule(
+    service: str,
+    period_s: float,
+    down_s: float,
+    count: int,
+    start_s: float = 0.0,
+    replicas_down: Optional[int] = None,
+    drain: bool = True,
+) -> "tuple[ChaosEvent, ...]":
+    """Rolling-restart chaos: ``count`` outage windows of ``down_s``
+    seconds, one per ``period_s``.
+
+    The simulation analogue of the reference's gateway-bouncer
+    (perf/stability/gateway-bouncer/README.md:14-21: the ingress
+    gateway is rolling-restarted on a loop and fortio clients crash on
+    the connection errors the bounce causes).  Point it at the
+    entrypoint service to bounce the ingress: during each window the
+    entry refuses connections, outside the windows traffic is clean.
+    """
+    if down_s <= 0 or down_s > period_s:
+        raise ValueError("bounce needs 0 < down_s <= period_s")
+    if count <= 0:
+        raise ValueError("bounce count must be positive")
+    return tuple(
+        ChaosEvent(
+            service=service,
+            start_s=start_s + i * period_s,
+            end_s=start_s + i * period_s + down_s,
+            replicas_down=replicas_down,
+            drain=drain,
+        )
+        for i in range(count)
+    )
 
 
 @dataclasses.dataclass(frozen=True)

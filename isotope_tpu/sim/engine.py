@@ -301,6 +301,41 @@ class Simulator:
         self._eff_replicas = jnp.asarray(np.maximum(eff, 1), jnp.int32)
         self.has_chaos = bool(chaos)
 
+        # -- ungraceful kills (drain=False): resident-request resets -------
+        # A graceful kill (default) only removes capacity; an ungraceful
+        # one also resets the requests resident on the killed replicas at
+        # the kill instant (perf/stability/graceful-shutdown).  The
+        # engine applies this post-hoc to requests whose hop on the
+        # killed service straddles the kill time: each dies w.p.
+        # down/k and the client sees a transport failure at ~the kill
+        # instant.  Approximations (the oracle models them exactly):
+        # retries of the killed call and mid-tree truncation effects on
+        # downstream metrics are not re-simulated, and closed-loop
+        # pacing keeps the uninterrupted latency.
+        kills = []
+        for ev in sorted(chaos, key=lambda e: e.start_s):
+            if ev.drain:
+                continue
+            s = name_to_idx[ev.service]
+            down = (
+                int(t.replicas[s])
+                if ev.replicas_down is None
+                else ev.replicas_down
+            )
+            # the residents are spread over the replicas ALIVE just
+            # before this kill (the prior phase's effective count, which
+            # overlapping chaos windows may already have reduced) — the
+            # same denominator the DES oracle uses
+            p = cuts.index(ev.start_s)
+            k_before = int(eff[p - 1, s]) if p > 0 else int(t.replicas[s])
+            if k_before <= 0:
+                continue  # already fully down: nothing resident to kill
+            cols = np.nonzero(compiled.hop_service == s)[0]
+            kills.append(
+                (float(ev.start_s), cols, min(down / k_before, 1.0))
+            )
+        self._kills = tuple(kills)
+
         # -- per-(chaos x churn)-phase offered load ------------------------
         # A total outage changes WHERE load flows, not just capacity: a
         # transport error truncates its caller's script, so services in
@@ -1551,6 +1586,31 @@ class Simulator:
         client_error = err_hop[:, 0]
         if root_down is not None:
             client_error = client_error | root_down
+        # ungraceful kills: a request whose hop on the killed service is
+        # in flight at the kill instant dies (transport) w.p. down/k —
+        # the client sees the reset at ~the kill time (see __init__)
+        if self._kills:
+            died_any = jnp.zeros(n, bool)
+            for i, (t_k, cols, frac) in enumerate(self._kills):
+                strad = (
+                    hop_sent[:, cols]
+                    & (hop_start[:, cols] < t_k)
+                    & (hop_start[:, cols] + hop_lat[:, cols] > t_k)
+                )
+                coin = (
+                    jax.random.uniform(
+                        jax.random.fold_in(key, 9_990_000 + i),
+                        strad.shape,
+                    )
+                    < frac
+                )
+                died = (strad & coin).any(axis=1) & ~died_any
+                reset_lat = (
+                    jnp.maximum(t_k - arrivals, 0.0) + self._root_net
+                )
+                root_lat = jnp.where(died, reset_lat, root_lat)
+                client_error = client_error | died
+                died_any = died_any | died
         res = SimResults(
             client_start=arrivals,
             client_latency=root_lat,

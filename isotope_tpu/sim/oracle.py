@@ -60,7 +60,7 @@ def _bind():
         ctypes.c_int32,                                      # entry
         ctypes.c_double, ctypes.c_double,                    # network
         ctypes.c_int32, ctypes.c_double, ctypes.c_double,    # service time
-        ctypes.c_int32, _i32p, _f64p, _f64p, _i32p,          # chaos
+        ctypes.c_int32, _i32p, _f64p, _f64p, _i32p, _u8p,    # chaos
         ctypes.c_int32, ctypes.c_double, ctypes.c_int32,     # load
         ctypes.c_double,                                     # pace jitter
         ctypes.c_int64, ctypes.c_uint64,                     # n, seed
@@ -193,6 +193,9 @@ class OracleSimulator:
              for ev in chaos],
             np.int32,
         )
+        self._chaos_drain = np.asarray(
+            [bool(getattr(ev, "drain", True)) for ev in chaos], np.uint8
+        )
         self._fn = _bind()
 
     def run(
@@ -235,7 +238,7 @@ class OracleSimulator:
             float(self.params.cpu_time_s),
             float(self.params.service_time_param),
             len(self._chaos_svc), self._chaos_svc, self._chaos_start,
-            self._chaos_end, self._chaos_down,
+            self._chaos_end, self._chaos_down, self._chaos_drain,
             kind, qps, conns, float(pace_jitter), n, seed,
             out_start, out_lat, out_err, out_busy, out_arr,
             ctypes.byref(out_hops),

@@ -1,0 +1,180 @@
+"""Stability-scenario analogues: gateway-bouncer and graceful-shutdown.
+
+The reference's stability suite includes two scenarios with clean
+simulation analogues (SURVEY.md §2.3 #28):
+
+- **gateway-bouncer** (perf/stability/gateway-bouncer/README.md:14-21):
+  the ingress gateway is rolling-restarted on a loop; fortio clients
+  crash on the connection errors each bounce causes.  Analogue:
+  ``bounce_schedule`` pointed at the entrypoint — repeated total-outage
+  windows during which the entry refuses connections.
+- **graceful-shutdown** (perf/stability/graceful-shutdown/): a long
+  in-flight request across a replica kill.  Analogue:
+  ``ChaosEvent(drain=...)`` — graceful kills only remove capacity
+  (in-flight requests complete); ungraceful kills reset the requests
+  resident on the killed replicas (transport errors at the client).
+"""
+import jax
+import numpy as np
+import pytest
+
+from isotope_tpu.compiler import compile_graph
+from isotope_tpu.models.graph import ServiceGraph
+from isotope_tpu.sim import LoadModel, SimParams, Simulator
+from isotope_tpu.sim.config import ChaosEvent, bounce_schedule
+from isotope_tpu.sim.oracle import OracleSimulator
+
+KEY = jax.random.PRNGKey(11)
+MU = 1.0 / SimParams().cpu_time_s
+
+LONG_REQUEST = """
+services:
+- name: entry
+  isEntrypoint: true
+  numReplicas: 4
+  script: [{call: worker}]
+- name: worker
+  numReplicas: 4
+  script: [{sleep: 2s}]
+"""
+
+SIMPLE = """
+services:
+- name: entry
+  isEntrypoint: true
+  numReplicas: 2
+"""
+
+
+def test_bounce_schedule_windows():
+    evs = bounce_schedule("gw", period_s=60.0, down_s=5.0, count=3,
+                          start_s=10.0)
+    assert [(e.start_s, e.end_s) for e in evs] == [
+        (10.0, 15.0), (70.0, 75.0), (130.0, 135.0)
+    ]
+    assert all(e.replicas_down is None and e.drain for e in evs)
+    with pytest.raises(ValueError, match="down_s"):
+        bounce_schedule("gw", period_s=5.0, down_s=6.0, count=1)
+
+
+def test_gateway_bouncer_errors_only_in_bounce_windows():
+    # rolling entry restarts: connection errors DURING each bounce
+    # window, clean traffic outside — the detector the reference's
+    # fortio clients implement by crashing on errors
+    graph = ServiceGraph.from_yaml(SIMPLE)
+    chaos = bounce_schedule("entry", period_s=10.0, down_s=2.0, count=4,
+                            start_s=5.0)
+    engine = Simulator(compile_graph(graph), SimParams(), chaos)
+    load = LoadModel(kind="open", qps=2000.0)
+    res = engine.run(load, 80_000, KEY)
+    st = np.asarray(res.client_start)
+    err = np.asarray(res.client_error)
+    in_bounce = np.zeros_like(err)
+    for ev in chaos:
+        in_bounce |= (st >= ev.start_s) & (st < ev.end_s)
+    # all bounce-window requests are refused; all others succeed
+    assert err[in_bounce].all()
+    assert not err[~in_bounce].any()
+    # refused connections cost one wire round trip, not a full request
+    lat = np.asarray(res.client_latency)
+    assert lat[in_bounce].max() < lat[~in_bounce].min()
+
+    # the oracle agrees on the error fraction
+    oracle = OracleSimulator(graph, SimParams(), chaos)
+    ro = oracle.run(load, 80_000, seed=0)
+    assert float(err.mean()) == pytest.approx(
+        float(ro.client_error.mean()), abs=0.01
+    )
+
+
+def test_graceful_kill_completes_inflight_requests():
+    # drain=True (default): killed replicas finish their in-flight
+    # work; with capacity to spare no client ever sees an error
+    graph = ServiceGraph.from_yaml(LONG_REQUEST)
+    chaos = (ChaosEvent(service="worker", start_s=10.0, end_s=30.0,
+                        replicas_down=2, drain=True),)
+    load = LoadModel(kind="open", qps=50.0)
+    engine = Simulator(compile_graph(graph), SimParams(), chaos)
+    res = engine.run(load, 2_000, KEY)
+    assert not np.asarray(res.client_error).any()
+    oracle = OracleSimulator(graph, SimParams(), chaos)
+    ro = oracle.run(load, 2_000, seed=0)
+    assert not ro.client_error.any()
+
+
+def test_ungraceful_kill_resets_inflight_requests():
+    # drain=False: requests resident on the 2 killed replicas (of 4)
+    # at t=10 die with a connection reset.  With 2 s of sleep per
+    # request, arrivals in ~[8, 10) are in flight at the kill — about
+    # half of them (2/4 replicas) must fail, in engine AND oracle.
+    graph = ServiceGraph.from_yaml(LONG_REQUEST)
+    chaos = (ChaosEvent(service="worker", start_s=10.0, end_s=30.0,
+                        replicas_down=2, drain=False),)
+    load = LoadModel(kind="open", qps=50.0)
+    engine = Simulator(compile_graph(graph), SimParams(), chaos)
+    res = engine.run(load, 2_000, KEY)
+    st = np.asarray(res.client_start)
+    err = np.asarray(res.client_error)
+    lat = np.asarray(res.client_latency)
+
+    oracle = OracleSimulator(graph, SimParams(), chaos)
+    ro = oracle.run(load, 2_000, seed=0)
+
+    window = (st >= 7.9) & (st < 10.0)
+    window_o = (ro.client_start >= 7.9) & (ro.client_start < 10.0)
+    frac_e = float(err[window].mean())
+    frac_o = float(ro.client_error[window_o].mean())
+    # ~half the straddling requests die (binomial noise over ~100 reqs)
+    assert frac_e == pytest.approx(0.5, abs=0.15)
+    assert frac_o == pytest.approx(0.5, abs=0.15)
+    # requests outside the straddle window are untouched
+    assert not err[(st < 7.5) | (st > 10.5)].any()
+    assert not ro.client_error[
+        (ro.client_start < 7.5) | (ro.client_start > 10.5)
+    ].any()
+    # a reset client observes the kill instant, not the full sleep
+    died = err & window
+    if died.any():
+        np.testing.assert_array_less(lat[died], 2.0)
+        ends = st[died] + lat[died]
+        np.testing.assert_allclose(ends, 10.0, atol=0.05)
+
+
+def test_chaos_toml_bounce_and_drain(tmp_path):
+    from isotope_tpu.runner.config import load_toml
+
+    topo = tmp_path / "t.yaml"
+    topo.write_text(SIMPLE)
+    cfg = tmp_path / "c.toml"
+    cfg.write_text(
+        f"""
+topology_paths = ["{topo}"]
+environments = ["NONE"]
+
+[client]
+qps = [100]
+num_concurrent_connections = [4]
+duration = "60s"
+
+[[chaos]]
+service = "entry"
+start = "5s"
+end = "7s"
+period = "10s"
+repeat = 3
+
+[[chaos]]
+service = "entry"
+start = "55s"
+end = "58s"
+replicas_down = 1
+drain = false
+"""
+    )
+    c = load_toml(cfg)
+    assert len(c.chaos) == 4
+    assert [(e.start_s, e.end_s) for e in c.chaos[:3]] == [
+        (5.0, 7.0), (15.0, 17.0), (25.0, 27.0)
+    ]
+    assert c.chaos[3].drain is False
+    assert c.chaos[3].replicas_down == 1
