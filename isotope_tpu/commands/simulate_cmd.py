@@ -184,6 +184,8 @@ def run_simulate(args) -> int:
             compiled,
             config.environments[0].apply(config.sim_params()),
             config.chaos,
+            config.churn,
+            mtls=config.mtls,
         )
         (load,) = config.load_models()
         res = sim.run(load, args.trace_requests,

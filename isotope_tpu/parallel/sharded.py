@@ -48,10 +48,11 @@ class ShardedSimulator:
         params: SimParams = SimParams(),
         chaos=(),
         churn=(),
+        mtls=None,
     ):
         self.compiled = compiled
         self.mesh = mesh
-        self.sim = Simulator(compiled, params, chaos, churn)
+        self.sim = Simulator(compiled, params, chaos, churn, mtls=mtls)
         self.collector = MetricsCollector(compiled)
         if SVC_AXIS not in mesh.axis_names:
             raise ValueError(

@@ -20,6 +20,7 @@ from typing import Dict, List, Optional, Tuple
 
 from isotope_tpu.sim.config import (
     ChaosEvent,
+    MtlsSchedule,
     bounce_schedule,
     LoadModel,
     NetworkModel,
@@ -125,6 +126,7 @@ class ExperimentConfig:
     labels: str = ""
     chaos: Tuple[ChaosEvent, ...] = ()
     churn: Tuple[TrafficSplit, ...] = ()
+    mtls: Optional[MtlsSchedule] = None
     # entrypoint override: pick one instance of a multi-entry topology
     # (replicate_topology); None = the graph's first entrypoint
     entry: Optional[str] = None
@@ -279,6 +281,20 @@ def load_toml(path) -> ExperimentConfig:
             )
         )
 
+    # [mtls]: the auto-mTLS switching analogue — a schedule of per-edge
+    # one-way taxes cycled every `period` (perf/load/auto-mtls/scale.py)
+    mtls = None
+    if "mtls" in doc:
+        m = doc["mtls"]
+        mtls = MtlsSchedule(
+            period_s=dur.parse_duration_seconds(m["period"]),
+            taxes_s=tuple(
+                dur.parse_duration_seconds(x) if isinstance(x, str)
+                else float(x)
+                for x in m["taxes"]
+            ),
+        )
+
     sim = doc.get("sim", {})
     defaults = SimParams()
     return ExperimentConfig(
@@ -304,5 +320,6 @@ def load_toml(path) -> ExperimentConfig:
         labels=doc.get("labels", ""),
         chaos=tuple(chaos),
         churn=tuple(churn),
+        mtls=mtls,
         entry=sim.get("entry"),
     )

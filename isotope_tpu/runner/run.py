@@ -111,7 +111,7 @@ class _LazyTopology:
         if env.name not in self._sims:
             params = env.apply(self.config.sim_params())
             sim = Simulator(self.compiled, params, self.config.chaos,
-                            self.config.churn)
+                            self.config.churn, mtls=self.config.mtls)
             use_mesh = self.mesh_data * self.mesh_svc > 1
             sharded = (
                 ShardedSimulator(
@@ -120,6 +120,7 @@ class _LazyTopology:
                     params,
                     self.config.chaos,
                     self.config.churn,
+                    mtls=self.config.mtls,
                 )
                 if use_mesh
                 else None

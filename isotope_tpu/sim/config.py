@@ -227,6 +227,37 @@ class TrafficSplit:
         return sum(self.weights) / len(self.weights)
 
 
+@dataclasses.dataclass(frozen=True)
+class MtlsSchedule:
+    """Time-phased per-edge mTLS tax.
+
+    The simulation analogue of the reference's auto-mTLS scale test
+    (perf/load/auto-mtls/scale.py:1-130): istio-sidecar and legacy
+    deployments are alternately scaled so the share of connections
+    paying the mTLS handshake flips over time, exercising istiod's
+    auto-mTLS switching.  Here the *data-plane consequence* is modeled
+    directly: every edge's one-way wire latency gains
+    ``taxes_s[floor(t / period_s) mod len(taxes_s)]`` at the request's
+    arrival time — e.g. ``taxes_s=(0.0, 1e-3)`` alternates the tax off
+    and on each period, and a mixed-fleet phase is a fractional tax.
+    The tax is pure latency (the handshake burns proxy CPU, not
+    service CPU), so offered-load/queueing tables are unaffected —
+    matching how the sidecar-mode environments model proxies.
+    """
+
+    period_s: float
+    taxes_s: "tuple[float, ...]"
+
+    def __post_init__(self):
+        if self.period_s <= 0:
+            raise ValueError("mtls period_s must be positive")
+        if not self.taxes_s:
+            raise ValueError("mtls taxes_s must be non-empty")
+        if any(x < 0 for x in self.taxes_s):
+            raise ValueError("mtls taxes must be >= 0")
+        object.__setattr__(self, "taxes_s", tuple(self.taxes_s))
+
+
 OPEN_LOOP = "open"
 CLOSED_LOOP = "closed"
 

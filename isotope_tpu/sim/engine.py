@@ -50,6 +50,7 @@ from isotope_tpu.sim.config import (
     SERVICE_TIME_PARETO,
     ChaosEvent,
     LoadModel,
+    MtlsSchedule,
     SimParams,
     TrafficSplit,
 )
@@ -164,9 +165,17 @@ class Simulator:
         params: SimParams = SimParams(),
         chaos: Sequence[ChaosEvent] = (),
         churn: Sequence[TrafficSplit] = (),
+        mtls: Optional[MtlsSchedule] = None,
     ):
         self.compiled = compiled
         self.params = params
+        # auto-mTLS switching: a time-phased extra one-way latency on
+        # every edge, indexed by the request's (nominal) arrival time —
+        # pure wire tax, so queueing tables are untouched (see
+        # config.MtlsSchedule)
+        self._mtls = mtls
+        if mtls is not None:
+            self._mtls_taxes = jnp.asarray(mtls.taxes_s, jnp.float32)
         t = compiled.services
         net = params.network
 
@@ -331,8 +340,18 @@ class Simulator:
             if k_before <= 0:
                 continue  # already fully down: nothing resident to kill
             cols = np.nonzero(compiled.hop_service == s)[0]
+            # the reset propagates back to the client over payload-free
+            # wire legs, one per ancestor edge (matching the oracle's
+            # one_way(0.0) return; cross-cluster extras on the return
+            # path are ignored — sub-ms, documented approximation)
+            back = jnp.asarray(
+                (compiled.hop_depth[cols] + 1)
+                * params.network.base_latency_s
+                + params.network.entry_extra_latency_s,
+                jnp.float32,
+            )
             kills.append(
-                (float(ev.start_s), cols, min(down / k_before, 1.0))
+                (float(ev.start_s), cols, min(down / k_before, 1.0), back)
             )
         self._kills = tuple(kills)
 
@@ -580,9 +599,11 @@ class Simulator:
             in_rg, np.sqrt(params.retry_copula_r), 0.0
         ).astype(np.float32)
         # the finite-population law replaces the open-loop wait law only
-        # when the whole run is one stationary phase (no chaos/churn cuts)
+        # when the whole run is one stationary phase (no chaos/churn
+        # cuts, no phased mTLS tax — the MVA delay station is static)
         self._single_phase = (
             int(self._phase_starts.shape[0]) * self._num_combos == 1
+            and mtls is None
         )
         self._fns: Dict[Tuple[int, str, bool], "jax.stages.Wrapped"] = {}
         self._summary_fns: Dict[tuple, "jax.stages.Wrapped"] = {}
@@ -1182,6 +1203,19 @@ class Simulator:
             )
             arrivals = None  # closed-loop arrivals derive from latencies
 
+        # ---- phased mTLS tax at each request's arrival time --------------
+        # (n,) extra one-way latency added to EVERY edge leg — the
+        # auto-mTLS alternation (config.MtlsSchedule)
+        tax = None
+        if self._mtls is not None:
+            t_idx = (
+                jnp.floor(
+                    nominal_arrivals / self._mtls.period_s
+                ).astype(jnp.int32)
+                % len(self._mtls.taxes_s)
+            )
+            tax = self._mtls_taxes[t_idx]
+
         # ---- traffic-split weights at each request's arrival time --------
         # (N, E+1): one column per schedule + a sentinel 1.0 column for
         # unchurned calls; the nominal arrival places closed-loop
@@ -1344,6 +1378,8 @@ class Simulator:
                     # single attempt, call k <-> child k: the whole attempt
                     # loop reduces to elementwise ops — no scatters
                     tt = lvl.child_rtt + lat_lvls[d + 1]  # (N, C)
+                    if tax is not None:
+                        tt = tt + 2.0 * tax[:, None]
                     down_child = down[:, csl] if down is not None else None
                     transport_a, dur_a = _call_outcome(
                         tt,
@@ -1412,6 +1448,8 @@ class Simulator:
                         valid = lvl.att_valid[a]     # (K,) static
                         use = used_a & valid
                         t = rtt_child[idx] + lat_child[:, idx]
+                        if tax is not None:
+                            t = t + 2.0 * tax[:, None]
                         transport_a, dur_a = _call_outcome(
                             t,
                             lvl.call_timeout if lvl.finite_timeout else None,
@@ -1534,14 +1572,18 @@ class Simulator:
 
         # ---- closed-loop arrivals (need latencies) -----------------------
         # a refused connection to the entry costs one wire round trip
+        root_wire = self._root_net
+        if tax is not None:
+            # the client -> entry edge pays the tax on both legs too
+            root_wire = root_wire + 2.0 * tax
         if root_down is not None:
             root_lat = jnp.where(
                 root_down,
                 2 * self._entry_one_way,
-                self._root_net + lat_lvls[0][:, 0],
+                root_wire + lat_lvls[0][:, 0],
             )
         else:
-            root_lat = self._root_net + lat_lvls[0][:, 0]
+            root_lat = root_wire + lat_lvls[0][:, 0]
         if kind == CLOSED_LOOP:
             c = max(connections, 1)
             per = n // c
@@ -1564,14 +1606,20 @@ class Simulator:
             conn_end = conn_t0
 
         # ---- downward pass 2: absolute start times -----------------------
+        entry_wire = self._entry_one_way
+        if tax is not None:
+            entry_wire = entry_wire + tax
         start_lvls: List[jax.Array] = [
-            (arrivals + self._entry_one_way)[:, None]
+            (arrivals + entry_wire)[:, None]
         ]
         for d in range(len(self._levels) - 1):
             lvl = self._levels[d]
             sl = slice(lvl.offset, lvl.offset + lvl.size)
             base = (start_lvls[d] + wait[:, sl])[:, lvl.child_parent_local]
-            start_lvls.append(base + off_lvls[d] + lvl.child_net_out)
+            out_wire = lvl.child_net_out
+            if tax is not None:
+                out_wire = out_wire + tax[:, None]
+            start_lvls.append(base + off_lvls[d] + out_wire)
 
         hop_sent = jnp.concatenate(sent_lvls, axis=1)
         hop_lat = jnp.concatenate(lat_lvls, axis=1)
@@ -1591,7 +1639,7 @@ class Simulator:
         # the client sees the reset at ~the kill time (see __init__)
         if self._kills:
             died_any = jnp.zeros(n, bool)
-            for i, (t_k, cols, frac) in enumerate(self._kills):
+            for i, (t_k, cols, frac, back) in enumerate(self._kills):
                 strad = (
                     hop_sent[:, cols]
                     & (hop_start[:, cols] < t_k)
@@ -1604,9 +1652,13 @@ class Simulator:
                     )
                     < frac
                 )
-                died = (strad & coin).any(axis=1) & ~died_any
-                reset_lat = (
-                    jnp.maximum(t_k - arrivals, 0.0) + self._root_net
+                died_h = strad & coin
+                died = died_h.any(axis=1) & ~died_any
+                # the earliest reset to reach the client wins: the
+                # shortest payload-free return path among killed hops
+                ret = jnp.where(died_h, back[None, :], jnp.inf).min(1)
+                reset_lat = jnp.maximum(t_k - arrivals, 0.0) + jnp.where(
+                    jnp.isfinite(ret), ret, 0.0
                 )
                 root_lat = jnp.where(died, reset_lat, root_lat)
                 client_error = client_error | died

@@ -194,7 +194,7 @@ class OracleSimulator:
             np.int32,
         )
         self._chaos_drain = np.asarray(
-            [bool(getattr(ev, "drain", True)) for ev in chaos], np.uint8
+            [bool(ev.drain) for ev in chaos], np.uint8
         )
         self._fn = _bind()
 

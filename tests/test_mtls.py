@@ -1,0 +1,113 @@
+"""auto-mTLS switching: the time-phased per-edge tax overlay.
+
+The reference's auto-mtls scale test alternately scales istio/legacy
+deployments so the share of connections paying the mTLS handshake flips
+over time (perf/load/auto-mtls/scale.py:1-130).  The simulation models
+the data-plane consequence directly: ``MtlsSchedule`` cycles an extra
+one-way per-edge latency by arrival time (sim/config.py).
+"""
+import jax
+import numpy as np
+import pytest
+
+from isotope_tpu.compiler import compile_graph
+from isotope_tpu.models.graph import ServiceGraph
+from isotope_tpu.sim import LoadModel, SimParams, Simulator
+from isotope_tpu.sim.config import MtlsSchedule
+
+KEY = jax.random.PRNGKey(5)
+DET = SimParams(service_time="deterministic")
+
+CHAIN3 = """
+services:
+- name: a
+  isEntrypoint: true
+  script: [{call: b}]
+- name: b
+  script: [{call: c}]
+- name: c
+"""
+
+
+def test_mtls_schedule_validation():
+    with pytest.raises(ValueError, match="period_s"):
+        MtlsSchedule(period_s=0.0, taxes_s=(0.0,))
+    with pytest.raises(ValueError, match="non-empty"):
+        MtlsSchedule(period_s=1.0, taxes_s=())
+    with pytest.raises(ValueError, match=">= 0"):
+        MtlsSchedule(period_s=1.0, taxes_s=(-1e-3,))
+
+
+def test_mtls_phase_latency_deltas():
+    # deterministic service, quiet load: the alternating phases differ
+    # by EXACTLY 2 legs x 3 edges x tax — the per-phase delta the
+    # reference's alternation produces
+    mtls = MtlsSchedule(period_s=5.0, taxes_s=(0.0, 1e-3))
+    sim = Simulator(
+        compile_graph(ServiceGraph.from_yaml(CHAIN3)), DET, mtls=mtls
+    )
+    load = LoadModel(kind="open", qps=10.0)
+    res = sim.run(load, 200, KEY)
+    st = np.asarray(res.client_start)
+    lat = np.asarray(res.client_latency, np.float64)
+    phase = (np.floor(st / 5.0).astype(int)) % 2
+    lat_on = lat[phase == 1]
+    lat_off = lat[phase == 0]
+    assert len(lat_on) > 20 and len(lat_off) > 20
+    delta = lat_on.mean() - lat_off.mean()
+    assert delta == pytest.approx(2 * 3 * 1e-3, rel=1e-4)
+    # within a phase the latency is constant (deterministic)
+    assert lat_on.std() < 1e-9 and lat_off.std() < 1e-9
+
+
+def test_mtls_fractional_mixed_fleet_phase():
+    # a mixed istio/legacy fleet = fractional expected tax
+    mtls = MtlsSchedule(period_s=2.0, taxes_s=(2e-4, 5e-4, 1e-3))
+    sim = Simulator(
+        compile_graph(ServiceGraph.from_yaml(CHAIN3)), DET, mtls=mtls
+    )
+    res = sim.run(LoadModel(kind="open", qps=20.0), 240, KEY)
+    st = np.asarray(res.client_start)
+    lat = np.asarray(res.client_latency, np.float64)
+    phase = (np.floor(st / 2.0).astype(int)) % 3
+    base = lat[phase == 0].mean() - 2 * 3 * 2e-4
+    for i, tax in enumerate((2e-4, 5e-4, 1e-3)):
+        assert lat[phase == i].mean() == pytest.approx(
+            base + 2 * 3 * tax, rel=1e-4
+        )
+
+
+def test_mtls_toml_surface(tmp_path):
+    from isotope_tpu.runner.config import load_toml
+    from isotope_tpu.runner.run import run_experiment
+
+    topo = tmp_path / "t.yaml"
+    topo.write_text(CHAIN3)
+    cfg = tmp_path / "c.toml"
+    cfg.write_text(
+        f"""
+topology_paths = ["{topo}"]
+environments = ["NONE"]
+
+[client]
+qps = [100]
+num_concurrent_connections = [4]
+duration = "20s"
+load_kind = "open"
+
+[sim]
+num_requests = 2000
+service_time = "deterministic"
+
+[mtls]
+period = "5s"
+taxes = ["0ms", "1ms"]
+"""
+    )
+    c = load_toml(cfg)
+    assert c.mtls == MtlsSchedule(period_s=5.0, taxes_s=(0.0, 1e-3))
+    (result,) = run_experiment(c, out_dir=str(tmp_path / "out"))
+    # the alternation widens the latency spread: p99 - p50 spans the
+    # 6 ms on/off delta
+    flat = result.flat
+    assert flat["p99"] - flat["p50"] >= 5000  # microseconds
