@@ -15,17 +15,32 @@ def register(sub) -> None:
         "report",
         help="render a sweep's results.jsonl as a static HTML report",
     )
-    r.add_argument("results", help="sweep output directory")
+    r.add_argument("results", help="sweep output directory (or, with "
+                                   "--history, a directory of publish "
+                                   "trees)")
     r.add_argument("--baseline", metavar="DIR",
                    help="another sweep to diff against (regression view)")
+    r.add_argument("--history", action="store_true",
+                   help="treat RESULTS as a directory of "
+                        "<date>_<loadgen>_<branch>_<ver> publish trees "
+                        "and render metric-over-time series (the "
+                        "reference dashboard's day-over-day view)")
     r.add_argument("--title", default=None)
     r.add_argument("-o", "--output", default="report.html")
     r.set_defaults(func=run_report)
 
 
 def run_report(args) -> int:
-    from isotope_tpu.report import write_report
+    from isotope_tpu.report import write_history_report, write_report
 
+    if args.history:
+        if args.baseline:
+            print("--baseline is ignored with --history", file=sys.stderr)
+        count = write_history_report(
+            args.results, args.output, title=args.title
+        )
+        print(f"{count} publishes -> {args.output}", file=sys.stderr)
+        return 0
     count = write_report(
         args.results, args.output,
         baseline_dir=args.baseline, title=args.title,

@@ -94,6 +94,12 @@ def register(sub) -> None:
                    help="capture a jax.profiler trace per run into "
                         "DIR/<label>/ (the reference's per-run flame "
                         "capture, runner.py:405-417)")
+    w.add_argument("--export", action="append", default=[],
+                   metavar="SPEC",
+                   help="post-run exporter(s), e.g. "
+                        "bigquery:project.dataset.table or "
+                        "gcs:gs://bucket/path (the collector's upload "
+                        "hook, fortio.py:235-242); repeatable")
     w.set_defaults(func=run_sweep)
 
     p = sub.add_parser(
@@ -285,6 +291,7 @@ def run_sweep(args) -> int:
         progress=lambda label: print(f"running {label}", file=sys.stderr),
         resume=not args.fresh,
         profile_dir=args.profile,
+        export=args.export,
     )
     discarded = [r.label for r in results if r.window.discarded]
     print(

@@ -469,3 +469,152 @@ def write_report(
     )
     pathlib.Path(out_path).write_text(doc)
     return len(rows)
+
+
+# -- history across publish ids --------------------------------------------
+
+# the suite's publish-id format, `<date>_<loadgen>_<branch>_<ver>` —
+# exactly what the reference dashboard scrapes from the GCS bucket
+# (perf_dashboard/helpers/download.py:56-62, e.g. 20200525_fortio_master_1.7)
+_PUBLISH_ID_RE = re.compile(r"^(?P<date>\d{8})_[^_]+_.+_.+$")
+
+
+def load_history(root) -> List[Tuple[str, List[dict]]]:
+    """Scan a directory of publish trees (``runner.suite`` output roots)
+    and return ``(publish_id, rows)`` pairs in date order.
+
+    Each publish tree holds one ``results.jsonl`` per config
+    subdirectory; rows are merged with their config name so the same
+    run label in different configs stays distinct.
+    """
+    root = pathlib.Path(root)
+    out: List[Tuple[str, List[dict]]] = []
+    for child in sorted(p for p in root.iterdir() if p.is_dir()):
+        if not _PUBLISH_ID_RE.match(child.name):
+            continue
+        rows: List[dict] = []
+        for results in sorted(child.glob("*/results.jsonl")):
+            cfg = results.parent.name
+            for r in load_results(results.parent):
+                rows.append(dict(r, _config=cfg))
+        if rows:
+            out.append((child.name, rows))
+    if not out:
+        raise FileNotFoundError(
+            f"no publish trees (<date>_<loadgen>_<branch>_<ver> dirs "
+            f"with */results.jsonl) under {root}"
+        )
+    return out
+
+
+HISTORY_METRICS = (
+    ("p50", "latency (ms)", 1e-3),
+    ("p99", "latency (ms)", 1e-3),
+    ("ActualQPS", "qps", 1.0),
+    ("errorPercent", "errors (%)", 1.0),
+)
+
+
+def build_history_report(
+    history: Sequence[Tuple[str, List[dict]]],
+    title: str = "isotope-tpu history",
+) -> str:
+    """Metric-over-publish-id time series — the reference dashboard's
+    day-over-day regression view (perf_dashboard/helpers/download.py:
+    27-66 downloads one benchmark.csv per day and charts them together).
+
+    X axis is the publish index (ids are date-prefixed and sorted);
+    each series is one run label, joined across the publishes it
+    appears in.
+    """
+    ids = [pid for pid, _ in history]
+
+    def series_for(metric: str, scale: float):
+        out: Dict[str, List[Tuple[float, float]]] = {}
+        for i, (_, rows) in enumerate(history):
+            for r in rows:
+                v = r.get(metric)
+                if not isinstance(v, (int, float)):
+                    continue
+                name = f"{r['_config']}/{r['Labels']}"
+                out.setdefault(name, []).append((float(i), v * scale))
+        # a one-point series renders as a dot; keep it (a new config's
+        # first publish is still information)
+        return out
+
+    charts = []
+    series_names: List[str] = []
+    for metric, unit, scale in HISTORY_METRICS:
+        grouped = series_for(metric, scale)
+        if grouped:
+            series_names = sorted(grouped)
+            charts.append(
+                "<figure>"
+                + svg_line_chart(
+                    {k: grouped[k] for k in series_names},
+                    f"{metric} over publishes",
+                    "publish",
+                    unit,
+                )
+                + "</figure>"
+            )
+
+    light_css, dark_css = _series_css()
+    css = _CSS.replace("SERIES_CSS", light_css).replace(
+        "SERIES_DARK_CSS", dark_css
+    )
+    id_list = "".join(
+        f"<li><code>{i}: {html.escape(pid)}</code> — "
+        f"{len(rows)} runs</li>"
+        for i, (pid, rows) in enumerate(history)
+    )
+    doc = [
+        "<!doctype html><html><head><meta charset='utf-8'>",
+        f"<title>{html.escape(title)}</title>",
+        f"<style>{css}</style></head><body>",
+        f"<h1>{html.escape(title)}</h1>",
+        f"<p>{len(ids)} publishes, oldest to newest:</p>",
+        f"<ul>{id_list}</ul>",
+    ]
+    if len(series_names) >= 2:
+        doc.append(_legend(series_names))
+    doc.append(f'<div class="charts">{"".join(charts)}</div>')
+
+    # latest-vs-previous regression table (the dashboard's
+    # master-vs-release deltas, applied day-over-day)
+    if len(history) >= 2:
+        prev_id, prev_rows = history[-2]
+        cur_id, cur_rows = history[-1]
+        doc.append(
+            f"<h2>Regression: {html.escape(cur_id)} vs "
+            f"{html.escape(prev_id)}</h2>"
+        )
+
+        # join on (config, label): the same run label may exist in
+        # several configs of one publish tree
+        def qualify(rows):
+            return [
+                dict(r, Labels=f"{r['_config']}/{r['Labels']}")
+                for r in rows
+            ]
+
+        joined = regression_rows(qualify(cur_rows), qualify(prev_rows))
+        if joined:
+            doc.append(_regression_table(joined))
+        else:
+            doc.append("<p>No runs with matching labels.</p>")
+    doc.append("</body></html>")
+    return "".join(doc)
+
+
+def write_history_report(
+    root, out_path, title: Optional[str] = None
+) -> int:
+    """Render a metric-over-time page from a directory of publish
+    trees; returns the number of publishes included."""
+    history = load_history(root)
+    doc = build_history_report(
+        history, title or f"isotope-tpu history — {pathlib.Path(root).name}"
+    )
+    pathlib.Path(out_path).write_text(doc)
+    return len(history)

@@ -116,6 +116,11 @@ class ExperimentConfig:
     connections: Tuple[int, ...]
     duration_s: float
     load_kind: str = "closed"            # fortio's default mode
+    # the load-generator identity axis of the reference's benchmark
+    # matrix: "fortio" (closed-loop workers, runner.py:255-268) or
+    # "nighthawk" (open-loop, runner.py:270-316); flows into the suite
+    # publish id `<date>_<loadgen>_<branch>_<ver>`
+    loadgen: str = "fortio"
     num_requests: int = 100_000
     seed: int = 0
     cpu_time_s: float = SimParams().cpu_time_s
@@ -295,6 +300,22 @@ def load_toml(path) -> ExperimentConfig:
             ),
         )
 
+    # loadgen axis: fortio is closed-loop by default, nighthawk is the
+    # open-loop generator (runner.py:270-316 builds a distinct
+    # invocation; it has no closed-loop mode)
+    loadgen = client.get("loadgen", "fortio")
+    if loadgen not in ("fortio", "nighthawk"):
+        raise ValueError(
+            f"unknown loadgen {loadgen!r} (choose fortio or nighthawk)"
+        )
+    default_kind = "open" if loadgen == "nighthawk" else "closed"
+    load_kind = client.get("load_kind", default_kind)
+    if loadgen == "nighthawk" and load_kind != "open":
+        raise ValueError(
+            "nighthawk is an open-loop generator; drop load_kind or "
+            "set it to \"open\" (runner.py:270-316)"
+        )
+
     sim = doc.get("sim", {})
     defaults = SimParams()
     return ExperimentConfig(
@@ -303,7 +324,8 @@ def load_toml(path) -> ExperimentConfig:
         qps=tuple(qps_list),
         connections=tuple(conns),
         duration_s=dur.parse_duration_seconds(client.get("duration", "5m")),
-        load_kind=client.get("load_kind", "closed"),
+        load_kind=load_kind,
+        loadgen=loadgen,
         num_requests=int(sim.get("num_requests", 100_000)),
         seed=int(sim.get("seed", 0)),
         cpu_time_s=(

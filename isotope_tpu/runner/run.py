@@ -23,7 +23,8 @@ import hashlib
 import json
 import os
 import pathlib
-from typing import List, Optional
+import sys
+from typing import List, Optional, Sequence
 
 import jax
 
@@ -192,11 +193,22 @@ def run_experiment(
     progress=None,
     resume: bool = True,
     profile_dir: Optional[str] = None,
+    export: Sequence[str] = (),
 ) -> List[RunResult]:
     """``profile_dir`` captures a ``jax.profiler`` trace per executed run
     into ``<profile_dir>/<label>/`` — the analogue of the reference's
     per-run ``perf record`` flame capture (runner.py:405-417), readable
-    in TensorBoard/XProf."""
+    in TensorBoard/XProf.  ``export`` lists exporter specs (e.g.
+    ``bigquery:proj.ds.table``) run over the collected results after the
+    CSV is written — the collector's upload hook (fortio.py:235-242)."""
+    # resolve exporter specs up front: a typo'd --export must fail
+    # before hours of simulation, not after
+    exporters = []
+    if export:
+        from isotope_tpu.metrics.export import resolve_exporter
+
+        exporters = [resolve_exporter(s) for s in export]
+
     results: List[RunResult] = []
     key = jax.random.PRNGKey(config.seed)
     mesh_svc = max(config.mesh_svc, 1)
@@ -350,4 +362,6 @@ def run_experiment(
             [r.flat for r in results],
             out / "benchmark.csv",
         )
+        for exporter in exporters:
+            print(exporter(results, out), file=sys.stderr)
     return results

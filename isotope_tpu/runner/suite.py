@@ -58,7 +58,12 @@ def run_suite(
     resume: bool = True,
 ) -> SuiteResult:
     """Run every config, publish one artifact tree, monitor every run."""
-    sid = id or suite_id(labels=labels)
+    cfgs = [(p, load_toml(p)) for p in config_paths]
+    # the publish id carries the loadgen name (download.py:56-62:
+    # `<date>_<loadgen>_<branch>_<ver>`); a mixed suite is labeled as such
+    loadgens = {c.loadgen for _, c in cfgs} or {"sim"}
+    loadgen = loadgens.pop() if len(loadgens) == 1 else "mixed"
+    sid = id or suite_id(labels=labels, loadgen=loadgen)
     publish = pathlib.Path(out_root) / sid
     publish.mkdir(parents=True, exist_ok=True)
     # the sink is append-only and every invocation re-evaluates all runs
@@ -70,9 +75,8 @@ def run_suite(
 
     configs_out: List[dict] = []
     total_runs = 0
-    for cfg_path in config_paths:
+    for cfg_path, cfg in cfgs:
         stem = pathlib.Path(cfg_path).stem
-        cfg = load_toml(cfg_path)
         out_dir = publish / stem
         results = run_experiment(
             cfg, out_dir=str(out_dir), progress=progress, resume=resume
@@ -116,7 +120,7 @@ def run_suite(
 
     manifest = {
         "id": sid,
-        "loadgen": "sim",
+        "loadgen": loadgen,
         "configs": configs_out,
         "total_runs": total_runs,
         "total_alarms": sum(c["alarms"] for c in configs_out),

@@ -143,3 +143,89 @@ def test_report_cli(tmp_path, capsys):
 def test_report_missing_dir_errors(tmp_path):
     with pytest.raises(FileNotFoundError):
         write_report(tmp_path / "nosuch", tmp_path / "x.html")
+
+
+# -- history across publish ids --------------------------------------------
+
+
+def fake_publish(tmp_path, pid, p99):
+    """One publish tree: <pid>/<config>/results.jsonl."""
+    root = tmp_path / "pub"
+    root.mkdir(exist_ok=True)
+    tree = root / pid
+    tree.mkdir()
+    fake_sweep(tree, "latency", {"baseline": [(16, p99)]})
+    return root
+
+
+def test_history_report_over_publishes(tmp_path):
+    from isotope_tpu.report import load_history, write_history_report
+
+    for pid, p99 in (
+        ("20260728_sim_master_dev", 3000),
+        ("20260729_sim_master_dev", 3100),
+        ("20260730_sim_master_dev", 3900),
+    ):
+        fake_publish(tmp_path, pid, p99)
+    # a non-publish directory is ignored
+    (tmp_path / "pub" / "scratch").mkdir()
+
+    history = load_history(tmp_path / "pub")
+    assert [pid for pid, _ in history] == [
+        "20260728_sim_master_dev",
+        "20260729_sim_master_dev",
+        "20260730_sim_master_dev",
+    ]
+
+    out = tmp_path / "history.html"
+    n = write_history_report(tmp_path / "pub", out)
+    assert n == 3
+    doc = out.read_text()
+    # metric-over-publish charts with one series joined across ids
+    assert "p99 over publishes" in doc
+    assert "p50 over publishes" in doc
+    assert "latency/topo_baseline" in doc
+    # latest-vs-previous regression: p99 3100 -> 3900 is > 5% worse
+    assert "Regression: 20260730_sim_master_dev vs" in doc
+    assert "regress" in doc
+
+
+def test_history_cli(tmp_path, capsys):
+    fake_publish(tmp_path, "20260730_sim_master_dev", 2500)
+    out = tmp_path / "h.html"
+    rc = cli.main(
+        ["report", str(tmp_path / "pub"), "--history", "-o", str(out)]
+    )
+    assert rc == 0
+    assert "1 publishes" in capsys.readouterr().err
+    assert "over publishes" in out.read_text()
+
+
+def test_history_empty_root_errors(tmp_path):
+    from isotope_tpu.report import load_history
+
+    (tmp_path / "empty").mkdir()
+    with pytest.raises(FileNotFoundError, match="no publish trees"):
+        load_history(tmp_path / "empty")
+
+
+def test_history_regression_joins_per_config(tmp_path):
+    # the same run label in two configs must diff against ITS OWN
+    # config's baseline, not whichever config won the label collision
+    from isotope_tpu.report import build_history_report, load_history
+
+    root = tmp_path / "pub"
+    for pid, lat_p99, cpu_p99 in (
+        ("20260729_sim_master_dev", 3000, 9000),
+        ("20260730_sim_master_dev", 3100, 9100),
+    ):
+        tree = root / pid
+        tree.mkdir(parents=True)
+        fake_sweep(tree, "latency", {"baseline": [(16, lat_p99)]})
+        fake_sweep(tree, "cpu_mem", {"baseline": [(16, cpu_p99)]})
+    doc = build_history_report(load_history(root))
+    # both joins are ~+1..3% (below the 5% band): nothing may be
+    # flagged as a regression (a cross-config join would show +203%)
+    assert "+203" not in doc
+    assert 'class="regress"' not in doc
+    assert "cpu_mem/topo_baseline" in doc

@@ -169,3 +169,101 @@ def test_suite_cli_exit_code_on_alarm(tmp_path, capsys):
          "--cpu-limit", "0.0001"]
     )
     assert rc == 1
+
+
+def test_suite_publish_id_carries_loadgen(tmp_path):
+    # download.py:56-62 id format: <date>_<loadgen>_<branch>_<ver>
+    cfg = tmp_path / "nh.toml"
+    cfg.write_text(
+        f"""
+topology_paths = ["{TOPO}"]
+environments = ["NONE"]
+
+[client]
+loadgen = "nighthawk"
+qps = [200]
+num_concurrent_connections = [8]
+duration = "30s"
+
+[sim]
+num_requests = 1500
+"""
+    )
+    result = run_suite([str(cfg)], tmp_path / "pub")
+    assert "_nighthawk_" in result.publish_dir.name
+    assert result.manifest["loadgen"] == "nighthawk"
+
+
+def test_loadgen_validation(tmp_path):
+    from isotope_tpu.runner.config import load_toml
+
+    base = f"""
+topology_paths = ["{TOPO}"]
+environments = ["NONE"]
+
+[client]
+qps = [100]
+num_concurrent_connections = [4]
+duration = "30s"
+"""
+    ok = tmp_path / "ok.toml"
+    ok.write_text(base + 'loadgen = "nighthawk"\n')
+    c = load_toml(ok)
+    assert c.loadgen == "nighthawk"
+    assert c.load_kind == "open"  # nighthawk implies open loop
+
+    bad = tmp_path / "bad.toml"
+    bad.write_text(
+        base + 'loadgen = "nighthawk"\nload_kind = "closed"\n'
+    )
+    with pytest.raises(ValueError, match="open-loop generator"):
+        load_toml(bad)
+
+    unk = tmp_path / "unk.toml"
+    unk.write_text(base + 'loadgen = "wrk2"\n')
+    with pytest.raises(ValueError, match="unknown loadgen"):
+        load_toml(unk)
+
+
+def test_bigquery_exporter_writes_datafile(tmp_path):
+    # the collector's upload hook (fortio.py:235-242): the exporter
+    # must produce the exact NDJSON datafile `bq insert` consumes
+    from isotope_tpu.runner.config import load_toml
+    from isotope_tpu.runner.run import run_experiment
+
+    cfg = write_cfg(tmp_path, "exp.toml", 200)
+    out = tmp_path / "out"
+    run_experiment(
+        load_toml(cfg), out_dir=str(out),
+        export=["bigquery:proj.perf.results"],
+    )
+    lines = (out / "bq_rows.json").read_text().splitlines()
+    assert len(lines) == 1
+    doc = json.loads(lines[0])
+    assert "DurationHistogram" in doc and "ActualQPS" in doc
+    script = (out / "bq_insert.sh").read_text()
+    assert "bq insert proj.perf.results bq_rows.json" in script
+
+
+def test_exporter_registry_errors_and_extension(tmp_path):
+    from isotope_tpu.metrics.export import (
+        ExportError,
+        register_exporter,
+        resolve_exporter,
+        run_exporters,
+    )
+
+    with pytest.raises(ExportError, match="unknown exporter"):
+        resolve_exporter("spanner")
+    with pytest.raises(ExportError, match="needs a table"):
+        resolve_exporter("bigquery")
+
+    seen = {}
+    register_exporter(
+        "testsink",
+        lambda arg: (lambda results, out_dir: seen.setdefault(
+            "call", (arg, len(list(results)))
+        ) and "ok" or "ok"),
+    )
+    assert run_exporters(["testsink:xyz"], [1, 2], tmp_path) == ["ok"]
+    assert seen["call"] == ("xyz", 2)
