@@ -116,6 +116,22 @@ def main() -> None:
             blk10k * 4, blk10k, warm=3, iters=3,
         )
 
+        # the star archetype's skewed hub level (one ~2,000-step
+        # service among thousands of leaves) runs via the sparse
+        # call-slot encoding — dense grids made it block-starved
+        star10k = Simulator(
+            compile_graph(
+                ServiceGraph.decode(
+                    realistic_topology(10_000, archetype="star", seed=0)
+                )
+            )
+        )
+        blk_star = star10k.default_block_size()
+        extra["star10k"] = _rate(
+            star10k, LoadModel(kind="open", qps=1000.0),
+            blk_star * 4, blk_star, warm=3, iters=3,
+        )
+
         closed = LoadModel(kind="closed", qps=None, connections=64)
         extra["closed64"] = _rate(tree, closed, blk * blocks, blk)
 

@@ -25,6 +25,10 @@ def register(sub) -> None:
                         "<date>_<loadgen>_<branch>_<ver> publish trees "
                         "and render metric-over-time series (the "
                         "reference dashboard's day-over-day view)")
+    r.add_argument("--lineage", default=None, metavar="SUBSTR",
+                   help="with --history: select one publish lineage "
+                        "(substring of the id suffix after the date) "
+                        "when the directory holds several")
     r.add_argument("--title", default=None)
     r.add_argument("-o", "--output", default="report.html")
     r.set_defaults(func=run_report)
@@ -37,7 +41,8 @@ def run_report(args) -> int:
         if args.baseline:
             print("--baseline is ignored with --history", file=sys.stderr)
         count = write_history_report(
-            args.results, args.output, title=args.title
+            args.results, args.output, title=args.title,
+            lineage=args.lineage,
         )
         print(f"{count} publishes -> {args.output}", file=sys.stderr)
         return 0

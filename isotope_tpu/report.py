@@ -479,19 +479,41 @@ def write_report(
 _PUBLISH_ID_RE = re.compile(r"^(?P<date>\d{8})_[^_]+_.+_.+$")
 
 
-def load_history(root) -> List[Tuple[str, List[dict]]]:
+def load_history(
+    root, lineage: Optional[str] = None
+) -> List[Tuple[str, List[dict]]]:
     """Scan a directory of publish trees (``runner.suite`` output roots)
     and return ``(publish_id, rows)`` pairs in date order.
 
     Each publish tree holds one ``results.jsonl`` per config
     subdirectory; rows are merged with their config name so the same
     run label in different configs stays distinct.
+
+    A history is one *lineage*: publishes sharing the id suffix after
+    the date (``<loadgen>_<branch>_<ver>``).  Mixing lineages would
+    mis-order same-date publishes and diff unrelated runs (open-loop
+    nighthawk vs closed-loop fortio), so a root holding several
+    demands an explicit ``lineage`` selector (substring of the
+    suffix).
     """
     root = pathlib.Path(root)
-    out: List[Tuple[str, List[dict]]] = []
+    found: List[Tuple[str, str, pathlib.Path]] = []
     for child in sorted(p for p in root.iterdir() if p.is_dir()):
-        if not _PUBLISH_ID_RE.match(child.name):
+        m = _PUBLISH_ID_RE.match(child.name)
+        if not m:
             continue
+        suffix = child.name[len(m.group("date")) + 1:]
+        if lineage is not None and lineage not in suffix:
+            continue
+        found.append((m.group("date"), suffix, child))
+    suffixes = {s for _, s, _ in found}
+    if len(suffixes) > 1:
+        raise ValueError(
+            f"{root} holds {len(suffixes)} publish lineages "
+            f"({sorted(suffixes)}); pass a lineage selector to pick one"
+        )
+    out: List[Tuple[str, List[dict]]] = []
+    for _, _, child in sorted(found):
         rows: List[dict] = []
         for results in sorted(child.glob("*/results.jsonl")):
             cfg = results.parent.name
@@ -503,6 +525,7 @@ def load_history(root) -> List[Tuple[str, List[dict]]]:
         raise FileNotFoundError(
             f"no publish trees (<date>_<loadgen>_<branch>_<ver> dirs "
             f"with */results.jsonl) under {root}"
+            + (f" matching lineage {lineage!r}" if lineage else "")
         )
     return out
 
@@ -608,11 +631,12 @@ def build_history_report(
 
 
 def write_history_report(
-    root, out_path, title: Optional[str] = None
+    root, out_path, title: Optional[str] = None,
+    lineage: Optional[str] = None,
 ) -> int:
     """Render a metric-over-time page from a directory of publish
     trees; returns the number of publishes included."""
-    history = load_history(root)
+    history = load_history(root, lineage=lineage)
     doc = build_history_report(
         history, title or f"isotope-tpu history — {pathlib.Path(root).name}"
     )

@@ -91,6 +91,11 @@ class SimParams:
     # Total attempt-attempt correlation = sibling_copula_r +
     # retry_copula_r; fit against the DES oracle (ORACLE.md).
     retry_copula_r: float = 0.5
+    # Dense-grid element threshold above which a skewed level (grid
+    # > 4x its real call-step count) switches to the sparse call-slot
+    # step encoding (engine._SparseSteps) — the star-10k mitigation.
+    # Lower it to force the sparse path on small graphs (tests).
+    sparse_level_elems: int = 262_144
 
     def __post_init__(self):
         if self.service_time not in (

@@ -119,6 +119,10 @@ class _Level:
     # c when call_seg == repeat(arange(size*pmax), c): the per-step
     # aggregation is a reshape-reduce instead of a scatter
     uniform_calls: Optional[int] = None
+    # sparse call-slot step encoding (skewed wide levels); None = dense
+    sparse: Optional["_SparseSteps"] = None
+    # call-free levels: busy time is fully static — (L,) seconds
+    leaf_busy: Optional[jax.Array] = None
 
     @property
     def num_children(self) -> int:
@@ -131,6 +135,38 @@ class _Level:
     @property
     def max_attempts(self) -> int:
         return self.att_child.shape[0]
+
+
+@dataclasses.dataclass(frozen=True)
+class _SparseSteps:
+    """Call-slot step encoding for skewed wide levels.
+
+    A level's dense step grid is (hops x Pmax_level); on skewed graphs
+    (one ~2,000-step hub among thousands of single-step leaves — the
+    star-10k archetype) that grid is >100x larger than the number of
+    steps that actually exist.  This encoding keeps one dynamic slot
+    per CALL-BEARING step only: pure-sleep steps fold into static
+    per-hop totals/prefixes, per-hop busy times are packed segment sums
+    (cumsum minus segment starts — no (L x P) tensor ever materializes)
+    and child start offsets gather static sleep prefixes plus the
+    dynamic call prefix at their slot.
+
+    Only valid when the level cannot transport-fail (no finite
+    timeouts, no chaos): a transport failure truncates the script
+    mid-way, which needs the dense executed-step mask.  The engine
+    falls back to dense in those runs.
+    """
+
+    n_slots: int
+    slot_base: jax.Array          # (S,) sleep floor of each call step
+    call_slot: Optional[jax.Array]  # (K,) call -> slot; None == identity
+    has_slots: jax.Array          # (L,) bool
+    seg_first: jax.Array          # (L,) first slot of the hop (safe 0)
+    seg_last: jax.Array           # (L,) last slot of the hop (safe 0)
+    sleep_total: jax.Array        # (L,) static pure-sleep busy seconds
+    child_sleep_prefix: jax.Array  # (C,) static sleep before child's step
+    child_slot: jax.Array         # (C,) slot of the child's step
+    child_seg_first: jax.Array    # (C,) first slot of the child's parent
 
 
 def _call_outcome(t, timeout, down_child):
@@ -496,6 +532,80 @@ class Simulator:
                     call_seg_p, np.repeat(np.arange(slots), c)
                 ):
                     uniform = c
+
+            # -- sparse call-slot encoding for skewed wide levels ------
+            # Transport failures (timeouts / chaos downs) need the dense
+            # executed-step mask, so sparse requires their static
+            # absence.  Dense grids within 4x of the real call-step
+            # count (or small outright) aren't worth the extra gathers.
+            sparse: Optional[_SparseSteps] = None
+            leaf_busy: Optional[jax.Array] = None
+            sleep_real = lvl.step_is_real.astype(np.float64) * (
+                lvl.step_base
+            )
+            if n_calls == 0:
+                leaf_busy = jnp.asarray(sleep_real.sum(1), jnp.float32)
+            elif (
+                not self.has_chaos
+                and not bool(np.isfinite(lvl.call_timeout).any())
+            ):
+                slot_segs = np.unique(call_seg_p)  # sorted
+                n_slots = len(slot_segs)
+                dense_elems = lvl.num_hops * pmax
+                if dense_elems > max(
+                    4 * n_slots, params.sparse_level_elems
+                ):
+                    slot_hop = slot_segs // pmax
+                    slot_step = slot_segs % pmax
+                    call_slot_np = np.searchsorted(slot_segs, call_seg_p)
+                    seg_first = np.zeros(lvl.num_hops, np.int64)
+                    seg_last = np.zeros(lvl.num_hops, np.int64)
+                    has = np.zeros(lvl.num_hops, bool)
+                    for i, h in enumerate(slot_hop):
+                        if not has[h]:
+                            seg_first[h] = i
+                            has[h] = True
+                        seg_last[h] = i
+                    has_call_step = np.zeros(
+                        (lvl.num_hops, pmax), bool
+                    )
+                    has_call_step[slot_hop, slot_step] = True
+                    sleep_only = sleep_real[:, :pmax] * ~has_call_step
+                    sleep_prefix = np.cumsum(sleep_only, 1) - sleep_only
+                    child_sleep_prefix = sleep_prefix[
+                        parent_local, child_step
+                    ]
+                    child_slot_np = np.searchsorted(
+                        slot_segs, parent_local * pmax + child_step
+                    )
+                    sparse = _SparseSteps(
+                        n_slots=n_slots,
+                        slot_base=jnp.asarray(
+                            lvl.step_base[slot_hop, slot_step],
+                            jnp.float32,
+                        ),
+                        call_slot=(
+                            None
+                            if np.array_equal(
+                                call_slot_np,
+                                np.arange(n_calls, dtype=np.int64),
+                            )
+                            else jnp.asarray(call_slot_np, jnp.int32)
+                        ),
+                        has_slots=jnp.asarray(has),
+                        seg_first=jnp.asarray(seg_first, jnp.int32),
+                        seg_last=jnp.asarray(seg_last, jnp.int32),
+                        sleep_total=jnp.asarray(
+                            sleep_only.sum(1), jnp.float32
+                        ),
+                        child_sleep_prefix=jnp.asarray(
+                            child_sleep_prefix, jnp.float32
+                        ),
+                        child_slot=jnp.asarray(child_slot_np, jnp.int32),
+                        child_seg_first=jnp.asarray(
+                            seg_first[parent_local], jnp.int32
+                        ),
+                    )
             levels.append(
                 _Level(
                     offset=offset,
@@ -528,6 +638,8 @@ class Simulator:
                         np.isfinite(lvl.call_timeout).any()
                     ),
                     uniform_calls=uniform,
+                    sparse=sparse,
+                    leaf_busy=leaf_busy,
                 )
             )
             offset += lvl.num_hops
@@ -1480,51 +1592,98 @@ class Simulator:
                     used_lvls[d] = used[:, :C]
 
                 # -- aggregate calls into (parent, step) slots -------------
-                if lvl.uniform_calls is not None:
-                    # call_seg == repeat(arange(size*P), c): reshape-reduce
-                    agg = dur_call.reshape(
-                        n, lvl.size, P, lvl.uniform_calls
-                    ).max(-1)
-                else:
-                    agg = (
-                        jnp.zeros((n, lvl.size * P))
-                        .at[:, lvl.call_seg]
-                        .max(dur_call)
-                        .reshape(n, lvl.size, P)
-                    )
-                step_dur = jnp.maximum(lvl.step_base, agg) * lvl.step_mask
-                if final_transport is not None:
-                    fail_contrib = jnp.where(
-                        final_transport, lvl.call_step, P
-                    ).astype(jnp.int32)
-                    if lvl.uniform_calls is not None:
-                        fail_step = fail_contrib.reshape(
-                            n, lvl.size, P * lvl.uniform_calls
-                        ).min(-1)
+                if lvl.sparse is not None:
+                    # sparse call-slot path (skewed wide level; transport
+                    # is statically impossible here, so no truncation
+                    # mask is ever needed): per-hop busy times are
+                    # packed segment sums, pure-sleep steps are static
+                    sp = lvl.sparse
+                    if sp.call_slot is None:
+                        slot_agg = dur_call
                     else:
-                        fail_step = (
-                            jnp.full((n, lvl.size), P, jnp.int32)
-                            .at[:, lvl.call_seg // P]
-                            .min(fail_contrib)
+                        slot_agg = (
+                            jnp.zeros((n, sp.n_slots))
+                            .at[:, sp.call_slot]
+                            .max(dur_call)
                         )
+                    dyn = jnp.maximum(sp.slot_base, slot_agg)
+                    pcs = jnp.cumsum(dyn, axis=1)
+                    excl = pcs - dyn
+                    seg_sum = jnp.where(
+                        sp.has_slots,
+                        pcs[:, sp.seg_last] - excl[:, sp.seg_first],
+                        0.0,
+                    )
+                    busy = sp.sleep_total + seg_sum
+                    off = (
+                        sp.child_sleep_prefix
+                        + excl[:, sp.child_slot]
+                        - excl[:, sp.child_seg_first]
+                    )
+                    if err_coin is not None:
+                        # a 500ing parent runs no steps (dense zeroes
+                        # the grid before the prefix — match exactly)
+                        off = off * ~err_coin[:, sl][
+                            :, lvl.child_parent_local
+                        ]
+                    if att_off is not None:
+                        off = off + used_lvls[d] * att_off[:, :C]
+                    off_lvls[d] = off
+                    step_dur = None
+                else:
+                    if lvl.uniform_calls is not None:
+                        # call_seg == repeat(arange(size*P), c):
+                        # reshape-reduce
+                        agg = dur_call.reshape(
+                            n, lvl.size, P, lvl.uniform_calls
+                        ).max(-1)
+                    else:
+                        agg = (
+                            jnp.zeros((n, lvl.size * P))
+                            .at[:, lvl.call_seg]
+                            .max(dur_call)
+                            .reshape(n, lvl.size, P)
+                        )
+                    step_dur = (
+                        jnp.maximum(lvl.step_base, agg) * lvl.step_mask
+                    )
+                    if final_transport is not None:
+                        fail_contrib = jnp.where(
+                            final_transport, lvl.call_step, P
+                        ).astype(jnp.int32)
+                        if lvl.uniform_calls is not None:
+                            fail_step = fail_contrib.reshape(
+                                n, lvl.size, P * lvl.uniform_calls
+                            ).min(-1)
+                        else:
+                            fail_step = (
+                                jnp.full((n, lvl.size), P, jnp.int32)
+                                .at[:, lvl.call_seg // P]
+                                .min(fail_contrib)
+                            )
             else:
-                step_dur = (
-                    jnp.broadcast_to(lvl.step_base, (n, lvl.size, P))
-                    * lvl.step_mask
-                )
+                # call-free level: busy time is fully static
+                busy = jnp.broadcast_to(lvl.leaf_busy, (n, lvl.size))
+                step_dur = None
             fail_lvls[d] = fail_step
-            # executed-step mask: errorRate 500s skip the whole script;
-            # transport errors truncate it after the failing step
-            if fail_step is not None:
-                executed = (
-                    jnp.arange(P, dtype=jnp.int32) <= fail_step[:, :, None]
-                )
-                if err_coin is not None:
-                    executed = executed & ~err_coin[:, sl][:, :, None]
-                step_dur = step_dur * executed
+            if step_dur is not None:
+                # executed-step mask: errorRate 500s skip the whole
+                # script; transport errors truncate after the failing
+                # step
+                if fail_step is not None:
+                    executed = (
+                        jnp.arange(P, dtype=jnp.int32)
+                        <= fail_step[:, :, None]
+                    )
+                    if err_coin is not None:
+                        executed = executed & ~err_coin[:, sl][:, :, None]
+                    step_dur = step_dur * executed
+                elif err_coin is not None:
+                    step_dur = step_dur * ~err_coin[:, sl][:, :, None]
+                busy = step_dur.sum(-1)
             elif err_coin is not None:
-                step_dur = step_dur * ~err_coin[:, sl][:, :, None]
-            busy = step_dur.sum(-1)
+                # errorRate 500 skips the whole script
+                busy = busy * ~err_coin[:, sl]
             lat_lvls[d] = wait[:, sl] + svc_time[:, sl] + busy
             # this hop's own response status: 500 iff errorRate coin or a
             # transport-failed step
@@ -1534,7 +1693,7 @@ class Simulator:
                 err_lvls[d] = err_coin[:, sl]
             elif fail_step is not None:
                 err_lvls[d] = fail_step < P
-            if lvl.num_children > 0:
+            if lvl.num_children > 0 and step_dur is not None:
                 prefix = jnp.cumsum(step_dur, axis=-1) - step_dur
                 off = prefix.reshape(n, -1)[:, lvl.child_seg]
                 if att_off is not None:

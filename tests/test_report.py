@@ -229,3 +229,22 @@ def test_history_regression_joins_per_config(tmp_path):
     assert "+203" not in doc
     assert 'class="regress"' not in doc
     assert "cpu_mem/topo_baseline" in doc
+
+
+def test_history_mixed_lineages_require_selector(tmp_path):
+    # same-date publishes from two loadgens must not be treated as one
+    # timeline (the regression would diff open- vs closed-loop runs)
+    from isotope_tpu.report import load_history
+
+    root = tmp_path / "pub"
+    for pid in (
+        "20260730_fortio_master_dev",
+        "20260730_nighthawk_master_dev",
+    ):
+        tree = root / pid
+        tree.mkdir(parents=True)
+        fake_sweep(tree, "latency", {"baseline": [(16, 3000)]})
+    with pytest.raises(ValueError, match="2 publish lineages"):
+        load_history(root)
+    history = load_history(root, lineage="nighthawk")
+    assert [pid for pid, _ in history] == ["20260730_nighthawk_master_dev"]

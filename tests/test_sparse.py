@@ -1,0 +1,172 @@
+"""Sparse call-slot step encoding (the star-10k wide-level mitigation).
+
+A skewed level — one ~2,000-step hub among thousands of single-step
+leaves, the star archetype's shape — used to materialize a dense
+(hops x Pmax) step grid per request.  The sparse encoding keeps one
+dynamic slot per call-bearing step and folds pure-sleep steps into
+static constants (engine._SparseSteps).  These tests force the sparse
+path on small graphs (SimParams.sparse_level_elems=1) and pin it
+against the dense path on the same RNG draws: both encodings consume
+identical (n, H) random tensors, so outcomes must agree to float
+tolerance.
+"""
+import dataclasses
+
+import jax
+import numpy as np
+import pytest
+
+from isotope_tpu.compiler import compile_graph
+from isotope_tpu.models.graph import ServiceGraph
+from isotope_tpu.sim import LoadModel, SimParams, Simulator
+
+KEY = jax.random.PRNGKey(7)
+
+# a skewed level: hub has a long mixed script (sleeps between calls),
+# its siblings are plain leaves — hub and leaves share depth 1
+SKEWED = """
+services:
+- name: entry
+  isEntrypoint: true
+  script:
+  - [{call: hub}, {call: s0}, {call: s1}, {call: s2}]
+- name: hub
+  script:
+  - sleep: 1ms
+  - call: w0
+  - sleep: 2ms
+  - call: w1
+  - call: w2
+  - sleep: 3ms
+  - call: w3
+- name: s0
+- name: s1
+- name: s2
+- name: w0
+  script: [{sleep: 5ms}]
+- name: w1
+- name: w2
+  script: [{sleep: 1ms}]
+- name: w3
+"""
+
+SPARSE = SimParams(sparse_level_elems=1)
+LOAD = LoadModel(kind="open", qps=0.4 / SimParams().cpu_time_s)
+
+
+def both_encodings(yaml_text, load=LOAD, n=20_000, **kw):
+    g = ServiceGraph.from_yaml(yaml_text)
+    dense = Simulator(compile_graph(g), SimParams(**kw))
+    sparse = Simulator(
+        compile_graph(g), SimParams(sparse_level_elems=1, **kw)
+    )
+    # the threshold actually flipped the encoding somewhere
+    assert all(lvl.sparse is None for lvl in dense._levels)
+    assert any(lvl.sparse is not None for lvl in sparse._levels)
+    rd = dense.run(load, n, KEY)
+    rs = sparse.run(load, n, KEY)
+    return rd, rs
+
+
+def assert_same(rd, rs):
+    np.testing.assert_allclose(
+        np.asarray(rd.client_latency), np.asarray(rs.client_latency),
+        rtol=1e-5,
+    )
+    np.testing.assert_array_equal(
+        np.asarray(rd.client_error), np.asarray(rs.client_error)
+    )
+    np.testing.assert_array_equal(
+        np.asarray(rd.hop_sent), np.asarray(rs.hop_sent)
+    )
+    np.testing.assert_allclose(
+        np.asarray(rd.hop_latency), np.asarray(rs.hop_latency),
+        rtol=1e-5, atol=1e-9,
+    )
+    np.testing.assert_allclose(
+        np.asarray(rd.hop_start), np.asarray(rs.hop_start),
+        rtol=1e-5, atol=1e-9,
+    )
+
+
+def test_sparse_matches_dense_skewed_level():
+    assert_same(*both_encodings(SKEWED))
+
+
+def test_sparse_matches_dense_with_error_rates():
+    yaml_text = SKEWED.replace(
+        "- name: hub\n", "- name: hub\n  errorRate: 30%\n"
+    ).replace("- name: w1\n", "- name: w1\n  errorRate: 20%\n")
+    assert_same(*both_encodings(yaml_text))
+
+
+def test_sparse_matches_dense_with_send_probability():
+    yaml_text = SKEWED.replace(
+        "  - call: w1\n",
+        "  - call: {service: w1, probability: 60}\n",
+    )
+    assert_same(*both_encodings(yaml_text))
+
+
+def test_sparse_matches_dense_with_retries():
+    # retries without timeouts stay transport-free (500-triggered only),
+    # so the sparse encoding remains valid under multi-attempt calls
+    yaml_text = SKEWED.replace(
+        "  - call: w3\n",
+        "  - call: {service: w3, retries: 2}\n",
+    ).replace("- name: w3\n", "- name: w3\n  errorRate: 40%\n")
+    assert_same(*both_encodings(yaml_text))
+
+
+def test_sparse_exact_latency_under_det():
+    # deterministic quiet-load: the hub's latency is the exact sum of
+    # its steps — sleeps (static part) and call round trips (dynamic)
+    g = ServiceGraph.from_yaml(SKEWED)
+    p = dataclasses.replace(
+        SPARSE, service_time="deterministic"
+    )
+    sim = Simulator(compile_graph(g), p)
+    assert any(lvl.sparse is not None for lvl in sim._levels)
+    res = sim.run(LoadModel(kind="open", qps=0.001), 8, KEY)
+    cpu = p.cpu_time_s
+    net = p.network.one_way(0.0)
+    # hub: 1ms + (w0: 2net+cpu+5ms) + 2ms + (w1: 2net+cpu) +
+    #      (w2: 2net+cpu+1ms) + 3ms + (w3: 2net+cpu)
+    hub = (
+        0.001 + 0.002 + 0.003
+        + (2 * net + cpu + 0.005)
+        + (2 * net + cpu)
+        + (2 * net + cpu + 0.001)
+        + (2 * net + cpu)
+        + cpu
+    )
+    # entry: concurrent max(hub-call, leaf calls) + cpu; client adds
+    # the entry wire round trip
+    total = 2 * net + cpu + max(2 * net + hub, 2 * net + cpu)
+    np.testing.assert_allclose(
+        np.asarray(res.client_latency), total, rtol=1e-5
+    )
+
+
+def test_sparse_inactive_with_timeouts_or_chaos():
+    from isotope_tpu.sim.config import ChaosEvent
+
+    to = SKEWED.replace(
+        "  - call: w1\n", "  - call: {service: w1, timeout: 1s}\n"
+    )
+    sim = Simulator(
+        compile_graph(ServiceGraph.from_yaml(to)), SPARSE
+    )
+    assert all(lvl.sparse is None for lvl in sim._levels)
+
+    sim2 = Simulator(
+        compile_graph(ServiceGraph.from_yaml(SKEWED)), SPARSE,
+        (ChaosEvent(service="w0", start_s=1.0, end_s=2.0,
+                    replicas_down=None),),
+    )
+    assert all(lvl.sparse is None for lvl in sim2._levels)
+
+
+def test_leaf_levels_use_static_busy():
+    sim = Simulator(compile_graph(ServiceGraph.from_yaml(SKEWED)))
+    assert sim._levels[-1].leaf_busy is not None
