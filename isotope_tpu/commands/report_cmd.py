@@ -46,6 +46,8 @@ def run_report(args) -> int:
         )
         print(f"{count} publishes -> {args.output}", file=sys.stderr)
         return 0
+    if args.lineage:
+        print("--lineage is ignored without --history", file=sys.stderr)
     count = write_report(
         args.results, args.output,
         baseline_dir=args.baseline, title=args.title,

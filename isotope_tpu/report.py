@@ -502,6 +502,8 @@ def load_history(
         m = _PUBLISH_ID_RE.match(child.name)
         if not m:
             continue
+        if not any(child.glob("*/results.jsonl")):
+            continue  # empty/stale publish dir (e.g. a crashed suite)
         suffix = child.name[len(m.group("date")) + 1:]
         if lineage is not None and lineage not in suffix:
             continue
