@@ -56,6 +56,10 @@ from typing import Dict, NamedTuple, Tuple
 import numpy as np
 from scipy.special import gammainc
 
+# shared wait-quantile polynomial degree (tables_from_pi and the
+# engine's degenerate-row stubs must agree on the coefficient count)
+DEFAULT_QUANTILE_DEGREE = 10
+
 
 class ClosedTables(NamedTuple):
     """Per-population sampling tables (see ``closed_network_tables``)."""
@@ -66,6 +70,130 @@ class ClosedTables(NamedTuple):
     mean_wait: np.ndarray  # (S,) E[wait] at arrival (diagnostics)
     sigma: np.ndarray     # (S,) std of the queue census at arrival
     var_delay: float      # Var(j_delay): the census-sum variance target
+
+
+def convolution_marginals(
+    visits: np.ndarray,
+    replicas: np.ndarray,
+    mu: float,
+    delay_s: float,
+    population: int,
+) -> Tuple[float, np.ndarray, np.ndarray]:
+    """Exact product-form solution via Buzen's convolution algorithm.
+
+    Returns (lambda(N), pi, pi_delay) where ``pi[s, j]`` /
+    ``pi_delay[j]`` are queue-length distributions under population
+    N-1 — what an arriving customer sees (arrival theorem).
+
+    Load-dependent exact MVA is numerically unstable for multi-server
+    stations: its per-population marginals rely on ``P(0|n) = 1 - sum``
+    cancellations that corrupt catastrophically once a station's tail
+    mass approaches 1 (observed: a k=2 station's computed throughput
+    DROPPED below k=1's).  The convolution form has no cancellation —
+    every term is a nonneg product — and stays exact with a common rate
+    scale ``beta`` plus per-step max-normalization (tracked in log
+    space):
+
+        f_s(j)   = prod_{i<=j} beta * v_s / mu_s(i)     (station)
+        f_d(j)   = (beta * Z)^j / j!                     (delay)
+        G        = f_1 (*) ... (*) f_S (*) f_d
+        lambda(N)= beta * G(N-1) / G(N)
+        P_s(j|n) = f_s(j) * G_{-s}(n - j) / G(n)
+
+    with ``G_{-s}`` assembled from prefix/suffix convolutions —
+    O(S * N^2) total, like MVA.
+    """
+    v = np.asarray(visits, np.float64)
+    k = np.asarray(replicas, np.float64)
+    S = len(v)
+    N = int(population)
+    if N < 1:
+        raise ValueError("population must be >= 1")
+    z = max(float(delay_s), 1e-12)
+    active = np.nonzero(v > 1e-15)[0]
+    # common rate scale keeps the f magnitudes near 1
+    beta = max(float((k * mu).max(initial=1.0)), 1.0 / z)
+
+    def norm(c: np.ndarray, lg: float) -> Tuple[np.ndarray, float]:
+        m = float(c.max())
+        if m <= 0.0:
+            return c, lg
+        return c / m, lg + np.log(m)
+
+    def log_station_f(s: int) -> np.ndarray:
+        j = np.arange(1, N + 1, dtype=np.float64)
+        rate = np.minimum(j, k[s]) * mu
+        lf = np.empty(N + 1)
+        lf[0] = 0.0
+        lf[1:] = np.cumsum(np.log(beta * v[s] / rate))
+        return lf
+
+    def log_delay_f() -> np.ndarray:
+        j = np.arange(1, N + 1, dtype=np.float64)
+        lf = np.empty(N + 1)
+        lf[0] = 0.0
+        lf[1:] = np.cumsum(np.log(beta * z / j))
+        return lf
+
+    def from_log(lf: np.ndarray) -> Tuple[np.ndarray, float]:
+        # factors span hundreds of orders of magnitude (beta*v/mu per
+        # step can exceed 1 by k_max/k_s): exponentiate only after
+        # centering on the max so nothing overflows
+        m = float(lf.max())
+        return np.exp(lf - m), m
+
+    def conv(a: np.ndarray, b: np.ndarray) -> np.ndarray:
+        return np.convolve(a, b)[: N + 1]
+
+    # normalized factors (common log offsets cancel in the ratios below)
+    fs: list = []
+    lgs: list = []
+    for s in active:
+        f, lg = from_log(log_station_f(int(s)))
+        fs.append(f)
+        lgs.append(lg)
+    fd, lgd = from_log(log_delay_f())
+    fs.append(fd)
+    lgs.append(lgd)
+    M = len(fs)
+
+    # prefix[i] = f_0 (*) ... (*) f_{i-1}; suffix[i] = f_i (*) ... last
+    one = np.zeros(N + 1)
+    one[0] = 1.0
+    prefix = [(one, 0.0)]
+    for i in range(M):
+        c, lg = norm(conv(prefix[-1][0], fs[i]), prefix[-1][1] + lgs[i])
+        prefix.append((c, lg))
+    suffix = [(one, 0.0)]
+    for i in reversed(range(M)):
+        c, lg = norm(conv(fs[i], suffix[0][0]), lgs[i] + suffix[0][1])
+        suffix.insert(0, (c, lg))
+    g, _ = prefix[-1]
+    if g[N] <= 0.0 or g[N - 1] <= 0.0:  # pragma: no cover - degenerate
+        raise FloatingPointError("convolution underflow")
+    lam = beta * g[N - 1] / g[N]
+
+    # arriving-customer marginals at population N-1
+    pi = np.zeros((S, N))
+    pi[:, 0] = 1.0
+    pi_d = np.zeros(N)
+    pi_d[0] = 1.0
+    n1 = N - 1
+    for idx in range(M):
+        gm = conv(prefix[idx][0], suffix[idx + 1][0])
+        f = fs[idx]
+        raw = f[: n1 + 1] * gm[n1::-1] if n1 >= 0 else f[:1]
+        tot = float(raw.sum())
+        marg = np.zeros(N)
+        if tot > 0.0 and n1 >= 0:
+            marg[: n1 + 1] = raw / tot
+        else:
+            marg[0] = 1.0
+        if idx < len(active):
+            pi[active[idx]] = marg
+        else:
+            pi_d = marg
+    return lam, pi, pi_d
 
 
 def mva_load_dependent(
@@ -84,6 +212,11 @@ def mva_load_dependent(
     weights the cycle denominator (fork-join overlap, see module doc).
     O(S * N^2) in float64; stations with ``visits == 0`` fall out
     naturally (their pi stays a point mass at 0).
+
+    .. warning:: numerically unstable for multi-server (k > 1)
+       stations near saturation — the production path uses
+       :func:`convolution_marginals`; this remains as a cross-check
+       for k == 1 networks.
     """
     v = np.asarray(visits, np.float64)
     cv = np.asarray(cycle_visits, np.float64)
@@ -275,7 +408,7 @@ def tables_from_pi(
     pi: np.ndarray,
     replicas: np.ndarray,
     mu: float,
-    degree: int = 10,
+    degree: int = DEFAULT_QUANTILE_DEGREE,
     v_max: float = 16.0,
 ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
     """(p_zero, coef, mean_wait) quantile-polynomial tables from
@@ -291,7 +424,16 @@ def tables_from_pi(
     p_zero = np.empty(S)
     coef = np.zeros((degree + 1, S))
     mean_wait = np.zeros(S)
-    v_grid = np.linspace(0.0, v_max, 257)
+    # Exclude v = 0 from the fit and leave the intercept free: when the
+    # census mixture sits at high stages (say ~40 — a single-replica
+    # bottleneck under chaos), the true quantile leaps from 0 to the
+    # mixture's bulk within u' ~ 1e-20; a polynomial dragged through an
+    # exact W(0)=0 anchor undershoots the entire low-quantile region
+    # (measured: sampled mean 3.46 ms vs the Little-law 4.92 ms).  The
+    # free intercept ~= W(0.0625), distorting only ~6% mass near the
+    # atom for low-stage mixtures where W really is ~0 there (the
+    # engine clamps sampled waits at 0 either way).
+    v_grid = np.linspace(0.0, v_max, 257)[1:]
     cache: Dict[bytes, Tuple[np.ndarray, float]] = {}
     for s in range(S):
         ks = int(k[s])
@@ -307,9 +449,7 @@ def tables_from_pi(
         key = np.round(w, 12).tobytes() + bytes([ks & 0xFF])
         if key not in cache:
             t = _erlang_mixture_quantiles(w, rate, v_grid)
-            # anchor W(0) = 0 exactly; fit the rest by least squares
             c = np.polynomial.polynomial.polyfit(v_grid, t, degree)
-            c[0] = 0.0
             m = np.arange(1, len(w) + 1)
             cache[key] = (c, float((w * m).sum()) / rate)
         c, cond_mean = cache[key]
@@ -326,18 +466,19 @@ def closed_network_tables(
     mu: float,
     delay_s: float,
     population: int,
-    degree: int = 10,
+    degree: int = DEFAULT_QUANTILE_DEGREE,
     v_max: float = 16.0,
 ) -> ClosedTables:
-    """Exact-MVA sampling tables for chain (no fork-join) graphs.
-
-    Concurrent graphs use the engine's self-consistent fixed point over
-    ``repairman_marginals`` instead — the single-token population
-    constraint (and with it the variance identity) doesn't survive
-    forks.
+    """Exact product-form sampling tables for chain (no fork-join)
+    graphs, via the numerically stable convolution algorithm
+    (``cycle_visits`` equals ``visits`` on chains — forks are the only
+    source of cycle reweighting, and concurrent graphs use the
+    engine's self-consistent fixed point over ``repairman_marginals``
+    instead: the single-token population constraint, and with it the
+    variance identity, doesn't survive forks).
     """
-    lam, pi, pi_d = mva_load_dependent(
-        visits, cycle_visits, replicas, mu, delay_s, population
+    lam, pi, pi_d = convolution_marginals(
+        visits, replicas, mu, delay_s, population
     )
     p_zero, coef, mean_wait = tables_from_pi(
         pi, replicas, mu, degree, v_max

@@ -401,11 +401,15 @@ class Simulator:
         P = mult_phase.shape[0]
         Cc = self._num_combos
         visits_pc = np.empty((P * Cc, compiled.num_services), np.float64)
+        mult_pc = np.empty((P * Cc, compiled.num_hops), np.float64)
         for p in range(P):
             for c in range(Cc):
+                mult_pc[p * Cc + c] = mult_phase[p] * mult_combo[c]
                 visits_pc[p * Cc + c] = compiled.expected_visits(
-                    mult_phase[p] * mult_combo[c]
+                    mult_pc[p * Cc + c]
                 )
+        self._visits_pc_np = visits_pc
+        self._mult_pc = mult_pc
         self._visits_pc = jnp.asarray(visits_pc, jnp.float32)
         self._eff_replicas_pc = jnp.repeat(self._eff_replicas, Cc, axis=0)
         self._svc_down_pc = jnp.repeat(self._svc_down, Cc, axis=0)
@@ -475,13 +479,17 @@ class Simulator:
             )
         self._fj_factors = fj
         reach_f = compiled.hop_reach * fj
-        sleep_s = 0.0
+        hop_sleep = np.zeros(compiled.num_hops)
         for lvl in compiled.levels:
-            r = reach_f[lvl.hop_ids]
-            sleep_s += float(
-                (lvl.step_base * lvl.step_is_real * r[:, None]).sum()
-            )
-        self._delay_s = float((reach_f * hop_rtt).sum()) + sleep_s
+            hop_sleep[lvl.hop_ids] = (
+                lvl.step_base * lvl.step_is_real
+            ).sum(1)
+        # per-hop delay weight: wire round trip + own sleeps; the delay
+        # station's Z and the cycle visit ratios follow per phase row as
+        # sums over reach_fj * mult_pc (phased saturated closed loop)
+        self._reach_fj = reach_f
+        self._hop_delay_w = hop_rtt + hop_sleep
+        self._delay_s = float((reach_f * self._hop_delay_w).sum())
         self._cycle_visits = np.bincount(
             hs, weights=reach_f, minlength=compiled.num_services
         )
@@ -710,13 +718,9 @@ class Simulator:
         self._retry_w = np.where(
             in_rg, np.sqrt(params.retry_copula_r), 0.0
         ).astype(np.float32)
-        # the finite-population law replaces the open-loop wait law only
-        # when the whole run is one stationary phase (no chaos/churn
-        # cuts, no phased mTLS tax — the MVA delay station is static)
-        self._single_phase = (
-            int(self._phase_starts.shape[0]) * self._num_combos == 1
-            and mtls is None
-        )
+        # (the finite-population law handles chaos/churn phases with
+        # per-row tables; only the phased mTLS tax keeps a run on the
+        # open-loop fallback — see _saturated)
         self._fns: Dict[Tuple[int, str, bool], "jax.stages.Wrapped"] = {}
         self._summary_fns: Dict[tuple, "jax.stages.Wrapped"] = {}
         self._rate_cache: Dict[tuple, float] = {}
@@ -775,31 +779,68 @@ class Simulator:
         return out
 
     def _closed_tables(self, connections: int):
-        """Saturated-closed-loop sampling tables at ``connections``:
-        (throughput, p_zero_per_hop, coef_per_hop, active_mask,
-        center_c, var_scale) — lazily built, cached per C.
+        """Saturated-closed-loop sampling tables at ``connections``,
+        stacked per (chaos x churn) phase row: (throughput (R,),
+        p_zero (R, H), coef (R, D+1, H), e (R, H), center_c (R,),
+        var_scale (R, H)) — lazily built, cached per C.  Unphased runs
+        have R == 1 and index row 0 directly.
 
         ``center_c``/``var_scale`` realize the population copula:
-        z' = scale * (z - c * mask * mean_active(z)) has exact unit
-        marginals and pairwise correlation rho (sim/closed.py) among
-        the active hops.
+        z' = scale * (z - c * e * (e . z)) has exact unit marginals and
+        pairwise correlation rho (sim/closed.py) among the active hops.
         """
         if connections not in self._closed_cache:
-            from isotope_tpu.sim import closed
+            R = int(self._phase_starts.shape[0]) * self._num_combos
+            rows = [
+                self._closed_row(connections, r, refine=(R == 1))
+                for r in range(R)
+            ]
+            self._closed_cache[connections] = (
+                np.asarray([r[0] for r in rows]),
+                jnp.asarray(np.stack([r[1] for r in rows]), jnp.float32),
+                jnp.asarray(np.stack([r[2] for r in rows]), jnp.float32),
+                jnp.asarray(np.stack([r[3] for r in rows]), jnp.float32),
+                # center coefficients stay NumPy: the single-phase path
+                # reads them as python floats inside an active trace
+                np.asarray([r[4] for r in rows], np.float32),
+                jnp.asarray(np.stack([r[5] for r in rows]), jnp.float32),
+            )
+        return self._closed_cache[connections]
 
-            hs = self.compiled.hop_service
-            visits = np.asarray(self._visits, np.float64)
-            reps = np.asarray(self.compiled.services.replicas, np.float64)
-            rho = 0.0
-            if bool((self._fj_factors < 1.0).any()):
-                # fork-join: self-consistent fixed point — the cycle is
-                # re-measured from the ENGINE's own composition (max
-                # over siblings, copula) so Little's law closes:
-                # E[sampled latency] = C / lambda.
-                lam, pi, cycle = closed.fork_join_decomposition(
-                    visits, self._cycle_visits, reps, self._mu,
-                    self._delay_s, connections,
-                )
+    def _closed_row(self, connections: int, row: int, refine: bool):
+        """One phase row's closed-network tables (numpy)."""
+        from isotope_tpu.sim import closed
+
+        compiled = self.compiled
+        hs = compiled.hop_service
+        H = compiled.num_hops
+        visits = self._visits_pc_np[row]
+        reps = np.maximum(
+            np.asarray(self._eff_replicas_pc, np.float64)[row], 1.0
+        )
+        reach_r = self._reach_fj * self._mult_pc[row]
+        delay_r = float((reach_r * self._hop_delay_w).sum())
+        cycle_visits_r = np.bincount(
+            hs, weights=reach_r, minlength=compiled.num_services
+        )
+        if visits.max(initial=0.0) <= 1e-12:
+            # down entry: every connection spins on refused connects
+            lam = connections / max(2.0 * self._entry_one_way, 1e-9)
+            deg = closed.DEFAULT_QUANTILE_DEGREE
+            return (lam, np.ones(H), np.zeros((deg + 1, H)),
+                    np.zeros(H), 0.0, np.ones(H))
+        if bool((self._fj_factors < 1.0).any()):
+            # fork-join: finite-source decomposition; for unphased runs
+            # the cycle is refined through the ENGINE's own composition
+            # (max over siblings, copula) so Little's law closes:
+            # E[sampled latency] = C / lambda.  Phase rows keep the
+            # H_m/m-initialized decomposition (the pilot measures one
+            # stationary phase at a time, which phased runs don't have).
+            lam, pi, cycle = closed.fork_join_decomposition(
+                visits, cycle_visits_r, reps, self._mu,
+                delay_r, connections,
+            )
+            if refine:
                 w = np.full(len(visits), 1.0 / self._mu)
                 pilot = self._sat_pilot(connections)
                 key = jax.random.PRNGKey(20_260_730)
@@ -823,50 +864,40 @@ class Simulator:
                     )
                     if done:
                         break
-                p0, coef, _ = closed.tables_from_pi(pi, reps, self._mu)
-                throughput = connections / cycle
-                sigma = None
-                var_d = 0.0
-            else:
-                tabs = closed.closed_network_tables(
-                    visits, self._cycle_visits, reps, self._mu,
-                    self._delay_s, connections,
-                )
-                p0, coef = tabs.p_zero, tabs.coef
-                throughput = tabs.throughput
-                sigma, var_d = tabs.sigma, tabs.var_delay
-            p0_h = p0[hs]
-            # population copula: linearize j_s ~ mean + sigma_s * z_s;
-            # the census constraint sum_s j_s + j_d = C-1 means the
-            # sigma-weighted z-combination must carry Var(j_delay), not
-            # the independent sum Sigma sigma^2 — shrink its projection:
-            # z' = (z - c * e * (e . z)) / norm, c = 1 - sqrt(Vd/Ss^2).
-            c_center = 0.0
-            e_h = np.zeros(len(hs), np.float32)
-            scale_h = np.ones(len(hs), np.float32)
-            if sigma is not None:
-                # a station's weight spreads over its hops (independent
-                # draws): sigma/m per hop keeps multi-visit stations from
-                # dominating the projection
-                n_hops_s = np.bincount(hs, minlength=len(sigma))
-                sig_h = sigma[hs] / np.maximum(n_hops_s[hs], 1)
-                ss = float((sig_h**2).sum())
-                if ss > 1e-18 and var_d < ss:
-                    c_center = 1.0 - float(np.sqrt(max(var_d, 0.0) / ss))
-                    e_h = (sig_h / np.sqrt(ss)).astype(np.float32)
-                    shrink = (2 * c_center - c_center**2) * e_h**2
-                    scale_h = (1.0 / np.sqrt(1.0 - shrink)).astype(
-                        np.float32
-                    )
-            self._closed_cache[connections] = (
-                throughput,
-                jnp.asarray(p0_h, jnp.float32),
-                jnp.asarray(coef[:, hs], jnp.float32),
-                jnp.asarray(e_h),
-                c_center,
-                jnp.asarray(scale_h),
+            p0, coef, _ = closed.tables_from_pi(pi, reps, self._mu)
+            throughput = connections / cycle
+            sigma = None
+            var_d = 0.0
+        else:
+            tabs = closed.closed_network_tables(
+                visits, cycle_visits_r, reps, self._mu,
+                delay_r, connections,
             )
-        return self._closed_cache[connections]
+            p0, coef = tabs.p_zero, tabs.coef
+            throughput = tabs.throughput
+            sigma, var_d = tabs.sigma, tabs.var_delay
+        p0_h = p0[hs]
+        # population copula: linearize j_s ~ mean + sigma_s * z_s;
+        # the census constraint sum_s j_s + j_d = C-1 means the
+        # sigma-weighted z-combination must carry Var(j_delay), not
+        # the independent sum Sigma sigma^2 — shrink its projection:
+        # z' = (z - c * e * (e . z)) / norm, c = 1 - sqrt(Vd/Ss^2).
+        c_center = 0.0
+        e_h = np.zeros(len(hs))
+        scale_h = np.ones(len(hs))
+        if sigma is not None:
+            # a station's weight spreads over its hops (independent
+            # draws): sigma/m per hop keeps multi-visit stations from
+            # dominating the projection
+            n_hops_s = np.bincount(hs, minlength=len(sigma))
+            sig_h = sigma[hs] / np.maximum(n_hops_s[hs], 1)
+            ss = float((sig_h**2).sum())
+            if ss > 1e-18 and var_d < ss:
+                c_center = 1.0 - float(np.sqrt(max(var_d, 0.0) / ss))
+                e_h = sig_h / np.sqrt(ss)
+                shrink = (2 * c_center - c_center**2) * e_h**2
+                scale_h = 1.0 / np.sqrt(1.0 - shrink)
+        return (throughput, p0_h, coef[:, hs], e_h, c_center, scale_h)
 
     def _sat_pilot(self, connections: int, n: int = 8192):
         """Jitted mean-latency probe for the fork-join fixed point: the
@@ -941,11 +972,13 @@ class Simulator:
 
     def _saturated(self, load: LoadModel) -> bool:
         """True when the run uses the finite-population (MVA) wait law:
-        ``-qps max`` over a single stationary phase."""
+        ``-qps max``, with per-phase tables under chaos/churn.  A
+        phased mTLS tax falls back to the open-loop law (the MVA delay
+        station is static)."""
         return (
             load.kind == CLOSED_LOOP
             and load.qps is None
-            and self._single_phase
+            and self._mtls is None
         )
 
     def solve_closed_rate(
@@ -971,8 +1004,11 @@ class Simulator:
         """
         if self._saturated(load):
             # the closed network's throughput is what MVA computes exactly
-            # (product-form) — no pilot runs needed
-            return self._closed_tables(load.connections)[0]
+            # (product-form) — no pilot runs needed.  Phased runs
+            # time-weight the per-row rates over the chaos windows the
+            # run actually spans.
+            thr = self._closed_tables(load.connections)[0]
+            return self._sat_phased_rate(thr, num_requests)
         cache_key = (load.qps, load.connections, min(num_requests, 2048),
                      fixed_point_iters)
         if cache_key in self._rate_cache:
@@ -1013,6 +1049,30 @@ class Simulator:
         lam = 0.5 * (lo + hi)
         self._rate_cache[cache_key] = lam
         return lam
+
+    def _sat_phased_rate(self, thr: np.ndarray, num_requests: int) -> float:
+        """Average ``-qps max`` throughput over the chaos phases a run of
+        ``num_requests`` spans: walk the phase windows accumulating
+        requests at each window's rate until the count is reached
+        (churn combos cycle uniformly, so they average arithmetically
+        within a chaos phase)."""
+        P = int(self._phase_starts.shape[0])
+        Cc = self._num_combos
+        if P * Cc == 1:
+            return float(thr[0])
+        lam_p = np.asarray(thr, np.float64).reshape(P, Cc).mean(1)
+        cuts = np.asarray(self._phase_starts, np.float64)
+        acc = 0.0
+        for p in range(P):
+            start = cuts[p]
+            end = cuts[p + 1] if p + 1 < P else np.inf
+            rate = max(float(lam_p[p]), 1e-9)
+            seg = (end - start) * rate
+            if p + 1 >= P or acc + seg >= num_requests:
+                t_end = start + (num_requests - acc) / rate
+                return num_requests / max(t_end, 1e-9)
+            acc += seg
+        return float(lam_p[-1])  # pragma: no cover - loop always returns
 
     def run_summary(
         self,
@@ -1302,15 +1362,63 @@ class Simulator:
         else:
             c = max(connections, 1)
             per = n // c
-            nominal = (
-                req_offset + jnp.arange(per, dtype=jnp.float32)
-            ) * nominal_gap
+            num_phases_static = (
+                int(self._phase_starts.shape[0]) * self._num_combos
+            )
+            if sat_conns and num_phases_static > 1:
+                # phased -qps max: the closed loop's rate differs per
+                # chaos phase, so a constant-gap nominal clock drifts
+                # off the real timeline and mis-places requests around
+                # the cuts.  Warp nominal time piecewise from each
+                # phase's MVA throughput: the q-th request (globally)
+                # nominally fires at Rinv(q), R(t) = cumulative requests
+                # under the per-phase rates.
+                thr = self._closed_tables(sat_conns)[0]  # np (R,)
+                P_n = int(self._phase_starts.shape[0])
+                lam_p = np.maximum(
+                    thr.reshape(P_n, self._num_combos).mean(1), 1e-9
+                )
+                cuts_np = np.asarray(self._phase_starts, np.float64)
+                r_breaks = np.concatenate(
+                    [[0.0], np.cumsum(lam_p[:-1] * np.diff(cuts_np))]
+                )
+
+                def warp(idx):
+                    q = idx * float(sat_conns)
+                    k_ph = jnp.clip(
+                        jnp.searchsorted(
+                            jnp.asarray(r_breaks, jnp.float32), q,
+                            side="right",
+                        )
+                        - 1,
+                        0,
+                        P_n - 1,
+                    )
+                    return (
+                        jnp.asarray(cuts_np, jnp.float32)[k_ph]
+                        + (q - jnp.asarray(r_breaks, jnp.float32)[k_ph])
+                        / jnp.asarray(lam_p, jnp.float32)[k_ph]
+                    )
+
+                nominal = warp(
+                    req_offset + jnp.arange(per, dtype=jnp.float32)
+                )
+                rem_nominal = warp(
+                    jnp.full((n - c * per,), req_offset + per)
+                )
+            else:
+                nominal = (
+                    req_offset + jnp.arange(per, dtype=jnp.float32)
+                ) * nominal_gap
+                rem_nominal = jnp.full(
+                    (n - c * per,), (req_offset + per) * nominal_gap
+                )
             nominal_arrivals = jnp.concatenate(
                 [
                     jnp.broadcast_to(nominal, (c, per)).reshape(-1),
                     # remainder requests nominally follow the per-connection
                     # stream (chaos-phase placement only)
-                    jnp.full((n - c * per,), (req_offset + per) * nominal_gap),
+                    rem_nominal,
                 ]
             )
             arrivals = None  # closed-loop arrivals derive from latencies
@@ -1412,23 +1520,65 @@ class Simulator:
         if sat_conns:
             # finite-population law: per-hop quantile polynomial in
             # v = -log(1 - u') — Horner with per-hop coefficient rows,
-            # zero gathers (coefficients broadcast over the request axis).
+            # zero gathers (coefficients broadcast over the request axis;
+            # phased runs expand the per-row tables with the same
+            # one-hot matmul as the open-loop phase tables).
             # The wait draws stay in normal space: the sibling copula
             # (if active) correlates concurrent branches positively, and
             # the population copula (negative equicorrelation from the
             # fixed in-flight census, chains only) centers across hops.
+            hi = jax.lax.Precision.HIGHEST
             if sat_override is not None:
                 # fixed-point pilot: tables are traced arguments, no
                 # population centering (fork-join graphs have none)
                 p0_h, coef_h = sat_override
-                c_center, e_h, scale_h = 0.0, None, None
+                z = z_wait
+
+                def eval_poly(v, coef_h=coef_h):
+                    w = coef_h[-1]
+                    for ci in range(coef_h.shape[0] - 2, -1, -1):
+                        w = w * v + coef_h[ci]
+                    return w
+            elif num_phases == 1:
+                (_, p0_R, coef_R, e_R, c_R,
+                 scale_R) = self._closed_tables(sat_conns)
+                p0_h = p0_R[0]
+                c_center = float(c_R[0])
+                z = z_wait
+                if c_center > 0.0:
+                    zproj = (z * e_R[0]).sum(-1, keepdims=True)
+                    z = (z - c_center * e_R[0] * zproj) * scale_R[0]
+
+                def eval_poly(v, coef_h=coef_R[0]):
+                    w = coef_h[-1]
+                    for ci in range(coef_h.shape[0] - 2, -1, -1):
+                        w = w * v + coef_h[ci]
+                    return w
             else:
-                (_, p0_h, coef_h, e_h, c_center,
-                 scale_h) = self._closed_tables(sat_conns)
-            z = z_wait
-            if c_center > 0.0:
-                zproj = (z * e_h).sum(-1, keepdims=True)
-                z = (z - c_center * e_h * zproj) * scale_h
+                # per-phase tables selected by each request's arrival
+                # phase (``oh`` from the phase-table expansion above)
+                (_, p0_R, coef_R, e_R, c_R,
+                 scale_R) = self._closed_tables(sat_conns)
+                p0_h = jnp.matmul(oh, p0_R, precision=hi)
+                e_n = jnp.matmul(oh, e_R, precision=hi)
+                c_n = jnp.matmul(
+                    oh, jnp.asarray(c_R)[:, None], precision=hi
+                )
+                scale_n = jnp.matmul(oh, scale_R, precision=hi)
+                z = z_wait
+                zproj = (z * e_n).sum(-1, keepdims=True)
+                z = (z - c_n * e_n * zproj) * scale_n
+
+                def eval_poly(v, coef_R=coef_R):
+                    deg = coef_R.shape[1]
+                    w = jnp.matmul(
+                        oh, coef_R[:, deg - 1, :], precision=hi
+                    )
+                    for ci in range(deg - 2, -1, -1):
+                        w = w * v + jnp.matmul(
+                            oh, coef_R[:, ci, :], precision=hi
+                        )
+                    return w
             u_sat = jax.scipy.special.ndtr(z)
             u_c = jnp.clip(
                 (u_sat - p0_h) / jnp.maximum(1.0 - p0_h, 1e-9),
@@ -1436,10 +1586,9 @@ class Simulator:
                 1.0 - 1e-7,
             )
             v = -jnp.log1p(-u_c)
-            w = coef_h[-1]
-            for ci in range(coef_h.shape[0] - 2, -1, -1):
-                w = w * v + coef_h[ci]
-            wait = jnp.where(u_sat < p0_h, 0.0, jnp.maximum(w, 0.0))
+            wait = jnp.where(
+                u_sat < p0_h, 0.0, jnp.maximum(eval_poly(v), 0.0)
+            )
         else:
             wait = queueing.sample_wait_conditional(
                 p_wait_nh, wait_rate_nh, u_wait

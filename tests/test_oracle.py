@@ -361,6 +361,72 @@ services:
     assert float(res_e.offered_qps) == pytest.approx(thr_o, rel=0.02)
 
 
+def test_closed_loop_saturated_mixed_replicas():
+    # a single-replica bottleneck between multi-replica stations: the
+    # census mixture sits at high Erlang stages, where the old W(0)=0
+    # polynomial anchor undersampled the whole low-quantile region
+    # (sampled mean 3.46ms vs the Little-law 4.92ms)
+    yaml_text = """
+services:
+- name: a
+  isEntrypoint: true
+  numReplicas: 2
+  script: [{call: b}]
+- name: b
+  numReplicas: 1
+  script: [{call: c}]
+- name: c
+  numReplicas: 2
+"""
+    load = LoadModel(kind="closed", qps=None, connections=64)
+    res_e, res_o = fidelity_case(
+        yaml_text, load, tol_p50=0.03, tol_p99=0.04,
+        n_engine=64_000, n_oracle=256_000,
+    )
+    thr_o = len(res_o.client_latency) / float(res_o.client_end.max())
+    assert float(res_e.offered_qps) == pytest.approx(thr_o, rel=0.02)
+
+
+def test_closed_loop_saturated_under_chaos_phases():
+    # ORACLE.md's (former) out-of-envelope #3: phased -qps max runs.
+    # Per-phase MVA tables + the piecewise nominal time warp track the
+    # oracle inside AND outside the chaos window (measured: pre
+    # +1.2/+1.6%, chaos -0.5/+1.3%, post +0.1/+1.8%).
+    yaml_text = """
+services:
+- name: a
+  isEntrypoint: true
+  numReplicas: 2
+  script: [{call: b}]
+- name: b
+  numReplicas: 2
+  script: [{call: c}]
+- name: c
+  numReplicas: 2
+"""
+    g = ServiceGraph.from_yaml(yaml_text)
+    load = LoadModel(kind="closed", qps=None, connections=64)
+    chaos = (ChaosEvent(service="b", start_s=1.0, end_s=3.0,
+                        replicas_down=1),)
+    engine = Simulator(compile_graph(g), SimParams(), chaos)
+    res = engine.run(load, 128_000, jax.random.fold_in(KEY, 9))
+    st = np.asarray(res.client_start)
+    lat = np.asarray(res.client_latency, np.float64)
+    oracle = OracleSimulator(g, SimParams(), chaos)
+    ro = oracle.run(load, 256_000, seed=0)
+    for lo, hi, name in ((0.2, 1.0, "pre"), (1.15, 3.0, "chaos"),
+                         (3.3, 1e9, "post")):
+        m_e = (st >= lo) & (st <= hi)
+        m_o = (ro.client_start >= lo) & (ro.client_start <= hi)
+        for q, tol in ((0.5, 0.03), (0.99, 0.05)):
+            e = np.quantile(lat[m_e], q)
+            o = np.quantile(ro.client_latency[m_o], q)
+            assert e == pytest.approx(o, rel=tol), (
+                f"{name} p{int(q * 100)}: engine={e * 1e3:.3f}ms "
+                f"oracle={o * 1e3:.3f}ms err={(e / o - 1) * 100:+.2f}%"
+            )
+
+
 def test_closed_loop_saturated_fork_join_throughput():
     # fork-join saturated throughput: self-consistent fixed point lands
     # within 8% of the oracle (r4 measured: tree13 +6.3%, star9 +5.2%)
