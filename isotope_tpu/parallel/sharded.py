@@ -104,8 +104,17 @@ class ShardedSimulator:
                     f"divide evenly over {self.n_shards} shards"
                 )
             if offered_qps is None:
+                # saturated phased runs time-average per-phase rates
+                # over the REQUEST COUNT, so pass the real total (no
+                # pilot runs happen on that path); the pilot-based
+                # solver for paced loads keeps the small cap
+                n_solve = (
+                    num_requests
+                    if self.sim._saturated(load)
+                    else min(num_requests, 2048)
+                )
                 offered_qps = self.sim.solve_closed_rate(
-                    load, min(num_requests, 2048), key
+                    load, n_solve, key
                 )
             offered = jnp.float32(offered_qps)
             gap = (
@@ -139,6 +148,7 @@ class ShardedSimulator:
             key, offered, gap, nominal_gap,
             jnp.float32(window[0]), jnp.float32(window[1]),
             self.sim._vis_arg(float(offered)),
+            self.sim._windows_arg(float(offered), sat_conns > 0),
         )
 
     # ------------------------------------------------------------------
@@ -152,7 +162,7 @@ class ShardedSimulator:
             mapped = jax.shard_map(
                 body,
                 mesh=self.mesh,
-                in_specs=tuple(P() for _ in range(7)),
+                in_specs=tuple(P() for _ in range(8)),
                 out_specs=RunSummary(
                     count=P(),
                     error_count=P(),
@@ -201,6 +211,7 @@ class ShardedSimulator:
         win_lo: jax.Array,
         win_hi: jax.Array,
         visits_pc: jax.Array,
+        phase_windows: jax.Array,
     ) -> RunSummary:
         both = tuple(self.mesh.axis_names)
         shard = jnp.int32(0)
@@ -230,6 +241,7 @@ class ShardedSimulator:
                 req_off,
                 sat_conns=sat_conns,
                 visits_pc=visits_pc,
+                phase_windows=phase_windows,
             )
             return (t_end, conn_end, req_off + per), summarize(
                 res, self.collector,

@@ -346,6 +346,27 @@ class Simulator:
         self._eff_replicas = jnp.asarray(np.maximum(eff, 1), jnp.int32)
         self.has_chaos = bool(chaos)
 
+        # -- post-storm drain windows ---------------------------------------
+        # The phase model is piecewise-stationary, but an OVERLOADED
+        # phase (rho >= 1 somewhere — e.g. a retry storm under chaos)
+        # leaves a backlog that the next phase drains at its freed
+        # capacity before waits return to that phase's stationary law.
+        # The engine models this with a phase-WINDOW table, (bounds,
+        # row) pairs packed as one (2, W) array passed per run: drain
+        # windows extend the congested row past its cut
+        # (_phase_windows).  W is static: P real windows + up to P-1
+        # drains.
+        P_static = len(cuts)
+        self._num_windows = 2 * P_static - 1 if P_static > 1 else 1
+        ident_b = list(cuts) + [cuts[-1]] * (self._num_windows - P_static)
+        ident_r = list(range(P_static)) + [P_static - 1] * (
+            self._num_windows - P_static
+        )
+        self._ident_windows = np.stack(
+            [np.asarray(ident_b), np.asarray(ident_r, np.float64)]
+        ).astype(np.float32)
+        self._window_cache: Dict[tuple, np.ndarray] = {}
+
         # -- ungraceful kills (drain=False): resident-request resets -------
         # A graceful kill (default) only removes capacity; an ungraceful
         # one also resets the requests resident on the killed replicas at
@@ -932,6 +953,68 @@ class Simulator:
             self._feedback.visits_pc(float(offered)), jnp.float32
         )
 
+    def _windows_arg(self, offered: float, sat: bool) -> jax.Array:
+        """The (2, W) packed (bounds, row) phase-window table at
+        ``offered``: identity unless an overloaded phase leaves a
+        backlog, in which case drain windows keep the congested row
+        active past its cut for backlog / freed-capacity seconds.
+
+        Saturated (-qps max) runs skip drains: the closed population
+        bounds the backlog at C, so queues drain within one cycle.
+        """
+        P = int(self._phase_starts.shape[0])
+        if P == 1 or sat or not self.has_chaos:
+            return jnp.asarray(self._ident_windows)
+        key = (float(f"{float(offered):.4g}"),)
+        if key not in self._window_cache:
+            cuts = np.asarray(self._phase_starts, np.float64)
+            S = self.compiled.num_services
+            Cc = self._num_combos
+            visits = (
+                self._feedback.visits_pc(offered)
+                if self._feedback is not None
+                else self._visits_pc_np
+            )
+            lam = offered * visits.reshape(P, Cc, S).mean(1)  # (P, S)
+            eff = np.asarray(self._eff_replicas_pc, np.float64)[
+                ::Cc
+            ]  # (P, S) clamped >= 1
+            down = np.asarray(self._svc_down_pc, bool)[::Cc]
+            cap = np.where(down, 0.0, eff * self._mu)
+            lam = np.where(down, 0.0, lam)
+
+            seq = [(float(cuts[0]), 0)]
+            backlog = np.zeros(S)
+            for p in range(P - 1):
+                dur = float(cuts[p + 1] - cuts[p])
+                backlog += np.maximum(lam[p] - cap[p], 0.0) * dur
+                free = cap[p + 1] - lam[p + 1]
+                drainable = (backlog > 1e-9) & (free > 1e-9)
+                nxt_end = float(cuts[p + 2]) if p + 2 < P else np.inf
+                if drainable.any():
+                    drain_t = float(
+                        (backlog[drainable] / free[drainable]).max()
+                    )
+                    drain_end = min(cuts[p + 1] + drain_t, nxt_end)
+                    if drain_end > cuts[p + 1] + 1e-9:
+                        # the congested row stays live while draining
+                        seq.append((float(cuts[p + 1]), p))
+                        if drain_end < nxt_end:
+                            seq.append((float(drain_end), p + 1))
+                        drained = (
+                            np.maximum(free, 0.0)
+                            * (drain_end - cuts[p + 1])
+                        )
+                        backlog = np.maximum(backlog - drained, 0.0)
+                        continue
+                seq.append((float(cuts[p + 1]), p + 1))
+            while len(seq) < self._num_windows:
+                seq.append(seq[-1])
+            self._window_cache[key] = np.asarray(
+                [[b for b, _ in seq], [r for _, r in seq]], np.float32
+            )
+        return jnp.asarray(self._window_cache[key])
+
     def run(
         self,
         load: LoadModel,
@@ -951,6 +1034,7 @@ class Simulator:
                 key, jnp.float32(load.qps), jnp.float32(0.0),
                 jnp.float32(load.qps), jnp.float32(0.0),
                 visits_pc=self._vis_arg(load.qps),
+                phase_windows=self._windows_arg(load.qps, False),
             )
         lam = self.solve_closed_rate(load, num_requests, key,
                                      fixed_point_iters)
@@ -964,10 +1048,12 @@ class Simulator:
         # issue at the solved throughput, so placing every request at t=0
         # would silently skip chaos phases.
         nominal_gap = jnp.float32(load.connections / lam)
+        sat = self._saturated(load)
         return self._get(num_requests, CLOSED_LOOP, load.connections,
-                         sat=self._saturated(load))(
+                         sat=sat)(
             key, jnp.float32(lam), gap, jnp.float32(lam), nominal_gap,
             visits_pc=self._vis_arg(lam),
+            phase_windows=self._windows_arg(lam, sat),
         )
 
     def _saturated(self, load: LoadModel) -> bool:
@@ -1028,6 +1114,7 @@ class Simulator:
                 jax.random.fold_in(key, i), jnp.float32(lam), gap,
                 jnp.float32(lam), jnp.float32(load.connections / lam),
                 visits_pc=self._vis_arg(lam),
+                phase_windows=self._windows_arg(lam, False),
             )
             mean_lat = float(res.client_latency.mean())
             out = load.connections / max(mean_lat, 1e-9)
@@ -1127,13 +1214,15 @@ class Simulator:
             window = trim_window_bounds(num_blocks * block, offered)
         else:
             window = (0.0, np.inf)
+        sat = self._saturated(load)
         fn = self._get_summary(block, num_blocks, load.kind, conns,
-                               collector, trim, sat=self._saturated(load))
+                               collector, trim, sat=sat)
         return fn(
             key, jnp.float32(offered), jnp.float32(pace),
             jnp.float32(offered), jnp.float32(nominal),
             jnp.float32(window[0]), jnp.float32(window[1]),
             self._vis_arg(offered),
+            self._windows_arg(offered, sat),
         )
 
     def default_block_size(self, budget_elems: int = 33_554_432) -> int:
@@ -1182,7 +1271,8 @@ class Simulator:
             per = block // c
 
             def scanfn(key, offered_qps, pace_gap, arrival_qps,
-                       nominal_gap, win_lo, win_hi, visits_pc):
+                       nominal_gap, win_lo, win_hi, visits_pc,
+                       phase_windows):
                 def body(carry, b):
                     t0, conn_t0, req_off = carry
                     # disjoint fold domain: the closed-loop rate solver's
@@ -1194,6 +1284,7 @@ class Simulator:
                         req_off,
                         sat_conns=connections if sat else 0,
                         visits_pc=visits_pc,
+                        phase_windows=phase_windows,
                     )
                     s = summary_mod.summarize(
                         res, collector,
@@ -1251,6 +1342,7 @@ class Simulator:
         arrival_qps: jax.Array,
         nominal_gap: Optional[jax.Array] = None,
         visits_pc: Optional[jax.Array] = None,
+        phase_windows: Optional[jax.Array] = None,
     ) -> SimResults:
         """One self-contained block starting at t=0 (see _simulate_core)."""
         if nominal_gap is None:
@@ -1262,6 +1354,7 @@ class Simulator:
             jnp.float32(0.0),
             sat_conns=connections if sat else 0,
             visits_pc=visits_pc,
+            phase_windows=phase_windows,
         )
         return res
 
@@ -1281,6 +1374,7 @@ class Simulator:
         sat_conns: int = 0,
         sat_override: Optional[Tuple[jax.Array, jax.Array]] = None,
         visits_pc: Optional[jax.Array] = None,
+        phase_windows: Optional[jax.Array] = None,
     ) -> Tuple[SimResults, jax.Array, jax.Array]:
         """``offered_qps`` drives the queueing model (the rate the whole
         fleet of services sees); ``arrival_qps`` paces this batch's
@@ -1491,13 +1585,20 @@ class Simulator:
             )
         else:
             if P > 1:
-                chaos_idx = (
+                # phase WINDOWS, not raw cuts: drain windows keep an
+                # overloaded row live past its cut (_windows_arg)
+                if phase_windows is None:
+                    phase_windows = jnp.asarray(self._ident_windows)
+                win_idx = (
                     jnp.searchsorted(
-                        self._phase_starts, nominal_arrivals,
+                        phase_windows[0], nominal_arrivals,
                         side="right",
                     ).astype(jnp.int32)
                     - 1
                 )  # (N,)
+                chaos_idx = phase_windows[1].astype(jnp.int32)[
+                    jnp.clip(win_idx, 0, self._num_windows - 1)
+                ]
             else:
                 chaos_idx = jnp.zeros(n, jnp.int32)
             phase_idx = (
@@ -1528,17 +1629,19 @@ class Simulator:
             # the population copula (negative equicorrelation from the
             # fixed in-flight census, chains only) centers across hops.
             hi = jax.lax.Precision.HIGHEST
+
+            def _horner(v, coef_h):
+                w = coef_h[-1]
+                for ci in range(coef_h.shape[0] - 2, -1, -1):
+                    w = w * v + coef_h[ci]
+                return w
+
             if sat_override is not None:
                 # fixed-point pilot: tables are traced arguments, no
                 # population centering (fork-join graphs have none)
                 p0_h, coef_h = sat_override
                 z = z_wait
-
-                def eval_poly(v, coef_h=coef_h):
-                    w = coef_h[-1]
-                    for ci in range(coef_h.shape[0] - 2, -1, -1):
-                        w = w * v + coef_h[ci]
-                    return w
+                eval_poly = partial(_horner, coef_h=coef_h)
             elif num_phases == 1:
                 (_, p0_R, coef_R, e_R, c_R,
                  scale_R) = self._closed_tables(sat_conns)
@@ -1548,12 +1651,7 @@ class Simulator:
                 if c_center > 0.0:
                     zproj = (z * e_R[0]).sum(-1, keepdims=True)
                     z = (z - c_center * e_R[0] * zproj) * scale_R[0]
-
-                def eval_poly(v, coef_h=coef_R[0]):
-                    w = coef_h[-1]
-                    for ci in range(coef_h.shape[0] - 2, -1, -1):
-                        w = w * v + coef_h[ci]
-                    return w
+                eval_poly = partial(_horner, coef_h=coef_R[0])
             else:
                 # per-phase tables selected by each request's arrival
                 # phase (``oh`` from the phase-table expansion above)

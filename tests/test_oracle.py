@@ -473,7 +473,12 @@ def test_retry_storm_feedback_matches_oracle_collapse():
     oracle = OracleSimulator(graph, SimParams(), chaos)
     ro = oracle.run(load, 600_000, seed=0)
 
-    for lo, hi, tol in ((0.5, 2.0, 0.03), (2.2, 15.0, 0.03)):
+    # pre-chaos, in-chaos, AND post-chaos: the drain-window model keeps
+    # the storm row live for backlog/freed-capacity seconds after the
+    # chaos ends (~9 s here), so the post window tracks the oracle's
+    # drain transient too (measured -44.6% -> -0.04% without/with)
+    for lo, hi, tol in ((0.5, 2.0, 0.03), (2.2, 15.0, 0.03),
+                        (16.0, 23.0, 0.04)):
         m_e = (st >= lo) & (st <= hi)
         m_o = (ro.client_start >= lo) & (ro.client_start <= hi)
         for q in (0.5, 0.99):

@@ -87,3 +87,25 @@ def test_conditional_wait_zero_p_wait_is_zero():
         jnp.asarray([0.0]), jnp.asarray([100.0]), jnp.asarray([0.5])
     )
     assert float(w[0]) == 0.0
+
+
+def test_convolution_matches_mva_on_k1_networks():
+    # the cross-check mva_load_dependent's docstring promises: on k=1
+    # networks (where exact MVA is numerically sound) the stable Buzen
+    # convolution must agree to float precision
+    import numpy as np
+
+    from isotope_tpu.sim import closed
+
+    v = np.array([1.0, 0.6, 1.0])
+    k = np.ones(3)
+    lam_c, pi_c, pid_c = closed.convolution_marginals(
+        v, k, 13000.0, 1.5e-3, 48
+    )
+    lam_m, pi_m, pid_m = closed.mva_load_dependent(
+        v, v, k, 13000.0, 1.5e-3, 48
+    )
+    assert lam_c == pytest.approx(lam_m, rel=1e-9)
+    np.testing.assert_allclose(
+        pi_c, pi_m[:, : pi_c.shape[1]], atol=1e-9
+    )

@@ -104,6 +104,15 @@ def compare(
     return rows, (thr_e, thr_o)
 
 
+def print_rows(rows, te=None, to=None):
+    for r in rows:
+        print(f"{r[0]:<28}{r[1]:>6}{r[2]*1e3:>10.4f}ms"
+              f"{r[3]*1e3:>10.4f}ms{r[4]*100:>8.2f}%")
+    if te is not None:
+        print(f"{'  throughput':<28}{'':>6}{te:>10.0f}/s"
+              f"{to:>10.0f}/s{(te/to-1)*100:>8.2f}%")
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--n-engine", type=int, default=400_000)
@@ -122,9 +131,7 @@ def main():
                 f"{name}/open rho={rho}", yaml_text, load,
                 args.n_engine, args.n_oracle,
             )
-            for r in rows:
-                print(f"{r[0]:<28}{r[1]:>6}{r[2]*1e3:>10.4f}ms"
-                      f"{r[3]*1e3:>10.4f}ms{r[4]*100:>8.2f}%")
+            print_rows(rows)
     # closed loop: 64 connections, qps None (max) and paced
     for name, yaml_text in (("chain3", CHAIN3),):
         for qps, tag in ((None, "max"), (0.5 * mu, "half")):
@@ -133,11 +140,25 @@ def main():
                 f"{name}/closed64 {tag}", yaml_text, load,
                 256_000, 1_024_000,
             )
-            for r in rows:
-                print(f"{r[0]:<28}{r[1]:>6}{r[2]*1e3:>10.4f}ms"
-                      f"{r[3]*1e3:>10.4f}ms{r[4]*100:>8.2f}%")
-            print(f"{'  throughput':<28}{'':>6}{te:>10.0f}/s"
-                  f"{to:>10.0f}/s{(te/to-1)*100:>8.2f}%")
+            print_rows(rows, te, to)
+    # mixed replica counts: the 1-replica bottleneck regression case
+    mixed = """
+services:
+- name: a
+  isEntrypoint: true
+  numReplicas: 2
+  script: [{call: b}]
+- name: b
+  numReplicas: 1
+  script: [{call: c}]
+- name: c
+  numReplicas: 2
+"""
+    load = LoadModel(kind="closed", qps=None, connections=64)
+    rows, (te, to) = compare(
+        "mixed-k/closed64 max", mixed, load, 64_000, 256_000
+    )
+    print_rows(rows, te, to)
 
 
 if __name__ == "__main__":
