@@ -516,6 +516,20 @@ class Simulator:
         )
         self._closed_cache: Dict[int, tuple] = {}
         self._sat_pilot_fns: Dict[int, "jax.stages.Wrapped"] = {}
+        # service-time squared coefficient of variation: the
+        # census-conditional wait's variance scales with it (a sum of
+        # j residual services — sim/closed._erlang_mixture_quantiles)
+        if params.service_time == SERVICE_TIME_DETERMINISTIC:
+            self._svc_scv = 0.0
+        elif params.service_time == SERVICE_TIME_LOGNORMAL:
+            self._svc_scv = float(
+                np.expm1(params.service_time_param**2)
+            )
+        elif params.service_time == SERVICE_TIME_PARETO:
+            a = params.service_time_param
+            self._svc_scv = 1.0 / (a * (a - 2.0)) if a > 2.01 else 25.0
+        else:
+            self._svc_scv = 1.0
 
         # -- static RNG elimination -----------------------------------------
         # The reference's hot path only flips coins that can land both ways:
@@ -867,7 +881,7 @@ class Simulator:
                 key = jax.random.PRNGKey(20_260_730)
                 for it in range(12):
                     p0, coef, _ = closed.tables_from_pi(
-                        pi, reps, self._mu
+                        pi, reps, self._mu, scv=self._svc_scv
                     )
                     e = float(
                         pilot(
@@ -885,14 +899,16 @@ class Simulator:
                     )
                     if done:
                         break
-            p0, coef, _ = closed.tables_from_pi(pi, reps, self._mu)
+            p0, coef, _ = closed.tables_from_pi(
+                pi, reps, self._mu, scv=self._svc_scv
+            )
             throughput = connections / cycle
             sigma = None
             var_d = 0.0
         else:
             tabs = closed.closed_network_tables(
                 visits, cycle_visits_r, reps, self._mu,
-                delay_r, connections,
+                delay_r, connections, scv=self._svc_scv,
             )
             p0, coef = tabs.p_zero, tabs.coef
             throughput = tabs.throughput
