@@ -200,6 +200,52 @@ def realistic_topology(
     }
 
 
+def with_call_policy(
+    doc: dict,
+    timeout: Optional[str] = None,
+    retries: Optional[int] = None,
+) -> dict:
+    """Annotate every call command with a timeout and/or retry policy.
+
+    BASELINE configs[3] — "10k-service realistic graph with
+    retries/timeouts" — is a generated topology plus the reference's
+    per-call policy fields (Script extension, models/script.py).  The
+    generators emit bare ``{call: name}`` commands; this rewrites them
+    to the object form carrying the policy, leaving everything else
+    untouched.
+    """
+
+    def rewrite(cmd):
+        if isinstance(cmd, list):
+            return [rewrite(c) for c in cmd]
+        if isinstance(cmd, dict) and "call" in cmd:
+            call = cmd["call"]
+            if isinstance(call, str):
+                call = {"service": call}
+            else:
+                call = dict(call)
+            if timeout is not None:
+                call["timeout"] = timeout
+            if retries is not None:
+                call["retries"] = retries
+            return {**cmd, "call": call}
+        return cmd
+
+    services = []
+    for svc in doc.get("services", []):
+        copy = dict(svc)
+        if "script" in copy:
+            copy["script"] = [rewrite(c) for c in copy["script"]]
+        services.append(copy)
+    out = dict(doc, services=services)
+    defaults = doc.get("defaults")
+    if defaults and "script" in defaults:
+        out["defaults"] = dict(
+            defaults, script=[rewrite(c) for c in defaults["script"]]
+        )
+    return out
+
+
 def replicate_topology(
     doc: dict,
     instances: int,

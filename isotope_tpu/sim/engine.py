@@ -151,10 +151,15 @@ class _SparseSteps:
     and child start offsets gather static sleep prefixes plus the
     dynamic call prefix at their slot.
 
-    Only valid when the level cannot transport-fail (no finite
-    timeouts, no chaos): a transport failure truncates the script
-    mid-way, which needs the dense executed-step mask.  The engine
-    falls back to dense in those runs.
+    Transport failures (timeouts / chaos downs) are supported without
+    ever rebuilding the dense executed-step mask: a transport failure
+    can only originate at a CALL-BEARING step, so the first failing
+    *slot* of a hop determines its truncation point.  A scatter-min
+    over the slot axis yields the per-hop fail slot; slots past it are
+    zeroed before the packed prefix sums, the executed pure-sleep part
+    comes from a static per-slot sleep prefix, and children past the
+    fail step take the parent's truncated busy time as their offset
+    (matching the dense grid's flat prefix past the failure).
     """
 
     n_slots: int
@@ -167,6 +172,10 @@ class _SparseSteps:
     child_sleep_prefix: jax.Array  # (C,) static sleep before child's step
     child_slot: jax.Array         # (C,) slot of the child's step
     child_seg_first: jax.Array    # (C,) first slot of the child's parent
+    # -- transport-failure truncation tables (see class docstring) ------
+    slot_hop: jax.Array           # (S,) local hop index of each slot
+    slot_step: jax.Array          # (S,) step index of each slot
+    slot_sleep_prefix: jax.Array  # (S,) static sleep before the slot
 
 
 def _call_outcome(t, timeout, down_child):
@@ -577,10 +586,11 @@ class Simulator:
                     uniform = c
 
             # -- sparse call-slot encoding for skewed wide levels ------
-            # Transport failures (timeouts / chaos downs) need the dense
-            # executed-step mask, so sparse requires their static
-            # absence.  Dense grids within 4x of the real call-step
-            # count (or small outright) aren't worth the extra gathers.
+            # Transport failures (timeouts / chaos downs) are handled
+            # via per-slot fail scatter-mins (see _SparseSteps), so the
+            # encoding activates purely on shape.  Dense grids within
+            # 4x of the real call-step count (or small outright) aren't
+            # worth the extra gathers.
             sparse: Optional[_SparseSteps] = None
             leaf_busy: Optional[jax.Array] = None
             sleep_real = lvl.step_is_real.astype(np.float64) * (
@@ -588,10 +598,7 @@ class Simulator:
             )
             if n_calls == 0:
                 leaf_busy = jnp.asarray(sleep_real.sum(1), jnp.float32)
-            elif (
-                not self.has_chaos
-                and not bool(np.isfinite(lvl.call_timeout).any())
-            ):
+            else:
                 slot_segs = np.unique(call_seg_p)  # sorted
                 n_slots = len(slot_segs)
                 dense_elems = lvl.num_hops * pmax
@@ -647,6 +654,12 @@ class Simulator:
                         child_slot=jnp.asarray(child_slot_np, jnp.int32),
                         child_seg_first=jnp.asarray(
                             seg_first[parent_local], jnp.int32
+                        ),
+                        slot_hop=jnp.asarray(slot_hop, jnp.int32),
+                        slot_step=jnp.asarray(slot_step, jnp.int32),
+                        slot_sleep_prefix=jnp.asarray(
+                            sleep_prefix[slot_hop, slot_step],
+                            jnp.float32,
                         ),
                     )
             levels.append(
@@ -1856,20 +1869,62 @@ class Simulator:
 
                 # -- aggregate calls into (parent, step) slots -------------
                 if lvl.sparse is not None:
-                    # sparse call-slot path (skewed wide level; transport
-                    # is statically impossible here, so no truncation
-                    # mask is ever needed): per-hop busy times are
-                    # packed segment sums, pure-sleep steps are static
+                    # sparse call-slot path (skewed wide level): per-hop
+                    # busy times are packed segment sums, pure-sleep
+                    # steps are static.  Transport failures truncate via
+                    # the per-slot fail scatter-min — a failure can only
+                    # originate at a call-bearing step, so the first
+                    # failing slot pins the hop's fail step exactly as
+                    # the dense executed-step mask would.
                     sp = lvl.sparse
+                    S = sp.n_slots
                     if sp.call_slot is None:
                         slot_agg = dur_call
+                        slot_fail = final_transport
                     else:
                         slot_agg = (
-                            jnp.zeros((n, sp.n_slots))
+                            jnp.zeros((n, S))
                             .at[:, sp.call_slot]
                             .max(dur_call)
                         )
+                        slot_fail = (
+                            jnp.zeros((n, S), bool)
+                            .at[:, sp.call_slot]
+                            .max(final_transport)
+                            if final_transport is not None
+                            else None
+                        )
                     dyn = jnp.maximum(sp.slot_base, slot_agg)
+                    if slot_fail is not None:
+                        fail_slot = (
+                            jnp.full((n, lvl.size), S, jnp.int32)
+                            .at[:, sp.slot_hop]
+                            .min(
+                                jnp.where(
+                                    slot_fail,
+                                    jnp.arange(S, dtype=jnp.int32),
+                                    S,
+                                )
+                            )
+                        )
+                        failed = fail_slot < S
+                        safe = jnp.minimum(fail_slot, S - 1)
+                        fail_step = jnp.where(
+                            failed, sp.slot_step[safe], P
+                        )
+                        # slots past the hop's fail step don't execute
+                        dyn = jnp.where(
+                            sp.slot_step[None, :]
+                            <= fail_step[:, sp.slot_hop],
+                            dyn,
+                            0.0,
+                        )
+                        sleep_exec = jnp.where(
+                            failed, sp.slot_sleep_prefix[safe],
+                            sp.sleep_total,
+                        )
+                    else:
+                        sleep_exec = sp.sleep_total
                     pcs = jnp.cumsum(dyn, axis=1)
                     excl = pcs - dyn
                     seg_sum = jnp.where(
@@ -1877,12 +1932,22 @@ class Simulator:
                         pcs[:, sp.seg_last] - excl[:, sp.seg_first],
                         0.0,
                     )
-                    busy = sp.sleep_total + seg_sum
+                    busy = sleep_exec + seg_sum
                     off = (
                         sp.child_sleep_prefix
                         + excl[:, sp.child_slot]
                         - excl[:, sp.child_seg_first]
                     )
+                    if fail_step is not None:
+                        # children past the fail step aren't sent; the
+                        # dense grid's prefix is flat there (== the
+                        # truncated busy time) — match it exactly
+                        off = jnp.where(
+                            lvl.child_step
+                            <= fail_step[:, lvl.child_parent_local],
+                            off,
+                            busy[:, lvl.child_parent_local],
+                        )
                     if err_coin is not None:
                         # a 500ing parent runs no steps (dense zeroes
                         # the grid before the prefix — match exactly)

@@ -54,11 +54,11 @@ SPARSE = SimParams(sparse_level_elems=1)
 LOAD = LoadModel(kind="open", qps=0.4 / SimParams().cpu_time_s)
 
 
-def both_encodings(yaml_text, load=LOAD, n=20_000, **kw):
+def both_encodings(yaml_text, load=LOAD, n=20_000, chaos=(), **kw):
     g = ServiceGraph.from_yaml(yaml_text)
-    dense = Simulator(compile_graph(g), SimParams(**kw))
+    dense = Simulator(compile_graph(g), SimParams(**kw), chaos)
     sparse = Simulator(
-        compile_graph(g), SimParams(sparse_level_elems=1, **kw)
+        compile_graph(g), SimParams(sparse_level_elems=1, **kw), chaos
     )
     # the threshold actually flipped the encoding somewhere
     assert all(lvl.sparse is None for lvl in dense._levels)
@@ -148,7 +148,10 @@ def test_sparse_exact_latency_under_det():
     )
 
 
-def test_sparse_inactive_with_timeouts_or_chaos():
+def test_sparse_active_with_timeouts_and_chaos():
+    # transport failures no longer force the dense fallback: the
+    # per-slot fail scatter-min keeps the encoding valid (BASELINE
+    # configs[3] — 10k-service graph WITH retries/timeouts — needs it)
     from isotope_tpu.sim.config import ChaosEvent
 
     to = SKEWED.replace(
@@ -157,14 +160,120 @@ def test_sparse_inactive_with_timeouts_or_chaos():
     sim = Simulator(
         compile_graph(ServiceGraph.from_yaml(to)), SPARSE
     )
-    assert all(lvl.sparse is None for lvl in sim._levels)
+    assert any(lvl.sparse is not None for lvl in sim._levels)
 
     sim2 = Simulator(
         compile_graph(ServiceGraph.from_yaml(SKEWED)), SPARSE,
         (ChaosEvent(service="w0", start_s=1.0, end_s=2.0,
                     replicas_down=None),),
     )
-    assert all(lvl.sparse is None for lvl in sim2._levels)
+    assert any(lvl.sparse is not None for lvl in sim2._levels)
+
+
+def test_sparse_matches_dense_with_firing_timeouts():
+    # a timeout short enough that w0's 5ms sleep busts it: the hub
+    # transport-fails at that step, truncating its script — later
+    # steps (w1/w2/w3 calls, the 3ms sleep) must not run
+    yaml_text = SKEWED.replace(
+        "  - call: w0\n", "  - call: {service: w0, timeout: 3ms}\n"
+    )
+    rd, rs = both_encodings(yaml_text)
+    assert_same(rd, rs)
+    # the truncation actually fires: the hub hop 500s (a downstream
+    # 500 does NOT fail the entry), and w1/w2/w3 are never sent while
+    # w0 (the timed-out attempt) is
+    err = np.asarray(rd.hop_error)
+    sent = np.asarray(rd.hop_sent)
+    assert err[:, 1].all()
+    assert sent[:, 5].all() and not sent[:, 6:9].any()
+
+
+def test_sparse_matches_dense_with_mid_script_timeout():
+    # timeout on a MIDDLE call (w1) leaves earlier steps intact and
+    # kills only the tail — exercises partial sleep prefixes
+    yaml_text = SKEWED.replace(
+        "  - call: w1\n",
+        "  - call: {service: w1, timeout: 0.1ms}\n",
+    )
+    assert_same(*both_encodings(yaml_text))
+
+
+def test_sparse_matches_dense_with_timeout_retries():
+    # retries re-attempt timed-out calls; attempt durations stack
+    # inside the failing step before truncation
+    yaml_text = SKEWED.replace(
+        "  - call: w1\n",
+        "  - call: {service: w1, timeout: 0.2ms, retries: 2}\n",
+    )
+    assert_same(*both_encodings(yaml_text))
+
+
+def test_sparse_matches_dense_concurrent_slot_timeout():
+    # two calls SHARING one (hop, step) slot — a concurrent fan-out
+    # step inside the hub — where one of them times out: exercises the
+    # non-identity call_slot scatter for both the duration max and the
+    # slot-failure or-reduction, plus truncation of the steps after it
+    yaml_text = SKEWED.replace(
+        "  - call: w1\n  - call: w2\n",
+        "  - [{call: {service: w1, timeout: 0.1ms}}, {call: w2}]\n",
+    )
+    g = ServiceGraph.from_yaml(yaml_text)
+    sparse_sim = Simulator(compile_graph(g), SPARSE)
+    lv = [l for l in sparse_sim._levels if l.sparse is not None]
+    assert lv and any(l.sparse.call_slot is not None for l in lv)
+    rd, rs = both_encodings(yaml_text)
+    assert_same(rd, rs)
+    # the timeout fires on w1 while its slot-mate w2 still runs, and
+    # the steps after the fan-out (3ms sleep, w3 call) are truncated
+    sent = np.asarray(rd.hop_sent)
+    hub_err = np.asarray(rd.hop_error)[:, 1]
+    assert hub_err.all()
+    i_w2 = 5 + 2  # level-2 hops start at 5: w0, w1, w2, w3
+    i_w3 = 5 + 3
+    assert sent[:, i_w2].all() and not sent[:, i_w3].any()
+
+
+def test_sparse_matches_dense_with_chaos_total():
+    from isotope_tpu.sim.config import ChaosEvent
+
+    # w2 fully down in a window: hub requests arriving inside it
+    # transport-fail at the w2 step, others run the full script
+    n = 20_000
+    dur = n / LOAD.qps
+    chaos = (
+        ChaosEvent(
+            service="w2",
+            start_s=0.25 * dur,
+            end_s=0.75 * dur,
+            replicas_down=None,
+        ),
+    )
+    rd, rs = both_encodings(SKEWED, chaos=chaos)
+    assert_same(rd, rs)
+    # the window genuinely bit: hub hops transport-failing at the w2
+    # step 500 (without failing the entry), only inside the window
+    errs = np.asarray(rd.hop_error)[:, 1]
+    assert 0 < errs.sum() < n
+
+
+def test_sparse_matches_dense_with_chaos_and_timeout():
+    from isotope_tpu.sim.config import ChaosEvent
+
+    yaml_text = SKEWED.replace(
+        "  - call: w3\n",
+        "  - call: {service: w3, timeout: 0.2ms}\n",
+    )
+    n = 20_000
+    dur = n / LOAD.qps
+    chaos = (
+        ChaosEvent(
+            service="w0",
+            start_s=0.25 * dur,
+            end_s=0.5 * dur,
+            replicas_down=None,
+        ),
+    )
+    assert_same(*both_encodings(yaml_text, chaos=chaos, n=n))
 
 
 def test_leaf_levels_use_static_busy():

@@ -1,0 +1,108 @@
+"""Per-case bench regression gate.
+
+VERDICT r4 found svc1000 sliding 2.50B -> 2.05B -> 1.50B across rounds
+with nothing noticing: ``bench.py`` reported best-of-3 and no check
+compared against the previous round's driver capture.  This tool diffs
+a fresh bench capture against the newest ``BENCH_r*.json`` in the repo
+root and fails on any per-case regression beyond the threshold.
+
+Usage:
+    python bench.py | tee /tmp/bench.json
+    python tools/bench_regress.py /tmp/bench.json
+
+The driver's BENCH files wrap the parsed line under ``"parsed"``; a raw
+``bench.py`` line is accepted too.  Only numeric, per-case rate keys
+present in both captures are compared (evidence keys like
+``*_inflight`` and spread keys are skipped); the headline ``value`` is
+compared as case ``tree121``.
+"""
+from __future__ import annotations
+
+import glob
+import json
+import os
+import re
+import sys
+
+THRESHOLD = 0.15  # fail when new < (1 - THRESHOLD) * old
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load_capture(path: str) -> dict:
+    with open(path) as f:
+        text = f.read()
+    # accept either a driver BENCH_r*.json wrapper or a raw bench line
+    # (possibly preceded by jax warnings on stderr-merged logs)
+    try:
+        doc = json.loads(text)
+    except json.JSONDecodeError:
+        doc = None
+        for line in text.splitlines():
+            line = line.strip()
+            if line.startswith("{"):
+                try:
+                    doc = json.loads(line)
+                except json.JSONDecodeError:
+                    continue
+        if doc is None:
+            raise
+    if "parsed" in doc:
+        doc = doc["parsed"]
+    return doc
+
+
+def _cases(doc: dict) -> dict:
+    cases = {"tree121": float(doc["value"])}
+    for k, v in doc.get("extra", {}).items():
+        if not isinstance(v, (int, float)):
+            continue
+        if k.endswith(("_inflight", "_spread", "_census")):
+            continue  # evidence / variance keys, not rates
+        cases[k] = float(v)
+    return cases
+
+
+def previous_capture() -> tuple:
+    files = sorted(
+        glob.glob(os.path.join(REPO_ROOT, "BENCH_r*.json")),
+        key=lambda p: int(re.search(r"r(\d+)", p).group(1)),
+    )
+    if not files:
+        return None, None
+    path = files[-1]
+    return path, _cases(_load_capture(path))
+
+
+def main() -> int:
+    if len(sys.argv) != 2:
+        print(__doc__)
+        return 2
+    new = _cases(_load_capture(sys.argv[1]))
+    prev_path, prev = previous_capture()
+    if prev is None:
+        print("bench_regress: no BENCH_r*.json baseline found — skipping")
+        return 0
+    failures = []
+    for case, old_rate in sorted(prev.items()):
+        if case not in new:
+            print(f"bench_regress: {case}: dropped from capture "
+                  f"(was {old_rate:.3g}) — not compared")
+            continue
+        ratio = new[case] / old_rate if old_rate > 0 else float("inf")
+        verdict = "OK"
+        if ratio < 1.0 - THRESHOLD:
+            verdict = "REGRESSION"
+            failures.append(case)
+        print(f"bench_regress: {case}: {old_rate:.4g} -> "
+              f"{new[case]:.4g} ({(ratio - 1) * 100:+.1f}%) {verdict}")
+    if failures:
+        print(f"bench_regress: FAIL vs {prev_path}: "
+              f"{', '.join(failures)} regressed >"
+              f"{THRESHOLD:.0%}")
+        return 1
+    print(f"bench_regress: PASS vs {prev_path}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
