@@ -423,16 +423,27 @@ def compress_census(pi_row: np.ndarray, scv: float) -> np.ndarray:
 
     The convolution/decomposition census assumes exponential service;
     the real queue-length fluctuation scales roughly with the
-    arrival+service variability, interpolated (as in QNA / Whitt) by
-    sqrt((1 + scv) / 2) around the mean.  Deterministic service
-    (scv~0) compresses deviations by ~0.71 — the pipeline-like census
-    the DES shows — while heavy tails widen them.  Mass is remapped
-    with linear interpolation (mean-preserving up to edge clipping).
+    arrival+service variability.  Two regimes:
+
+    - scv >= 1: the open-network QNA form sqrt((1 + scv) / 2)
+      (Poisson-ish arrival stream, ca^2 ~ 1) — heavy tails widen the
+      census.
+    - scv < 1: the closed saturated loop feeds each station with the
+      DEPARTURES of its neighbors, whose variability collapses with
+      the service scv (Whitt's departure interpolation at rho -> 1:
+      cd^2 ~ cs^2), so ca^2 ~ scv and the factor is
+      sqrt((scv + scv) / 2) = sqrt(scv) — reaching the deterministic
+      pipeline's point census at scv -> 0 instead of QNA's 0.71
+      floor, which left M/D/k saturated p99 at +25% (VERDICT r4).
+
+    Both forms agree at scv = 1 (exponential: no reshaping).  Mass is
+    remapped with linear interpolation (mean-preserving up to edge
+    clipping).
     """
     scv = min(max(float(scv), 1e-3), 25.0)
     if abs(scv - 1.0) < 1e-9:
         return pi_row
-    f = np.sqrt((1.0 + scv) / 2.0)
+    f = np.sqrt(scv) if scv < 1.0 else np.sqrt((1.0 + scv) / 2.0)
     n = len(pi_row)
     j = np.arange(n, dtype=np.float64)
     mean = float((pi_row * j).sum())
@@ -531,6 +542,36 @@ def closed_network_tables(
     p_zero, coef, mean_wait = tables_from_pi(
         pi, replicas, mu, degree, v_max, scv
     )
+
+    if scv < 1.0 - 1e-9:
+        # Low-variability limit: a deterministic closed network runs a
+        # synchronized pipeline — throughput is exactly
+        # min(N / C0, lambda*) (C0 the zero-wait cycle, lambda* the
+        # capacity bound) with a DEGENERATE sojourn at N / lambda
+        # (measured: the DES oracle's saturated M/D/1 chain has
+        # p50 = p99 = N / capacity to the sample).  The exponential
+        # product form undershoots that throughput (~4% on chain3) and
+        # its census keeps residual burstiness, so blend the
+        # throughput linearly in scv toward the pipeline bound and
+        # rescale the wait tables so the mean sojourn obeys Little's
+        # law at the blended rate.  scv = 1 recovers the product form
+        # untouched; both corrections vanish there.
+        v = np.asarray(visits, np.float64)
+        cyc = np.asarray(cycle_visits, np.float64)
+        k = np.asarray(replicas, np.float64)
+        active = v > 1e-12
+        lam_cap = float(np.min(k[active] * mu / v[active]))
+        c0 = float((cyc / mu).sum()) + float(delay_s)
+        lam_det = min(population / c0, lam_cap)
+        g = max(float(scv), 0.0)
+        lam_new = g * lam + (1.0 - g) * lam_det
+        budget = max(population / lam_new - c0, 0.0)
+        budget_tab = float((cyc * mean_wait).sum())
+        if budget_tab > 1e-12:
+            c = budget / budget_tab
+            coef = coef * c
+            mean_wait = mean_wait * c
+        lam = lam_new
 
     # population copula inputs: Var(sum_s j_s) = Var(j_delay) exactly —
     # the engine shrinks the sigma-weighted z-combination to this target

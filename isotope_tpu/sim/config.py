@@ -91,6 +91,19 @@ class SimParams:
     # Total attempt-attempt correlation = sibling_copula_r +
     # retry_copula_r; fit against the DES oracle (ORACLE.md).
     retry_copula_r: float = 0.5
+    # Hierarchical decay of the sibling copula across the GROUP tree
+    # (open loop only): two hops whose sibling groups share their
+    # lowest common ancestor L levels up correlate at
+    # sibling_copula_r * gamma^L — same-depth groups only, so serial
+    # path sums stay independent (a parent-child term inflates the p99
+    # tail; see engine).  gamma=0 recovers the flat within-group-only
+    # copula.  Fork-join subtrees are fed by the same upstream
+    # arrivals, so COUSIN subtree compositions correlate too — the
+    # flat copula missed that, leaving tree13 p50 +7.9% at rho=0.9
+    # (ORACLE.md r4 "known out-of-envelope" #1); 0.9 measured: +4.1%
+    # p50 / +2.1% p99 at rho=0.9, monotone improvements at 0.3-0.85,
+    # saturated sampler untouched.  Fit against the DES oracle like r.
+    hierarchical_copula_gamma: float = 0.9
     # Dense-grid element threshold above which a skewed level (grid
     # > 4x its real call-step count) switches to the sparse call-slot
     # step encoding (engine._SparseSteps) — the star-10k mitigation.

@@ -388,6 +388,37 @@ class Simulator:
         # downstream metrics are not re-simulated, and closed-loop
         # pacing keeps the uninterrupted latency.
         kills = []
+        back_cum = None
+        if any(not ev.drain for ev in chaos):
+            # payload-free return legs, one per ancestor edge —
+            # cluster-aware: a cross-cluster ancestor edge pays the
+            # gateway extra on its return leg too, matching the
+            # oracle's one_way(0.0) path (ADVICE r4: depth * base alone
+            # diverged by the 1 ms/edge cross_cluster_latency_s on
+            # multicluster drain=False runs)
+            leg = np.full(
+                compiled.num_hops, params.network.base_latency_s,
+                np.float64,
+            )
+            if compiled.services.num_clusters > 1:
+                cl = compiled.services.cluster
+                hs_all = compiled.hop_service
+                par = compiled.hop_parent
+                leg[1:] += np.where(
+                    cl[hs_all[par[1:]]] != cl[hs_all[1:]],
+                    float(params.network.cross_cluster_latency_s),
+                    0.0,
+                )
+            leg[0] += params.network.entry_extra_latency_s
+            back_cum = leg.copy()
+            hi = 1  # level-by-level prefix over the BFS order
+            for lvl_c in compiled.levels:
+                nxt = hi + lvl_c.num_children
+                if lvl_c.num_children:
+                    back_cum[hi:nxt] += back_cum[
+                        compiled.hop_parent[hi:nxt]
+                    ]
+                hi = nxt
         for ev in sorted(chaos, key=lambda e: e.start_s):
             if ev.drain:
                 continue
@@ -406,16 +437,9 @@ class Simulator:
             if k_before <= 0:
                 continue  # already fully down: nothing resident to kill
             cols = np.nonzero(compiled.hop_service == s)[0]
-            # the reset propagates back to the client over payload-free
-            # wire legs, one per ancestor edge (matching the oracle's
-            # one_way(0.0) return; cross-cluster extras on the return
-            # path are ignored — sub-ms, documented approximation)
-            back = jnp.asarray(
-                (compiled.hop_depth[cols] + 1)
-                * params.network.base_latency_s
-                + params.network.entry_extra_latency_s,
-                jnp.float32,
-            )
+            # the reset reaches the client over the ancestor-chain
+            # return legs accumulated above
+            back = jnp.asarray(back_cum[cols], jnp.float32)
             kills.append(
                 (float(ev.start_s), cols, min(down / k_before, 1.0), back)
             )
@@ -464,6 +488,7 @@ class Simulator:
                 np.repeat(svc_down_np, Cc, axis=0),
                 own_combo_np,
                 visits_pc,
+                mtls=mtls,
             )
             if not self._feedback.active:  # pragma: no cover - guard match
                 self._feedback = None
@@ -713,6 +738,7 @@ class Simulator:
         n_multi = 0
         off = 1  # hop 0 is the root; level d's children follow in order
         gid = {("root",): 0}
+        gparent = [0]  # group -> parent group (the root group is its own)
         for d, lvl in enumerate(compiled.levels):
             segs = np.asarray(lvl.child_seg)
             counts: Dict[int, int] = {}
@@ -722,6 +748,13 @@ class Simulator:
                 key = (d, int(seg))
                 if key not in gid:
                     gid[key] = len(gid)
+                    # the group's parent group is the sibling group of
+                    # the PARENT HOP (the hop owning this call step) —
+                    # already assigned: levels fill in BFS order
+                    parent_hop = lvl.hop_ids[
+                        int(seg) // compiled.max_steps
+                    ]
+                    gparent.append(int(group[parent_hop]))
                     if counts[int(seg)] > 1:
                         n_multi += 1
                 group[off + local] = gid[key]
@@ -729,6 +762,50 @@ class Simulator:
         self._sib_group = group.astype(np.int32)
         self._num_sib_groups = len(gid)
         self._copula_active = n_multi > 0 and params.sibling_copula_r > 0.0
+
+        # -- hierarchical copula mix (SimParams.hierarchical_copula_gamma) --
+        # Same-depth sibling groups whose LCA sits L levels up
+        # correlate at gamma^L (so hop waits at r * gamma^L): COUSIN
+        # subtree compositions share upstream arrivals, which the flat
+        # copula missed.  Crucially, groups at DIFFERENT depths stay
+        # independent — a naive "mix down the group tree" recursion
+        # (Z_g = sqrt(gamma) Z_parent + ...) also correlates each hop
+        # with its ANCESTORS at r * gamma^(L/2), inflating the serial
+        # path-sum variance (measured: tree13 rho=0.9 p99 blew from
+        # +2.3% to +18.7%).  Independence across depths comes from
+        # giving every (ancestor group a, depth offset l) pair its OWN
+        # unit normal: group g at depth d loads
+        # sqrt((1-gamma) gamma^l) on (anc_l(g), l) for l < d and
+        # gamma^(d/2) on (root, d); rows have unit norm, and two rows
+        # share a factor iff the groups have equal depth (same l for a
+        # common ancestor), giving exactly gamma^L.
+        self._copula_mix = None
+        self._copula_dim = len(gid)
+        gamma = params.hierarchical_copula_gamma
+        if self._copula_active and gamma > 0.0 and len(gid) > 1:
+            G = len(gid)
+            pair_idx: Dict[Tuple[int, int], int] = {}
+            rows = []  # (g, factor, coeff)
+            for g in range(G):
+                w, a, lev = 1.0, g, 0
+                while a != 0:
+                    key = (a, lev)
+                    if key not in pair_idx:
+                        pair_idx[key] = len(pair_idx)
+                    rows.append((g, pair_idx[key], np.sqrt(w * (1.0 - gamma))))
+                    w *= gamma
+                    a = gparent[a]
+                    lev += 1
+                key = (0, lev)
+                if key not in pair_idx:
+                    pair_idx[key] = len(pair_idx)
+                rows.append((g, pair_idx[key], np.sqrt(w)))
+            F = len(pair_idx)
+            mix = np.zeros((G, F), np.float64)
+            for g, f, c in rows:
+                mix[g, f] = c
+            self._copula_mix = jnp.asarray(mix, jnp.float32)
+            self._copula_dim = F
 
         # -- retry copula: static hop -> call-group map ---------------------
         # Serial retry attempts of ONE call get an extra shared normal on
@@ -889,35 +966,92 @@ class Simulator:
                 delay_r, connections,
             )
             if refine:
-                w = np.full(len(visits), 1.0 / self._mu)
+                # Little-law closure: find the cycle c* with E(c*) = c*
+                # where E(c) is the engine's own composed mean latency
+                # under tables built at cycle c.  The map's contraction
+                # factor is ~0.9 (nearly marginal), so the old damped
+                # iteration amplified pilot noise ~10x and "converged"
+                # wherever the RNG stream pushed it (measured: a 0.3%
+                # pilot perturbation moved throughput 5%, flipping the
+                # r4 quantile calibration).  Instead: sample E at a
+                # spread of cycles around the decomposition estimate,
+                # fit the locally-linear map E(c) ~ a + b c by least
+                # squares, and solve c* = a / (1 - b) — one regression
+                # is robust to pilot noise where a marginal iteration
+                # is not.
                 pilot = self._sat_pilot(connections)
                 key = jax.random.PRNGKey(20_260_730)
-                for it in range(12):
+
+                def census_at(c):
+                    # the repairman sweep is itself a per-station fixed
+                    # point in w; iterate it to convergence at cycle c
+                    pi_c = pi
+                    w_c = np.full(len(visits), 1.0 / self._mu)
+                    for _ in range(4):
+                        pi_c, w_c = closed.repairman_marginals(
+                            visits, reps, self._mu, c, w_c, connections
+                        )
+                    return pi_c
+
+                def sigma_of(pi_c):
+                    jj = np.arange(pi_c.shape[1], dtype=np.float64)
+                    m1 = (pi_c * jj).sum(axis=1)
+                    v1 = (pi_c * jj**2).sum(axis=1) - m1**2
+                    return np.sqrt(np.maximum(v1, 0.0))
+
+                c0 = cycle
+                cs, es = [], []
+                for it, f in enumerate(
+                    (0.85, 0.925, 1.0, 1.075, 1.15)
+                ):
+                    c = c0 * f
+                    pi_c = census_at(c)
                     p0, coef, _ = closed.tables_from_pi(
-                        pi, reps, self._mu, scv=self._svc_scv
+                        pi_c, reps, self._mu, scv=self._svc_scv
+                    )
+                    e_c, cc, sc = self._center_terms(
+                        sigma_of(pi_c), None, hs
                     )
                     e = float(
                         pilot(
                             jax.random.fold_in(key, it),
-                            jnp.float32(cycle / connections),
+                            jnp.float32(c / connections),
                             jnp.asarray(p0[hs], jnp.float32),
                             jnp.asarray(coef[:, hs], jnp.float32),
+                            jnp.asarray(e_c, jnp.float32),
+                            jnp.float32(cc),
+                            jnp.asarray(sc, jnp.float32),
                         )
                     )
-                    new_cycle = 0.5 * cycle + 0.5 * e
-                    done = abs(new_cycle - cycle) < 2e-3 * cycle
-                    cycle = new_cycle
-                    pi, w = closed.repairman_marginals(
-                        visits, reps, self._mu, cycle, w, connections
-                    )
-                    if done:
-                        break
+                    cs.append(c)
+                    es.append(e)
+                b, a = np.polyfit(np.asarray(cs), np.asarray(es), 1)
+                if b < 0.98:  # sane slope: solve the linear map
+                    cycle = float(a / (1.0 - b))
+                    # clamp to the sampled neighborhood: the linear
+                    # model is local
+                    cycle = float(np.clip(cycle, 0.7 * c0, 1.6 * c0))
+                else:  # degenerate fit: keep the decomposition value
+                    cycle = c0
+                pi = census_at(cycle)
             p0, coef, _ = closed.tables_from_pi(
                 pi, reps, self._mu, scv=self._svc_scv
             )
             throughput = connections / cycle
-            sigma = None
-            var_d = 0.0
+            # Partial population centering for fork-join: the exact
+            # census variance identity (chains) does not survive forks,
+            # but the physical constraint — at -qps max the total
+            # in-system population is pinned at C, so station censuses
+            # are negatively correlated — still holds.  var_d = None
+            # tells the shared tail below to use the EMPIRICAL target
+            # alpha * sum(sigma_h^2) with alpha = 0.25, fit against
+            # the DES oracle on tree13/star9 (ORACLE.md r5: p99
+            # +7.7%/+3.8% -> +2.9%/-1.7% at unchanged p50).
+            jj = np.arange(pi.shape[1], dtype=np.float64)
+            mean_j = (pi * jj).sum(axis=1)
+            var_j = (pi * jj**2).sum(axis=1) - mean_j**2
+            sigma = np.sqrt(np.maximum(var_j, 0.0))
+            var_d = None
         else:
             tabs = closed.closed_network_tables(
                 visits, cycle_visits_r, reps, self._mu,
@@ -927,11 +1061,20 @@ class Simulator:
             throughput = tabs.throughput
             sigma, var_d = tabs.sigma, tabs.var_delay
         p0_h = p0[hs]
-        # population copula: linearize j_s ~ mean + sigma_s * z_s;
-        # the census constraint sum_s j_s + j_d = C-1 means the
-        # sigma-weighted z-combination must carry Var(j_delay), not
-        # the independent sum Sigma sigma^2 — shrink its projection:
-        # z' = (z - c * e * (e . z)) / norm, c = 1 - sqrt(Vd/Ss^2).
+        e_h, c_center, scale_h = self._center_terms(sigma, var_d, hs)
+        return (throughput, p0_h, coef[:, hs], e_h, c_center, scale_h)
+
+    @staticmethod
+    def _center_terms(sigma, var_d, hs):
+        """Population-copula centering terms from census sigmas.
+
+        Linearize j_s ~ mean + sigma_s * z_s; the census constraint
+        sum_s j_s + j_d = C-1 means the sigma-weighted z-combination
+        must carry Var(j_delay), not the independent sum Sigma sigma^2
+        — shrink its projection: z' = (z - c * e * (e . z)) / norm,
+        c = 1 - sqrt(Vd / Ss^2).  ``var_d=None`` selects the fork-join
+        empirical target 0.25 * Ss^2 (see _closed_row).
+        """
         c_center = 0.0
         e_h = np.zeros(len(hs))
         scale_h = np.ones(len(hs))
@@ -942,30 +1085,42 @@ class Simulator:
             n_hops_s = np.bincount(hs, minlength=len(sigma))
             sig_h = sigma[hs] / np.maximum(n_hops_s[hs], 1)
             ss = float((sig_h**2).sum())
+            if var_d is None:
+                var_d = 0.25 * ss
             if ss > 1e-18 and var_d < ss:
                 c_center = 1.0 - float(np.sqrt(max(var_d, 0.0) / ss))
                 e_h = sig_h / np.sqrt(ss)
                 shrink = (2 * c_center - c_center**2) * e_h**2
                 scale_h = 1.0 / np.sqrt(1.0 - shrink)
-        return (throughput, p0_h, coef[:, hs], e_h, c_center, scale_h)
+        return e_h, c_center, scale_h
 
-    def _sat_pilot(self, connections: int, n: int = 8192):
+    def _sat_pilot(self, connections: int, n: int = 32_768):
         """Jitted mean-latency probe for the fork-join fixed point: the
         quantile tables are ARGUMENTS (not baked constants) so the one
-        compilation serves every iteration."""
+        compilation serves every iteration.  The probe averages two
+        independent key streams at 32k requests — the cycle fixed
+        point amplifies probe noise (a ~0.3% mean perturbation was
+        measured to move the converged throughput by 5% between RNG
+        streams), so the estimator must be tight for the iteration to
+        land in the same basin regardless of upstream RNG layout."""
         if connections not in self._sat_pilot_fns:
             c = max(connections, 1)
 
-            def fn(key, nominal_gap, p0_h, coef_h):
-                res, _, _ = self._simulate_core(
-                    n, CLOSED_LOOP, connections, key,
-                    jnp.float32(1.0), jnp.float32(0.0), jnp.float32(1.0),
-                    nominal_gap, jnp.float32(0.0),
-                    jnp.zeros((c,), jnp.float32), jnp.float32(0.0),
-                    sat_conns=connections,
-                    sat_override=(p0_h, coef_h),
-                )
-                return res.client_latency.mean()
+            def fn(key, nominal_gap, p0_h, coef_h, e_h, c_ctr, scale_h):
+                means = []
+                for i in range(2):
+                    res, _, _ = self._simulate_core(
+                        n, CLOSED_LOOP, connections,
+                        jax.random.fold_in(key, i),
+                        jnp.float32(1.0), jnp.float32(0.0),
+                        jnp.float32(1.0),
+                        nominal_gap, jnp.float32(0.0),
+                        jnp.zeros((c,), jnp.float32), jnp.float32(0.0),
+                        sat_conns=connections,
+                        sat_override=(p0_h, coef_h, e_h, c_ctr, scale_h),
+                    )
+                    means.append(res.client_latency.mean())
+                return (means[0] + means[1]) / 2.0
 
             self._sat_pilot_fns[connections] = jax.jit(fn)
         return self._sat_pilot_fns[connections]
@@ -1456,9 +1611,29 @@ class Simulator:
             z_wait = 0.0
             w_own_sq = 1.0 - r
             if self._copula_active:
-                z_small = jax.random.normal(
-                    k_wait2, (n, self._num_sib_groups)
+                # the saturated path skips the hierarchical mix, so it
+                # draws the flat (n, G) tensor — not the (n, F) factor
+                # space whose extra columns it would discard
+                dim = (
+                    self._copula_dim
+                    if self._copula_mix is not None and not sat_conns
+                    else self._num_sib_groups
                 )
+                z_small = jax.random.normal(k_wait2, (n, dim))
+                if self._copula_mix is not None and not sat_conns:
+                    # hierarchical mix: Z = z @ mix.T gives each group
+                    # its ancestor-factor combination (unit variance,
+                    # same-depth cousin corr r * gamma^L, zero across
+                    # depths) — G x F is tiny, one matmul.  OPEN LOOP
+                    # ONLY: the saturated sampler's composition
+                    # (population centering + repairman join) was
+                    # calibrated with the flat copula, and the mix
+                    # collapses its join median (measured tree13 -qps
+                    # max p50 -3.7% -> -11.6% at gamma=0.8)
+                    z_small = jnp.matmul(
+                        z_small, self._copula_mix.T,
+                        precision=jax.lax.Precision.HIGHEST,
+                    )
                 z_wait = z_wait + np.sqrt(r) * z_small[:, self._sib_group]
             if self._retry_active:
                 z_call = jax.random.normal(
@@ -1666,10 +1841,15 @@ class Simulator:
                 return w
 
             if sat_override is not None:
-                # fixed-point pilot: tables are traced arguments, no
-                # population centering (fork-join graphs have none)
-                p0_h, coef_h = sat_override
+                # fixed-point pilot: tables AND centering are traced
+                # arguments — the pilot must sample exactly the
+                # composition the final tables deliver (a pilot without
+                # the partial population centering solves a cycle the
+                # delivered mean then misses; measured star9 thr +7%)
+                p0_h, coef_h, e_o, c_o, scale_o = sat_override
                 z = z_wait
+                zproj = (z * e_o).sum(-1, keepdims=True)
+                z = (z - c_o * e_o * zproj) * scale_o
                 eval_poly = partial(_horner, coef_h=coef_h)
             elif num_phases == 1:
                 (_, p0_R, coef_R, e_R, c_R,
