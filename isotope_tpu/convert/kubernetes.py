@@ -86,7 +86,7 @@ def service_graph_to_manifests(
     if opts.cluster is None or opts.cluster == entry_cluster:
         manifests.extend(_fortio_client(opts))
     if opts.environment_name == "ISTIO":
-        manifests.extend(_rbac_policies(graph))
+        manifests.extend(_rbac_policies(graph, opts.cluster))
     return manifests
 
 
@@ -265,9 +265,14 @@ def _fortio_client(opts: ConvertOptions) -> List[dict]:
     return [deployment, service]
 
 
-def _rbac_policies(graph: ServiceGraph) -> List[dict]:
+def _rbac_policies(
+    graph: ServiceGraph, cluster: Optional[str] = None
+) -> List[dict]:
     # rbac.go:25-71 + kubernetes.go:107-133: per-service ServiceRole +
     # ServiceRoleBinding fan-out, plus an allow-all role and RbacConfig.
+    # ``cluster`` mirrors the Deployment/Service filter: a per-context
+    # apply (the reference's common.sh:36-42 flow) must only carry
+    # policies for the workloads that live in that cluster.
     manifests: List[dict] = [
         {
             "apiVersion": "rbac.istio.io/v1alpha1",
@@ -280,6 +285,8 @@ def _rbac_policies(graph: ServiceGraph) -> List[dict]:
         }
     ]
     for svc in graph.services:
+        if cluster is not None and getattr(svc, "cluster", "") != cluster:
+            continue
         for i in range(svc.num_rbac_policies):
             role_name = f"{svc.name}-role-{i}"
             manifests.append(

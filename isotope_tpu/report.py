@@ -19,8 +19,10 @@ from __future__ import annotations
 import html
 import json
 import math
+import os
 import pathlib
 import re
+import urllib.parse
 from typing import Dict, List, Optional, Sequence, Tuple
 
 LATENCY_METRICS = ("p50", "p75", "p90", "p99", "p999")
@@ -540,9 +542,40 @@ HISTORY_METRICS = (
 )
 
 
+def artifact_listing(root) -> List[Tuple[str, int]]:
+    """Relative (path, bytes) of every artifact in a publish/sweep tree
+    — the reference dashboard's raw-artifact browsing
+    (perf_dashboard/artifacts/ views backed by
+    helpers/download.py:27-66, which lists and fetches each publish's
+    raw files from the bucket)."""
+    root = pathlib.Path(root)
+    return [
+        (str(p.relative_to(root)), p.stat().st_size)
+        for p in sorted(root.rglob("*"))
+        if p.is_file()
+    ]
+
+
+def _artifact_section(label: str, root, link_prefix: str = "") -> str:
+    files = artifact_listing(root)
+    items = "".join(
+        # quote() first (URL metacharacters like '#'/'?' in filenames),
+        # html.escape() second (the URL goes into an attribute)
+        f'<li><a href="'
+        f'{html.escape(urllib.parse.quote(link_prefix + rel))}">'
+        f"{html.escape(rel)}</a> <small>{size:,} B</small></li>"
+        for rel, size in files
+    )
+    return (
+        f"<details><summary><code>{html.escape(label)}</code> — "
+        f"{len(files)} artifacts</summary><ul>{items}</ul></details>"
+    )
+
+
 def build_history_report(
     history: Sequence[Tuple[str, List[dict]]],
     title: str = "isotope-tpu history",
+    artifact_sections: Sequence[str] = (),
 ) -> str:
     """Metric-over-publish-id time series — the reference dashboard's
     day-over-day regression view (perf_dashboard/helpers/download.py:
@@ -628,6 +661,9 @@ def build_history_report(
             doc.append(_regression_table(joined))
         else:
             doc.append("<p>No runs with matching labels.</p>")
+    if artifact_sections:
+        doc.append("<h2>Artifacts</h2>")
+        doc.extend(artifact_sections)
     doc.append("</body></html>")
     return "".join(doc)
 
@@ -637,10 +673,32 @@ def write_history_report(
     lineage: Optional[str] = None,
 ) -> int:
     """Render a metric-over-time page from a directory of publish
-    trees; returns the number of publishes included."""
+    trees; returns the number of publishes included.  Each publish gets
+    a collapsible raw-artifact browser with links relative to the
+    report's location (the reference dashboard's per-publish artifact
+    view)."""
     history = load_history(root, lineage=lineage)
+    root_p = pathlib.Path(root)
+    out_p = pathlib.Path(out_path)
+    # links are resolved by the browser relative to the report file,
+    # so the prefix must be root relative to the report's directory
+    # (os.path.relpath walks .. when the report lives inside root)
+    prefix_base = os.path.relpath(
+        root_p.resolve(), out_p.resolve().parent
+    )
+    sections = [
+        _artifact_section(
+            pid, root_p / pid,
+            link_prefix=f"{pid}/" if prefix_base == "."
+            else f"{prefix_base}/{pid}/",
+        )
+        for pid, _ in history
+        if (root_p / pid).is_dir()
+    ]
     doc = build_history_report(
-        history, title or f"isotope-tpu history — {pathlib.Path(root).name}"
+        history,
+        title or f"isotope-tpu history — {pathlib.Path(root).name}",
+        artifact_sections=sections,
     )
     pathlib.Path(out_path).write_text(doc)
     return len(history)

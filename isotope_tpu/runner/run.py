@@ -205,6 +205,13 @@ def run_experiment(
     # before hours of simulation, not after
     exporters = []
     if export:
+        if out_dir is None:
+            # exporters write datafiles under the output directory;
+            # without one they'd be silently dropped at the end
+            raise ValueError(
+                "export specs require out_dir (exporters write their "
+                "datafiles under the run's output directory)"
+            )
         from isotope_tpu.metrics.export import resolve_exporter
 
         exporters = [resolve_exporter(s) for s in export]

@@ -131,6 +131,7 @@ class RetryFeedback:
         svc_down_pc: np.ndarray,       # (PC, S) bool
         own_combo: np.ndarray,         # (Cc, H) churn-combo hop multipliers
         static_visits_pc: np.ndarray,  # (PC, S)
+        mtls=None,                     # Optional[MtlsSchedule]
     ):
         self.compiled = compiled
         self.params = params
@@ -145,6 +146,18 @@ class RetryFeedback:
         self._err = t.error_rate.astype(np.float64)
         hs = compiled.hop_service
         net_out, net_back = hop_wire_times(compiled, params.network)
+        if mtls is not None:
+            # the engine taxes every attempt round trip by 2x the
+            # phase's mTLS tax before the timeout comparison; the
+            # feedback's P(timeout) must see the same inflation or it
+            # under-counts retry load during taxed phases (ADVICE r4).
+            # The fixed point is per-(chaos x churn) phase, not
+            # per-mTLS phase, so fold the schedule's TIME-AVERAGED tax
+            # (phases are equal-length); the residual phase-to-phase
+            # wobble is documented in ORACLE.md.
+            avg_tax = float(np.mean(mtls.taxes_s))
+            net_out = net_out + avg_tax
+            net_back = net_back + avg_tax
 
         self.active = False
         self._levels: List[_LevelCalls] = []

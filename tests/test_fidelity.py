@@ -1,0 +1,90 @@
+"""The real-Fortio ground-truth diff tool (isotope-tpu fidelity).
+
+The vendored artifact ``tests/data/fortio_canonical_sample.json`` is a
+stand-in ground truth: a full ``fortio load -json``-schema result for
+the canonical topology (closed loop, 16 workers, 1000 qps, ~240 s),
+generated once from the engine under a DIFFERENT seed and frozen.  The
+tool must ingest the artifact schema (the one
+perf/benchmark/runner/fortio.py:38-75 flattens), reconstruct the load,
+and report per-percentile deltas — passing on matching ground truth
+and failing on perturbed ground truth.  When real cluster artifacts
+exist, the same command is the evidence path for the north star's
+"p99 within 5%" clause.
+"""
+import copy
+import json
+import pathlib
+
+import pytest
+
+from isotope_tpu.metrics.fidelity import check_fidelity, load_from_artifact
+
+DATA = pathlib.Path(__file__).parent / "data"
+TOPO = (
+    pathlib.Path(__file__).parent.parent
+    / "examples/topologies/canonical.yaml"
+)
+
+
+@pytest.fixture(scope="module")
+def artifact():
+    with open(DATA / "fortio_canonical_sample.json") as f:
+        return json.load(f)
+
+
+def test_load_reconstruction(artifact):
+    load, duration_s = load_from_artifact(artifact)
+    assert load.kind == "closed"
+    assert load.connections == 16
+    assert load.qps == pytest.approx(1000.0)
+    assert duration_s == pytest.approx(262.1, rel=0.01)
+
+
+def test_load_reconstruction_qps_max(artifact):
+    doc = dict(artifact, RequestedQPS="max")
+    load, _ = load_from_artifact(doc)
+    assert load.kind == "closed" and load.qps is None
+    assert load.connections == 16
+
+
+def test_fidelity_passes_on_matching_ground_truth(artifact):
+    report = check_fidelity(
+        artifact, TOPO.read_text(), tolerance=0.05,
+        max_requests=240_000, seed=7,
+    )
+    assert report.deltas, "artifact percentiles must be compared"
+    assert {d.percentile for d in report.deltas} == {
+        50, 75, 90, 99, 99.9,
+    }
+    for d in report.deltas:
+        assert abs(d.rel_err) <= 0.05, (
+            f"p{d.percentile}: {d.rel_err:+.2%}"
+        )
+    assert report.ok
+    assert report.actual_qps_sim == pytest.approx(
+        report.actual_qps_fortio, rel=0.05
+    )
+    # the human-readable report renders one line per percentile + 2
+    assert len(report.lines()) == len(report.deltas) + 3
+
+
+def test_fidelity_fails_on_perturbed_ground_truth(artifact):
+    doc = copy.deepcopy(artifact)
+    for p in doc["DurationHistogram"]["Percentiles"]:
+        if p["Percentile"] == 99:
+            p["Value"] *= 1.25
+    report = check_fidelity(
+        doc, TOPO.read_text(), tolerance=0.05,
+        max_requests=240_000, seed=7,
+    )
+    assert not report.ok
+    bad = [d for d in report.deltas if d.percentile == 99][0]
+    assert bad.rel_err < -0.05
+
+
+def test_cli_subcommand_registered():
+    from isotope_tpu.cli import main
+
+    with pytest.raises(SystemExit) as exc:
+        main(["fidelity", "--help"])
+    assert exc.value.code == 0

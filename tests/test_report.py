@@ -190,6 +190,26 @@ def test_history_report_over_publishes(tmp_path):
     assert "regress" in doc
 
 
+def test_history_artifact_browser(tmp_path):
+    # the reference dashboard also browses each publish's RAW
+    # artifacts (perf_dashboard/artifacts/, helpers/download.py:27-66)
+    # — the history report embeds a per-publish artifact listing with
+    # links relative to the report's location
+    from isotope_tpu.report import artifact_listing, write_history_report
+
+    fake_publish(tmp_path, "20260730_sim_master_dev", 2500)
+    files = artifact_listing(tmp_path / "pub" / "20260730_sim_master_dev")
+    rels = [rel for rel, _ in files]
+    assert any(r.endswith("results.jsonl") for r in rels)
+
+    out = tmp_path / "history.html"
+    write_history_report(tmp_path / "pub", out)
+    doc = out.read_text()
+    assert "<h2>Artifacts</h2>" in doc
+    assert 'href="pub/20260730_sim_master_dev/' in doc
+    assert "results.jsonl" in doc
+
+
 def test_history_cli(tmp_path, capsys):
     fake_publish(tmp_path, "20260730_sim_master_dev", 2500)
     out = tmp_path / "h.html"

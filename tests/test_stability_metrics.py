@@ -1,0 +1,110 @@
+"""The shared stability-scenario metric contract.
+
+Reference surface: perf/docker/prom_client.py:1-40 — every background
+stability scenario (redis/rabbitmq/mysql clients, http10, bouncer)
+reports ``stability_outgoing_requests_total{source, destination,
+succeeded}`` plus a ``stability_test_instances{test}`` gauge, and the
+alarm layer asserts on those series.  These tests pin the emitted
+exposition, its queryability through the PromQL layer, the alarm
+integration (including the running-query gate), and the
+bounce-schedule coupling.
+"""
+import pytest
+
+from isotope_tpu.metrics.alarms import run_queries
+from isotope_tpu.metrics.query import MetricStore
+from isotope_tpu.metrics.stability import (
+    StabilityScenario,
+    scenario_from_bounce,
+    stability_queries,
+    stability_text,
+)
+
+
+def test_counts_all_succeed():
+    sc = StabilityScenario(name="redis", destination="redis-master",
+                           period_s=1.0, success_prob=1.0)
+    ok, fail = sc.counts(60.0)
+    assert ok == 60 and fail == 0
+
+
+def test_counts_failure_window():
+    sc = StabilityScenario(
+        name="http10", destination="httpbin", period_s=1.0,
+        success_prob=1.0, fail_windows=((10.0, 20.0),),
+    )
+    ok, fail = sc.counts(60.0)
+    assert fail == 10 and ok == 50
+
+
+def test_counts_success_prob_binomial():
+    sc = StabilityScenario(name="rabbitmq", destination="rabbitmq",
+                           period_s=0.1, success_prob=0.7)
+    ok, fail = sc.counts(600.0, seed=1)
+    assert ok + fail == 6000
+    assert 0.65 < ok / 6000 < 0.75
+
+
+def test_exposition_schema():
+    text = stability_text(
+        [StabilityScenario(name="redis", destination="redis-master")],
+        30.0,
+    )
+    assert "# TYPE stability_outgoing_requests_total counter" in text
+    assert (
+        'stability_outgoing_requests_total{source="redis",'
+        'destination="redis-master",succeeded="True"} 30' in text
+    )
+    assert 'stability_test_instances{test="redis"} 1' in text
+
+
+def test_queryable_and_alarm_clean():
+    scenarios = [
+        StabilityScenario(name="redis", destination="redis-master"),
+        StabilityScenario(name="mysql", destination="mysql"),
+    ]
+    store = MetricStore.from_text(
+        stability_text(scenarios, 120.0), 120.0
+    )
+    assert store.query_value(
+        'sum(stability_outgoing_requests_total{succeeded="True"})'
+    ) == pytest.approx(240.0)
+    alarms = run_queries(
+        stability_queries(scenarios), store, log=lambda s: None
+    )
+    assert alarms == []
+
+
+def test_alarm_fires_on_failures():
+    sc = StabilityScenario(
+        name="http10", destination="httpbin",
+        fail_windows=((0.0, 30.0),),
+    )
+    store = MetricStore.from_text(stability_text([sc], 120.0), 120.0)
+    alarms = run_queries(
+        stability_queries([sc]), store, log=lambda s: None
+    )
+    assert alarms and "http10" in alarms[0]
+
+
+def test_running_query_gates_undeployed_scenario():
+    # the store only carries redis; the mysql check must be SKIPPED
+    # (running gauge absent), not fire a false alarm
+    redis = StabilityScenario(name="redis", destination="redis-master")
+    mysql = StabilityScenario(
+        name="mysql", destination="mysql", fail_windows=((0.0, 60.0),),
+    )
+    store = MetricStore.from_text(stability_text([redis], 120.0), 120.0)
+    alarms = run_queries(
+        stability_queries([redis, mysql]), store, log=lambda s: None
+    )
+    assert alarms == []
+
+
+def test_bounce_coupling():
+    sc = scenario_from_bounce(
+        "bouncer", "istio-ingressgateway",
+        bounce_schedule=[(5.0, 10.0), (20.0, 25.0)],
+    )
+    ok, fail = sc.counts(30.0)
+    assert fail == 10 and ok == 20
