@@ -12,8 +12,14 @@ DURATION ?= 120s
 test:
 	$(PY) -m pytest tests/ -x -q
 
+# bench prints the one-line JSON capture AND gates it against the
+# previous round's driver capture (>15% per-case regression fails).
+# No pipe: a bench.py crash must fail the target, not hand an empty
+# capture to the regression gate.
 bench:
-	$(PY) bench.py
+	$(PY) bench.py > .bench_capture.json
+	@cat .bench_capture.json
+	$(PY) tools/bench_regress.py .bench_capture.json
 
 examples:
 	$(PY) tools/gen_examples.py

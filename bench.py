@@ -1,7 +1,7 @@
 """Benchmark: simulated hop-events per second on one chip.
 
-Four workloads, all through the microbatched (lax.scan) summary path —
-HBM holds one request block, counters/histograms accumulate on device:
+Workloads, all through the microbatched (lax.scan) summary path — HBM
+holds one request block, counters/histograms accumulate on device:
 
 - ``tree121``   (headline): the ~120-service complete tree
   (BASELINE.json configs[1]) under open-loop load — every request
@@ -11,6 +11,13 @@ HBM holds one request block, counters/histograms accumulate on device:
 - ``realistic50``: a skewed Barabasi-Albert multitier topology with
   sequential calls — the unfavorable shape (long scripts, sparse hop
   execution).
+- ``svc10k`` / ``star10k``: the 10k-service realistic shapes.
+- ``svc10k_cfg3_10M``: BASELINE configs[3] AND the north-star census —
+  the 10k multitier graph with per-call ``timeout: 30s, retries: 2``
+  (models/generators.py with_call_policy) at an offered load whose
+  Little-law census lambda x E[W] exceeds 10M concurrent in-flight
+  requests (numReplicas 192 keeps every station stable at rho ~ 0.69).
+  The census evidence is reported as ``svc10k_cfg3_inflight``.
 - ``closed64``: the tree under 64-connection closed-loop load (Fortio's
   default mode) including the fixed-point rate solve.
 
@@ -18,10 +25,19 @@ Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", "extra"}.
 ``value`` is the headline tree121 rate; vs_baseline measures it against
 the north-star per-chip rate from BASELINE.json (1e9 hop-events/s on a
 v5e-8 => 1.25e8 per chip).
+
+Methodology (r5): each case reports the MEDIAN over >= 5 timed windows,
+with the relative spread (max - min) / median of the windows recorded
+as ``<case>_spread`` in extras.  r4's best-of-3 hid both the
+window-to-window variance of the tunneled chip (measured +-40% on
+svc1000) and a round-over-round doc drift; medians + spreads +
+tools/bench_regress.py (>15% per-case gate vs the previous round's
+driver capture) replace it.
 """
 from __future__ import annotations
 
 import json
+import statistics
 import time
 
 import jax
@@ -29,13 +45,15 @@ import jax
 NORTH_STAR_PER_CHIP = 1e9 / 8.0
 
 
-def _rate(sim, load, num_requests, block_size, *, warm=10, iters=5,
-          trials=3):
+def _rate(sim, load, num_requests, block_size, *, warm=3, iters=3,
+          trials=5):
     """Steady-state hop-events/s of run_summary on the current device.
 
-    Best of ``trials`` timed windows: the tunneled chip's first window
-    after a compile can run 3-4x below steady state, so a single window
-    under-reports by whatever warm-up it caught.
+    Returns (median, rel_spread) over ``trials`` timed windows of
+    ``iters`` runs each.  The tunneled chip's window-to-window variance
+    is large (+-40% observed on svc1000), so the median over >= 5
+    windows is the reported statistic and the spread is kept as
+    evidence instead of silently picking the best window.
     """
     key = jax.random.PRNGKey(0)
 
@@ -48,15 +66,17 @@ def _rate(sim, load, num_requests, block_size, *, warm=10, iters=5,
     for i in range(warm):
         s = once(jax.random.fold_in(key, 1000 + i))
     jax.block_until_ready(s.count)
-    best = 0.0
+    rates = []
     for trial in range(trials):
         t0 = time.perf_counter()
         for i in range(iters):
             s = once(jax.random.fold_in(key, trial * iters + i))
         jax.block_until_ready(s.count)
         dt = time.perf_counter() - t0
-        best = max(best, hops * iters / dt)
-    return best
+        rates.append(hops * iters / dt)
+    med = statistics.median(rates)
+    spread = (max(rates) - min(rates)) / med if med > 0 else 0.0
+    return med, spread
 
 
 def main() -> None:
@@ -64,7 +84,10 @@ def main() -> None:
 
     from __graft_entry__ import _flagship
     from isotope_tpu.compiler import compile_graph
-    from isotope_tpu.models.generators import realistic_topology
+    from isotope_tpu.models.generators import (
+        realistic_topology,
+        with_call_policy,
+    )
     from isotope_tpu.models.graph import ServiceGraph
     from isotope_tpu.sim.config import LoadModel
     from isotope_tpu.sim.engine import Simulator
@@ -77,17 +100,30 @@ def main() -> None:
     blocks = 4 if on_tpu else 2
     open_load = LoadModel(kind="open", qps=100_000.0)
 
-    tree = Simulator(_flagship())
-    tree121 = _rate(tree, open_load, blk * blocks, blk)
-
     extra = {}
+    spreads = {}
+
+    def case(name, sim, load, n, bs, **kw):
+        med, spread = _rate(sim, load, n, bs, **kw)
+        extra[name] = med
+        spreads[name] = spread
+        return med
+
+    tree = Simulator(_flagship())
+    tree121 = case("tree121", tree, open_load, blk * blocks, blk,
+                   trials=5)
+
     if on_tpu:
         with open("examples/topologies/1000-svc_2000-end.yaml") as f:
             doc = yaml.safe_load(f)
         svc1000 = Simulator(compile_graph(ServiceGraph.decode(doc)))
-        extra["svc1000"] = _rate(
-            svc1000, LoadModel(kind="open", qps=10_000.0), 65_536, 16_384
-        )
+        # r4 ran 65_536 requests; the r5 block sweep showed per-window
+        # rates 2x noisier at that size — 262_144 requests amortize the
+        # tunnel's dispatch overhead (r2-code-vs-r5-code probes under
+        # one harness agree within noise, so the r2->r4 "slide" was
+        # this measurement, not the engine)
+        case("svc1000", svc1000, LoadModel(kind="open", qps=10_000.0),
+             262_144, 32_768)
 
         real = Simulator(
             compile_graph(
@@ -97,7 +133,7 @@ def main() -> None:
             )
         )
         blk_real = real.default_block_size()
-        extra["realistic50"] = _rate(real, open_load, blk_real * 4, blk_real)
+        case("realistic50", real, open_load, blk_real * 4, blk_real)
 
         # BASELINE configs[3]: 10k services, realistic shape (deep
         # sequential scripts — the unfavorable geometry)
@@ -111,10 +147,8 @@ def main() -> None:
             )
         )
         blk10k = svc10k.default_block_size()
-        extra["svc10k"] = _rate(
-            svc10k, LoadModel(kind="open", qps=1000.0),
-            blk10k * 4, blk10k, warm=3, iters=3,
-        )
+        case("svc10k", svc10k, LoadModel(kind="open", qps=1000.0),
+             blk10k * 4, blk10k)
 
         # the star archetype's skewed hub level (one ~2,000-step
         # service among thousands of leaves) runs via the sparse
@@ -127,14 +161,73 @@ def main() -> None:
             )
         )
         blk_star = star10k.default_block_size()
-        extra["star10k"] = _rate(
-            star10k, LoadModel(kind="open", qps=1000.0),
-            blk_star * 4, blk_star, warm=3, iters=3,
+        case("star10k", star10k, LoadModel(kind="open", qps=1000.0),
+             blk_star * 4, blk_star)
+
+        # BASELINE configs[4]: 100k services + fault injection + heavy
+        # tails.  24 unrolled levels, block 335 (the hop axis dominates
+        # the element budget); a mid-run total outage exercises the
+        # phase tables and Pareto(2.5) the heavy-tail sampler.  r4's
+        # "~80M/chip" README figure was the old best-effort probe; with
+        # warm-up + medians this captures ~140M/chip (>= the 125M
+        # per-chip pro-rata bar).
+        from isotope_tpu.sim.config import ChaosEvent, SimParams
+
+        big = Simulator(
+            compile_graph(
+                ServiceGraph.decode(
+                    realistic_topology(
+                        100_000, archetype="multitier", seed=0
+                    )
+                )
+            ),
+            SimParams(service_time="pareto", service_time_param=2.5),
+            (ChaosEvent(service="mock-7", start_s=5.0, end_s=15.0,
+                        replicas_down=None),),
         )
+        blk_big = big.default_block_size()
+        case("svc100k_chaos", big, LoadModel(kind="open", qps=100.0),
+             blk_big * 2, blk_big)
+
+        # north-star census (BASELINE.json): configs[3] WITH the
+        # retries/timeouts policy, at an offered load holding >= 10M
+        # requests in flight (Little: lambda x E[W]).  1.78M qps over a
+        # ~5.8s critical path (probed: W=5.77s at 1.73M => 9.98M; the
+        # bump clears 1e7 with margin at rho ~ 0.71); numReplicas 192 keeps
+        # rho ~ 0.69 everywhere so the census is a stable steady state.
+        # Timeouts go on EVERY call; retries go on the entry's direct
+        # calls — each retry attempt unrolls its whole subtree, so
+        # tree-wide retries would explode the static hop budget
+        # (3^depth copies); entry-level retries triple the graph to
+        # ~30k hops while still exercising the retry-feedback path.
+        doc3 = with_call_policy(
+            realistic_topology(
+                10_000, archetype="multitier", seed=0,
+                num_replicas=192,
+            ),
+            timeout="30s",
+        )
+        for cmd in doc3["services"][0].get("script", []):
+            if isinstance(cmd, dict) and "call" in cmd:
+                cmd["call"]["retries"] = 2
+        cfg3 = Simulator(compile_graph(ServiceGraph.decode(doc3)))
+        blk_cfg3 = cfg3.default_block_size()
+        load_cfg3 = LoadModel(kind="open", qps=1_780_000.0)
+        case("svc10k_cfg3_10M", cfg3, load_cfg3,
+             blk_cfg3 * 4, blk_cfg3)
+        s = cfg3.run_summary(
+            load_cfg3, blk_cfg3 * 4, jax.random.PRNGKey(42),
+            block_size=blk_cfg3,
+        )
+        jax.block_until_ready(s.count)
+        extra["svc10k_cfg3_inflight"] = load_cfg3.qps * s.mean_latency_s
 
         closed = LoadModel(kind="closed", qps=None, connections=64)
-        extra["closed64"] = _rate(tree, closed, blk * blocks, blk)
+        case("closed64", tree, closed, blk * blocks, blk)
 
+    extra_out = {k: round(v) for k, v in extra.items()}
+    for k, v in spreads.items():
+        extra_out[f"{k}_spread"] = round(v, 3)
     print(
         json.dumps(
             {
@@ -142,7 +235,7 @@ def main() -> None:
                 "value": tree121,
                 "unit": "hop-events/s",
                 "vs_baseline": tree121 / NORTH_STAR_PER_CHIP,
-                "extra": {k: round(v) for k, v in extra.items()},
+                "extra": extra_out,
             }
         )
     )
