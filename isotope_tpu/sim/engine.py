@@ -779,32 +779,60 @@ class Simulator:
         # gamma^(d/2) on (root, d); rows have unit norm, and two rows
         # share a factor iff the groups have equal depth (same l for a
         # common ancestor), giving exactly gamma^L.
+        #
+        # Only MULTI-MEMBER groups (real concurrent fan-outs / retry
+        # fans) join the hierarchy; singleton groups keep their flat
+        # independent factor.  A dense (G, F) matrix over every group
+        # captured 7.1 GB of constants on a 30k-hop sequential graph
+        # (G ~ 30k singleton groups x a ~19-deep factor space) — the
+        # active subset is (|A|, F) with |A| = the concurrent groups
+        # only, identical behavior on fork-join topologies where every
+        # group is concurrent.
         self._copula_mix = None
+        self._copula_rows = None
         self._copula_dim = len(gid)
         gamma = params.hierarchical_copula_gamma
-        if self._copula_active and gamma > 0.0 and len(gid) > 1:
+        sizes = np.bincount(group, minlength=len(gid))
+        active_groups = np.nonzero(sizes > 1)[0]
+        if (
+            self._copula_active
+            and gamma > 0.0
+            and len(gid) > 1
+            and len(active_groups)
+        ):
             G = len(gid)
+            # factor space: one base factor per group (columns [0, G)),
+            # plus one factor per distinct (ancestor, depth>=1) pair
+            # used by an active group's chain
             pair_idx: Dict[Tuple[int, int], int] = {}
-            rows = []  # (g, factor, coeff)
-            for g in range(G):
-                w, a, lev = 1.0, g, 0
+            rows = []  # (row-in-A, factor, coeff)
+            for i, g in enumerate(active_groups):
+                w, a, lev = 1.0, int(g), 0
                 while a != 0:
-                    key = (a, lev)
-                    if key not in pair_idx:
-                        pair_idx[key] = len(pair_idx)
-                    rows.append((g, pair_idx[key], np.sqrt(w * (1.0 - gamma))))
+                    if lev == 0:
+                        f = a  # own base factor
+                    else:
+                        key = (a, lev)
+                        if key not in pair_idx:
+                            pair_idx[key] = G + len(pair_idx)
+                        f = pair_idx[key]
+                    rows.append((i, f, np.sqrt(w * (1.0 - gamma))))
                     w *= gamma
                     a = gparent[a]
                     lev += 1
-                key = (0, lev)
-                if key not in pair_idx:
-                    pair_idx[key] = len(pair_idx)
-                rows.append((g, pair_idx[key], np.sqrt(w)))
-            F = len(pair_idx)
-            mix = np.zeros((G, F), np.float64)
-            for g, f, c in rows:
-                mix[g, f] = c
+                if lev == 0:
+                    rows.append((i, int(g), 1.0))  # the root group
+                else:
+                    key = (0, lev)
+                    if key not in pair_idx:
+                        pair_idx[key] = G + len(pair_idx)
+                    rows.append((i, pair_idx[key], np.sqrt(w)))
+            F = G + len(pair_idx)
+            mix = np.zeros((len(active_groups), F), np.float64)
+            for i, f, c in rows:
+                mix[i, f] = c
             self._copula_mix = jnp.asarray(mix, jnp.float32)
+            self._copula_rows = jnp.asarray(active_groups, jnp.int32)
             self._copula_dim = F
 
         # -- retry copula: static hop -> call-group map ---------------------
@@ -1621,20 +1649,28 @@ class Simulator:
                 )
                 z_small = jax.random.normal(k_wait2, (n, dim))
                 if self._copula_mix is not None and not sat_conns:
-                    # hierarchical mix: Z = z @ mix.T gives each group
-                    # its ancestor-factor combination (unit variance,
+                    # hierarchical mix for the ACTIVE (concurrent)
+                    # groups only: Z_act = z @ mix.T combines each
+                    # group's ancestor factors (unit variance,
                     # same-depth cousin corr r * gamma^L, zero across
-                    # depths) — G x F is tiny, one matmul.  OPEN LOOP
-                    # ONLY: the saturated sampler's composition
-                    # (population centering + repairman join) was
-                    # calibrated with the flat copula, and the mix
-                    # collapses its join median (measured tree13 -qps
-                    # max p50 -3.7% -> -11.6% at gamma=0.8)
-                    z_small = jnp.matmul(
+                    # depths); singleton groups keep their base
+                    # column.  OPEN LOOP ONLY: the saturated sampler's
+                    # composition (population centering + repairman
+                    # join) was calibrated with the flat copula, and
+                    # the mix collapses its join median (measured
+                    # tree13 -qps max p50 -3.7% -> -11.6% at gamma=0.8)
+                    z_act = jnp.matmul(
                         z_small, self._copula_mix.T,
                         precision=jax.lax.Precision.HIGHEST,
                     )
-                z_wait = z_wait + np.sqrt(r) * z_small[:, self._sib_group]
+                    z_groups = (
+                        z_small[:, : self._num_sib_groups]
+                        .at[:, self._copula_rows]
+                        .set(z_act)
+                    )
+                else:
+                    z_groups = z_small[:, : self._num_sib_groups]
+                z_wait = z_wait + np.sqrt(r) * z_groups[:, self._sib_group]
             if self._retry_active:
                 z_call = jax.random.normal(
                     k_wait3, (n, self._num_retry_groups + 1)

@@ -24,7 +24,13 @@ import os
 import re
 import sys
 
-THRESHOLD = 0.15  # fail when new < (1 - THRESHOLD) * old
+# Fail when new < (1 - THRESHOLD) * old.  NOTE the instrument: the
+# tunneled chip drifts by up to ~2x across sessions (interleaved
+# A/B of r4-vs-r5 binaries measured both orderings within minutes),
+# so the default gate is meaningful for SAME-SESSION comparisons
+# (pre/post an optimization); across rounds, expect noise-fired
+# alarms and read them against the per-case ``_spread`` evidence.
+THRESHOLD = float(os.environ.get("BENCH_REGRESS_THRESHOLD", "0.15"))
 REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
@@ -51,14 +57,27 @@ def _load_capture(path: str) -> dict:
     return doc
 
 
-def _cases(doc: dict) -> dict:
+def _cases(doc: dict, prefer_best: bool = False) -> dict:
+    """Per-case rates from a capture.
+
+    ``prefer_best=True`` (applied to the NEW capture) compares the
+    best-window statistic against older rounds: captures before r5
+    reported best-of-3, so the r5 median would read as a spurious
+    across-methodology "regression" otherwise.
+    """
+    extra = doc.get("extra", {})
     cases = {"tree121": float(doc["value"])}
-    for k, v in doc.get("extra", {}).items():
+    for k, v in extra.items():
         if not isinstance(v, (int, float)):
             continue
-        if k.endswith(("_inflight", "_spread", "_census")):
+        if k.endswith(("_inflight", "_spread", "_census", "_best")):
             continue  # evidence / variance keys, not rates
         cases[k] = float(v)
+    if prefer_best:
+        for k in list(cases):
+            b = extra.get(f"{k}_best")
+            if isinstance(b, (int, float)):
+                cases[k] = float(b)
     return cases
 
 
@@ -77,13 +96,29 @@ def main() -> int:
     if len(sys.argv) != 2:
         print(__doc__)
         return 2
-    new = _cases(_load_capture(sys.argv[1]))
+    new_doc = _load_capture(sys.argv[1])
     prev_path, prev = previous_capture()
     if prev is None:
         print("bench_regress: no BENCH_r*.json baseline found — skipping")
         return 0
+    # like-for-like statistics: an r5+ baseline carries medians (and
+    # *_best evidence keys) — compare median vs median; a pre-r5
+    # baseline reported best-of-window, so compare the NEW capture's
+    # best against it (new-best-vs-old-median would mask a real median
+    # regression behind the +-40% window spread)
+    with open(prev_path) as f:
+        baseline_has_best = "_best" in f.read()
+    new = _cases(new_doc, prefer_best=not baseline_has_best)
+    new_extra = new_doc.get("extra", {})
     failures = []
     for case, old_rate in sorted(prev.items()):
+        if case in new_extra and new_extra[case] is None:
+            # the case crashed or timed out inside bench.py — a
+            # vanished case must fail the gate, not be skipped
+            print(f"bench_regress: {case}: FAILED in the new capture "
+                  f"(was {old_rate:.3g})")
+            failures.append(case)
+            continue
         if case not in new:
             print(f"bench_regress: {case}: dropped from capture "
                   f"(was {old_rate:.3g}) — not compared")
