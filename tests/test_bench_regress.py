@@ -1,0 +1,118 @@
+"""The per-case bench regression gate (tools/bench_regress.py).
+
+Locks the behaviors the r5 measurement-honesty work depends on:
+like-for-like statistic selection across methodology generations
+(pre-r5 captures reported best-of-window; r5+ report medians with
+``*_best`` evidence keys), failure on vanished (null) cases, and the
+pass/fail threshold itself.
+"""
+import importlib.util
+import json
+import pathlib
+import sys
+
+import pytest
+
+TOOL = pathlib.Path(__file__).parent.parent / "tools" / "bench_regress.py"
+spec = importlib.util.spec_from_file_location("bench_regress", TOOL)
+br = importlib.util.module_from_spec(spec)
+spec.loader.exec_module(br)
+
+
+def capture(value, extra):
+    return {"metric": "m", "value": value, "unit": "u",
+            "vs_baseline": 1.0, "extra": extra}
+
+
+def run_gate(tmp_path, monkeypatch, new_doc, baseline_doc, r=4):
+    (tmp_path / f"BENCH_r{r:02d}.json").write_text(
+        json.dumps({"parsed": baseline_doc})
+    )
+    new_path = tmp_path / "new.json"
+    new_path.write_text(json.dumps(new_doc))
+    monkeypatch.setattr(br, "REPO_ROOT", str(tmp_path))
+    # pin the threshold: br.THRESHOLD is baked from the ambient
+    # BENCH_REGRESS_THRESHOLD env var at import, and these tests'
+    # numeric expectations assume the 15% default
+    monkeypatch.setattr(br, "THRESHOLD", 0.15)
+    monkeypatch.setattr(sys, "argv", ["bench_regress", str(new_path)])
+    return br.main()
+
+
+def test_pass_within_threshold(tmp_path, monkeypatch, capsys):
+    base = capture(2.0e9, {"svc1000": 1.5e9})
+    new = capture(1.9e9, {"svc1000": 1.45e9})
+    assert run_gate(tmp_path, monkeypatch, new, base) == 0
+    assert "PASS" in capsys.readouterr().out
+
+
+def test_fail_beyond_threshold(tmp_path, monkeypatch, capsys):
+    base = capture(2.0e9, {"svc1000": 1.5e9})
+    new = capture(2.0e9, {"svc1000": 1.0e9})
+    assert run_gate(tmp_path, monkeypatch, new, base) == 1
+    assert "svc1000" in capsys.readouterr().out
+
+
+def test_best_vs_pre_r5_baseline(tmp_path, monkeypatch, capsys):
+    # pre-r5 baseline (no *_best keys) reported best-of-window: the
+    # new capture's BEST must be compared, not its median (a median
+    # 25% below an old best is methodology, not regression)
+    base = capture(2.0e9, {"svc1000": 2.0e9})
+    new = capture(
+        1.5e9,
+        {"svc1000": 1.5e9, "svc1000_spread": 0.4,
+         "svc1000_best": 1.9e9, "tree121_best": 1.9e9},
+    )
+    assert run_gate(tmp_path, monkeypatch, new, base) == 0
+    out = capsys.readouterr().out
+    assert "1.9e+09" in out  # compared the best, not the median
+
+
+def test_median_vs_r5_baseline(tmp_path, monkeypatch, capsys):
+    # an r5-style baseline (has *_best keys) stores medians: compare
+    # median vs median — new-best-vs-old-median would mask a real
+    # median regression behind the window spread
+    base = capture(
+        2.0e9, {"svc1000": 2.0e9, "svc1000_best": 2.6e9}
+    )
+    new = capture(
+        1.5e9,
+        {"svc1000": 1.5e9, "svc1000_best": 2.5e9,
+         "tree121_best": 2.5e9},
+    )
+    assert run_gate(tmp_path, monkeypatch, new, base) == 1
+    assert "svc1000" in capsys.readouterr().out
+
+
+def test_null_case_fails(tmp_path, monkeypatch, capsys):
+    # a case that crashed/timed out inside bench.py becomes null in
+    # the capture — the gate must FAIL, not skip it
+    base = capture(2.0e9, {"svc1000": 1.5e9})
+    new = capture(2.0e9, {"svc1000": None})
+    assert run_gate(tmp_path, monkeypatch, new, base) == 1
+    assert "FAILED in the new capture" in capsys.readouterr().out
+
+
+def test_evidence_keys_not_compared(tmp_path, monkeypatch, capsys):
+    base = capture(
+        2.0e9,
+        {"svc10k_cfg3_inflight": 1.0e7, "svc1000_spread": 0.3,
+         "svc1000": 2.0e9, "svc1000_best": 2.2e9},
+    )
+    new = capture(
+        2.0e9,
+        {"svc10k_cfg3_inflight": 5.0e6, "svc1000_spread": 0.9,
+         "svc1000": 2.0e9, "svc1000_best": 2.2e9},
+    )
+    # halved census / tripled spread are evidence, not rate cases
+    assert run_gate(tmp_path, monkeypatch, new, base) == 0
+
+
+def test_no_baseline_skips(tmp_path, monkeypatch, capsys):
+    new_path = tmp_path / "new.json"
+    new_path.write_text(json.dumps(capture(1.0e9, {})))
+    monkeypatch.setattr(br, "REPO_ROOT", str(tmp_path / "empty"))
+    (tmp_path / "empty").mkdir()
+    monkeypatch.setattr(sys, "argv", ["bench_regress", str(new_path)])
+    assert br.main() == 0
+    assert "skipping" in capsys.readouterr().out

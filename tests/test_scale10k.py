@@ -4,7 +4,10 @@ import jax
 import pytest
 
 from isotope_tpu.compiler import compile_graph
-from isotope_tpu.models.generators import realistic_topology
+from isotope_tpu.models.generators import (
+    realistic_topology,
+    with_call_policy,
+)
 from isotope_tpu.models.graph import ServiceGraph
 from isotope_tpu.sim import LoadModel, Simulator
 
@@ -35,6 +38,26 @@ def test_10k_simulates_through_scan_path(compiled10k):
     # client latency is thousands of network+service legs
     assert 1.0 < s.mean_latency_s < 30.0
     assert not bool(s.unstable.any())
+
+
+def test_star10k_with_timeouts_keeps_sparse_encoding():
+    # BASELINE configs[3] names retries/timeouts on the 10k graph; the
+    # star archetype's skewed hub level is exactly where the sparse
+    # call-slot encoding matters (a dense grid block-starves it), and
+    # until r5 finite timeouts forced the dense fallback.  Pin that
+    # the policy-carrying star-10k still lowers to sparse slots.
+    doc = with_call_policy(
+        realistic_topology(10_000, archetype="star", seed=0),
+        timeout="30s",
+    )
+    sim = Simulator(compile_graph(ServiceGraph.decode(doc)))
+    sparse_lvls = [
+        lvl for lvl in sim._levels if lvl.sparse is not None
+    ]
+    assert sparse_lvls, "the star hub level must stay sparse"
+    assert any(lvl.finite_timeout for lvl in sparse_lvls), (
+        "the sparse level itself carries the finite timeouts"
+    )
 
 
 def test_100k_generates_and_compiles_host_side():
