@@ -284,6 +284,7 @@ def main() -> None:
 
     extra: dict = {}
     for name in names:
+        proc = None
         try:
             proc = subprocess.run(
                 [sys.executable, __file__, "--case", name],
@@ -298,7 +299,7 @@ def main() -> None:
             # surface the child's actual error (the traceback / OOM
             # message lives in ITS stderr, not the parent exception)
             err = getattr(e, "stderr", None) or (
-                proc.stderr if "proc" in dir() else None
+                proc.stderr if proc is not None else None
             )
             for tail_line in (err or "").strip().splitlines()[-6:]:
                 print(f"bench:   {name}| {tail_line}", file=sys.stderr)

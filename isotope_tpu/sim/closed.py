@@ -418,6 +418,15 @@ def repairman_marginals(
     return pi_seen, w_new
 
 
+def census_sigma(pi: np.ndarray) -> np.ndarray:
+    """Per-station standard deviation of census distributions
+    ``pi[s, j]`` (rows are queue-length pmfs)."""
+    jj = np.arange(pi.shape[1], dtype=np.float64)
+    mean_j = (pi * jj).sum(axis=1)
+    var_j = (pi * jj**2).sum(axis=1) - mean_j**2
+    return np.sqrt(np.maximum(var_j, 0.0))
+
+
 def compress_census(pi_row: np.ndarray, scv: float) -> np.ndarray:
     """QNA-style census reshaping for non-exponential service.
 
@@ -575,9 +584,6 @@ def closed_network_tables(
 
     # population copula inputs: Var(sum_s j_s) = Var(j_delay) exactly —
     # the engine shrinks the sigma-weighted z-combination to this target
-    jj = np.arange(pi.shape[1], dtype=np.float64)
-    mean_j = (pi * jj).sum(axis=1)
-    var_j = (pi * jj**2).sum(axis=1) - mean_j**2
     jd = np.arange(len(pi_d), dtype=np.float64)
     var_d = float((pi_d * jd**2).sum() - ((pi_d * jd).sum()) ** 2)
     return ClosedTables(
@@ -585,6 +591,6 @@ def closed_network_tables(
         p_zero=p_zero,
         coef=coef,
         mean_wait=mean_wait,
-        sigma=np.sqrt(np.maximum(var_j, 0.0)),
+        sigma=census_sigma(pi),
         var_delay=var_d,
     )

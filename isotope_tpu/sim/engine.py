@@ -806,6 +806,8 @@ class Simulator:
             # used by an active group's chain
             pair_idx: Dict[Tuple[int, int], int] = {}
             rows = []  # (row-in-A, factor, coeff)
+            # active groups always sit below the root (group 0 holds
+            # only hop 0, size 1), so every chain walks >= 1 level
             for i, g in enumerate(active_groups):
                 w, a, lev = 1.0, int(g), 0
                 while a != 0:
@@ -820,13 +822,10 @@ class Simulator:
                     w *= gamma
                     a = gparent[a]
                     lev += 1
-                if lev == 0:
-                    rows.append((i, int(g), 1.0))  # the root group
-                else:
-                    key = (0, lev)
-                    if key not in pair_idx:
-                        pair_idx[key] = G + len(pair_idx)
-                    rows.append((i, pair_idx[key], np.sqrt(w)))
+                key = (0, lev)
+                if key not in pair_idx:
+                    pair_idx[key] = G + len(pair_idx)
+                rows.append((i, pair_idx[key], np.sqrt(w)))
             F = G + len(pair_idx)
             mix = np.zeros((len(active_groups), F), np.float64)
             for i, f, c in rows:
@@ -1021,12 +1020,6 @@ class Simulator:
                         )
                     return pi_c
 
-                def sigma_of(pi_c):
-                    jj = np.arange(pi_c.shape[1], dtype=np.float64)
-                    m1 = (pi_c * jj).sum(axis=1)
-                    v1 = (pi_c * jj**2).sum(axis=1) - m1**2
-                    return np.sqrt(np.maximum(v1, 0.0))
-
                 c0 = cycle
                 cs, es = [], []
                 for it, f in enumerate(
@@ -1038,7 +1031,7 @@ class Simulator:
                         pi_c, reps, self._mu, scv=self._svc_scv
                     )
                     e_c, cc, sc = self._center_terms(
-                        sigma_of(pi_c), None, hs
+                        closed.census_sigma(pi_c), None, hs
                     )
                     e = float(
                         pilot(
@@ -1075,10 +1068,7 @@ class Simulator:
             # alpha * sum(sigma_h^2) with alpha = 0.25, fit against
             # the DES oracle on tree13/star9 (ORACLE.md r5: p99
             # +7.7%/+3.8% -> +2.9%/-1.7% at unchanged p50).
-            jj = np.arange(pi.shape[1], dtype=np.float64)
-            mean_j = (pi * jj).sum(axis=1)
-            var_j = (pi * jj**2).sum(axis=1) - mean_j**2
-            sigma = np.sqrt(np.maximum(var_j, 0.0))
+            sigma = closed.census_sigma(pi)
             var_d = None
         else:
             tabs = closed.closed_network_tables(

@@ -82,6 +82,7 @@ def _cases(doc: dict, prefer_best: bool = False) -> dict:
 
 
 def previous_capture() -> tuple:
+    """(path, parsed_doc) of the newest BENCH_r*.json, or (None, None)."""
     files = sorted(
         glob.glob(os.path.join(REPO_ROOT, "BENCH_r*.json")),
         key=lambda p: int(re.search(r"r(\d+)", p).group(1)),
@@ -89,7 +90,7 @@ def previous_capture() -> tuple:
     if not files:
         return None, None
     path = files[-1]
-    return path, _cases(_load_capture(path))
+    return path, _load_capture(path)
 
 
 def main() -> int:
@@ -97,17 +98,19 @@ def main() -> int:
         print(__doc__)
         return 2
     new_doc = _load_capture(sys.argv[1])
-    prev_path, prev = previous_capture()
-    if prev is None:
+    prev_path, prev_doc = previous_capture()
+    if prev_doc is None:
         print("bench_regress: no BENCH_r*.json baseline found — skipping")
         return 0
+    prev = _cases(prev_doc)
     # like-for-like statistics: an r5+ baseline carries medians (and
     # *_best evidence keys) — compare median vs median; a pre-r5
     # baseline reported best-of-window, so compare the NEW capture's
     # best against it (new-best-vs-old-median would mask a real median
     # regression behind the +-40% window spread)
-    with open(prev_path) as f:
-        baseline_has_best = "_best" in f.read()
+    baseline_has_best = any(
+        k.endswith("_best") for k in prev_doc.get("extra", {})
+    )
     new = _cases(new_doc, prefer_best=not baseline_has_best)
     new_extra = new_doc.get("extra", {})
     failures = []
