@@ -79,11 +79,15 @@ def _rate(sim, load, num_requests, block_size, *, warm=3, iters=3,
           trials=5):
     """Steady-state hop-events/s of run_summary on the current device.
 
-    Returns (median, rel_spread) over ``trials`` timed windows of
-    ``iters`` runs each.  The tunneled chip's window-to-window variance
-    is large (+-40% observed on svc1000), so the median over >= 5
-    windows is the reported statistic and the spread is kept as
-    evidence instead of silently picking the best window.
+    Returns (median, rel_spread, best, first_s) over ``trials`` timed
+    windows of ``iters`` runs each.  The tunneled chip's
+    window-to-window variance is large (+-40% observed on svc1000), so
+    the median over >= 5 windows is the reported statistic and the
+    spread is kept as evidence instead of silently picking the best
+    window.  ``first_s`` is the first-call wall time — trace + XLA
+    compile (+ the closed-loop rate solve where applicable) — the
+    compile-wall evidence the level-scan executor and the persistent
+    compilation cache exist to shrink.
     """
     import jax
 
@@ -92,8 +96,10 @@ def _rate(sim, load, num_requests, block_size, *, warm=3, iters=3,
     def once(k):
         return sim.run_summary(load, num_requests, k, block_size=block_size)
 
+    t0 = time.perf_counter()
     s = once(key)
     jax.block_until_ready(s.count)
+    first_s = time.perf_counter() - t0
     hops = float(s.hop_events)
     for i in range(warm):
         s = once(jax.random.fold_in(key, 1000 + i))
@@ -108,7 +114,7 @@ def _rate(sim, load, num_requests, block_size, *, warm=3, iters=3,
         rates.append(hops * iters / dt)
     med = statistics.median(rates)
     spread = (max(rates) - min(rates)) / med if med > 0 else 0.0
-    return med, spread, max(rates)
+    return med, spread, max(rates), first_s
 
 
 def run_case(name: str) -> dict:
@@ -121,6 +127,15 @@ def run_case(name: str) -> dict:
 
     from __graft_entry__ import _flagship
     from isotope_tpu.compiler import compile_graph
+    from isotope_tpu.compiler.cache import enable_persistent_cache
+
+    # persistent XLA cache across the per-case subprocesses (and across
+    # whole bench runs): repeated topology families skip the backend
+    # compile entirely.  Default on, repo-local; $ISOTOPE_COMPILE_CACHE
+    # overrides the directory (or disables with "off").
+    cache_dir = enable_persistent_cache(
+        os.environ.get("ISOTOPE_COMPILE_CACHE", ".xla-cache")
+    )
     from isotope_tpu.models.generators import (
         realistic_topology,
         with_call_policy,
@@ -137,10 +152,10 @@ def run_case(name: str) -> dict:
 
     if name == "tree121":
         sim = Simulator(_flagship())
-        med, spread, best = _rate(sim, open_load, blk * blocks, blk)
+        med, spread, best, first_s = _rate(sim, open_load, blk * blocks, blk)
     elif name == "closed64":
         sim = Simulator(_flagship())
-        med, spread, best = _rate(
+        med, spread, best, first_s = _rate(
             sim, LoadModel(kind="closed", qps=None, connections=64),
             blk * blocks, blk,
         )
@@ -152,7 +167,7 @@ def run_case(name: str) -> dict:
         # windows 2x noisier (r2-code-vs-r5-code probes under one
         # harness agree within noise, so the r2->r4 "slide" was this
         # measurement, not the engine)
-        med, spread, best = _rate(
+        med, spread, best, first_s = _rate(
             sim, LoadModel(kind="open", qps=10_000.0), 262_144, 32_768
         )
     elif name == "realistic50":
@@ -164,7 +179,7 @@ def run_case(name: str) -> dict:
             )
         )
         b = sim.default_block_size()
-        med, spread, best = _rate(sim, open_load, b * 4, b)
+        med, spread, best, first_s = _rate(sim, open_load, b * 4, b)
     elif name == "svc10k":
         sim = Simulator(
             compile_graph(
@@ -175,7 +190,7 @@ def run_case(name: str) -> dict:
             )
         )
         b = sim.default_block_size()
-        med, spread, best = _rate(
+        med, spread, best, first_s = _rate(
             sim, LoadModel(kind="open", qps=1000.0), b * 4, b
         )
     elif name == "star10k":
@@ -189,7 +204,7 @@ def run_case(name: str) -> dict:
             )
         )
         b = sim.default_block_size()
-        med, spread, best = _rate(
+        med, spread, best, first_s = _rate(
             sim, LoadModel(kind="open", qps=1000.0), b * 4, b
         )
     elif name == "svc100k_chaos":
@@ -208,7 +223,7 @@ def run_case(name: str) -> dict:
                         replicas_down=None),),
         )
         b = sim.default_block_size()
-        med, spread, best = _rate(
+        med, spread, best, first_s = _rate(
             sim, LoadModel(kind="open", qps=100.0), b * 2, b
         )
     elif name == "svc10k_cfg3_10M":
@@ -250,7 +265,7 @@ def run_case(name: str) -> dict:
         load3 = LoadModel(kind="open", qps=1_780_000.0)
         # fewer windows: the ~200s compile dominates this case's
         # budget and its measured spread is small
-        med, spread, best = _rate(sim, load3, b * 4, b, warm=2,
+        med, spread, best, first_s = _rate(sim, load3, b * 4, b, warm=2,
                                   iters=2, trials=5)
         s = sim.run_summary(
             load3, b * 4, jax.random.PRNGKey(42), block_size=b
@@ -263,6 +278,11 @@ def run_case(name: str) -> dict:
     out["median"] = med
     out["spread"] = spread
     out["best"] = best
+    # first-call wall time (trace + XLA compile): the compile-wall
+    # evidence for the bucketed level-scan executor / compile cache
+    out["compile_s"] = first_s
+    if cache_dir:
+        out["compile_cache"] = cache_dir
     return out
 
 
@@ -279,7 +299,17 @@ def main() -> None:
          "import jax; print(jax.devices()[0].platform)"],
         capture_output=True, text=True, timeout=300,
     )
-    on_tpu = probe.stdout.strip() != "cpu"
+    platform = probe.stdout.strip().splitlines()[-1] if probe.stdout.strip() \
+        else ""
+    if probe.returncode != 0 or not platform:
+        # a broken environment must fail fast, not masquerade as TPU
+        # and run 8 cases to their timeouts (ADVICE r5)
+        print(f"bench: platform probe failed (rc={probe.returncode}); "
+              "aborting", file=sys.stderr)
+        for tail_line in (probe.stderr or "").strip().splitlines()[-6:]:
+            print(f"bench:   probe| {tail_line}", file=sys.stderr)
+        sys.exit(1)
+    on_tpu = platform != "cpu"
     names = CASE_ORDER if on_tpu else ["tree121"]
 
     extra: dict = {}
@@ -311,11 +341,13 @@ def main() -> None:
         # (best-of-3); kept for cross-round comparability next to the
         # honest median
         extra[f"{name}_best"] = round(res["best"])
+        extra[f"{name}_compile_s"] = round(res.get("compile_s", 0.0), 2)
         for k, v in res.items():
-            if k not in ("median", "spread", "best"):
+            if k not in ("median", "spread", "best", "compile_s"):
                 extra[k] = v
         print(f"bench: {name}: {res['median'] / 1e9:.3f}B "
-              f"(spread {res['spread']:.0%})", file=sys.stderr)
+              f"(spread {res['spread']:.0%}, first-call "
+              f"{res.get('compile_s', 0.0):.1f}s)", file=sys.stderr)
 
     tree121 = extra.get("tree121") or 0.0
     extra_out = {

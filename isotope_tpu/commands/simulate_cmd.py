@@ -44,6 +44,10 @@ def register(sub) -> None:
     s.add_argument("--cpu-time", default=None,
                    help='per-request CPU demand, e.g. "77us"')
     s.add_argument("--seed", type=int, default=0)
+    s.add_argument("--compile-cache", metavar="DIR", default=None,
+                   help="persistent XLA compilation cache directory "
+                        "(default: $ISOTOPE_COMPILE_CACHE; repeated "
+                        "runs of one topology family skip XLA)")
     s.add_argument("--labels", default="")
     s.add_argument("--entry", default=None,
                    help="entrypoint service (for multi-instance "
@@ -90,6 +94,9 @@ def register(sub) -> None:
     w.add_argument("--fresh", action="store_true",
                    help="ignore an existing checkpoint and rerun "
                         "everything (default: resume a killed sweep)")
+    w.add_argument("--compile-cache", metavar="DIR", default=None,
+                   help="persistent XLA compilation cache directory "
+                        "(default: $ISOTOPE_COMPILE_CACHE)")
     w.add_argument("--profile", metavar="DIR",
                    help="capture a jax.profiler trace per run into "
                         "DIR/<label>/ (the reference's per-run flame "
@@ -130,6 +137,9 @@ def _require_jax() -> None:
 def run_simulate(args) -> int:
     # jax-dependent imports stay inside the handler so `--help` is instant
     _require_jax()
+    from isotope_tpu.compiler.cache import enable_persistent_cache
+
+    enable_persistent_cache(args.compile_cache)
     from isotope_tpu.runner.config import (
         DEFAULT_ENVIRONMENTS,
         ExperimentConfig,
@@ -281,6 +291,9 @@ def run_plot(args) -> int:
 
 def run_sweep(args) -> int:
     _require_jax()
+    from isotope_tpu.compiler.cache import enable_persistent_cache
+
+    enable_persistent_cache(args.compile_cache)
     from isotope_tpu.runner.config import load_toml
     from isotope_tpu.runner.run import run_experiment
 

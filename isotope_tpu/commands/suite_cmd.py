@@ -29,10 +29,17 @@ def register(sub) -> None:
                    help="alarm threshold, MiB")
     s.add_argument("--fresh", action="store_true",
                    help="ignore existing per-config checkpoints")
+    s.add_argument("--compile-cache", metavar="DIR", default=None,
+                   help="persistent XLA compilation cache directory "
+                        "(default: $ISOTOPE_COMPILE_CACHE); a suite "
+                        "re-run of the same topology set skips XLA")
     s.set_defaults(func=run_suite_cmd)
 
 
 def run_suite_cmd(args) -> int:
+    from isotope_tpu.compiler.cache import enable_persistent_cache
+
+    enable_persistent_cache(args.compile_cache)
     from isotope_tpu.runner.suite import run_suite
 
     result = run_suite(

@@ -14,6 +14,17 @@ from isotope_tpu.compiler.program import (
     HopLevel,
     ServiceTable,
 )
+from isotope_tpu.compiler.buckets import (
+    LevelShape,
+    ScanBucketPlan,
+    UnrolledLevelPlan,
+    plan_segments,
+)
+from isotope_tpu.compiler.cache import (
+    enable_persistent_cache,
+    executable_cache,
+    persistent_cache_dir,
+)
 from isotope_tpu.compiler.compile import (
     CycleError,
     HopBudgetExceededError,
@@ -24,9 +35,16 @@ from isotope_tpu.compiler.compile import (
 __all__ = [
     "CompiledGraph",
     "HopLevel",
+    "LevelShape",
+    "ScanBucketPlan",
     "ServiceTable",
+    "UnrolledLevelPlan",
     "CycleError",
     "HopBudgetExceededError",
     "NoEntrypointError",
     "compile_graph",
+    "enable_persistent_cache",
+    "executable_cache",
+    "persistent_cache_dir",
+    "plan_segments",
 ]

@@ -1,0 +1,163 @@
+"""Level-bucket planning for the scan executor.
+
+The engine's original data plane Python-unrolls one tensor-program body
+per depth level, so trace/HLO size grows with depth (and with every
+retry-widened level).  The bucketed executor instead packs *consecutive*
+depth levels whose shapes are close into one **bucket**: each level's
+tensors are padded up to the bucket's bounds and the per-level sweep
+body is traced ONCE as a ``lax.scan`` over the stacked constants — the
+GSPMD move (one small reusable program over padded static shapes,
+arxiv 2105.04663) applied to the depth axis.
+
+Planning is a pure host-side function over light per-level shape
+metadata.  A level is *scan-eligible* when it has calls and children and
+would not use the sparse call-slot encoding (sparse levels keep their
+specialized unrolled path — it exists precisely because the dense grid
+is pathological there).  Consecutive eligible levels are grouped
+greedily while the padded element count stays within ``waste`` times the
+real element count, so chains and plateau-shaped multitier graphs
+collapse into a handful of buckets while geometric trees (3x size per
+level) naturally stay unrolled.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Sequence, Tuple, Union
+
+#: padded-elements / real-elements budget for one bucket (see plan_segments)
+DEFAULT_WASTE = 1.6
+
+#: a bucket shorter than this runs unrolled (no padding, no scan overhead)
+MIN_SCAN_LEVELS = 2
+
+
+@dataclasses.dataclass(frozen=True)
+class LevelShape:
+    """Shape metadata of one depth level (host-side planning input)."""
+
+    size: int       # hops at this level
+    pmax: int       # widest script among the level's services
+    children: int   # hops at the next level spawned here
+    calls: int      # call sites (retry fans share one site)
+    attempts: int   # max retry attempts of any call
+    sparse: bool    # the engine would use the sparse call-slot encoding
+    offset: int     # start of the level's slice in BFS hop order
+
+    @property
+    def leaf(self) -> bool:
+        return self.calls == 0 or self.children == 0
+
+
+@dataclasses.dataclass(frozen=True)
+class ScanBucketPlan:
+    """One scan segment: levels ``d0..d1`` padded to common bounds.
+
+    ``bound_hops`` covers every level size in ``d0..d1`` AND the size of
+    level ``d1+1`` — the scan carry holds the *child* level's outputs,
+    so the deepest child must fit the carry width too.
+    """
+
+    d0: int
+    d1: int
+    bound_hops: int      # B — hop/children axis bound
+    bound_steps: int     # P — step axis bound
+    bound_calls: int     # K
+    bound_attempts: int  # A
+
+    @property
+    def num_levels(self) -> int:
+        return self.d1 - self.d0 + 1
+
+    def signature(self) -> tuple:
+        return ("scan", self.d0, self.d1, self.bound_hops,
+                self.bound_steps, self.bound_calls, self.bound_attempts)
+
+
+@dataclasses.dataclass(frozen=True)
+class UnrolledLevelPlan:
+    """One unrolled segment: a single level traced with static shapes."""
+
+    d: int
+
+    def signature(self) -> tuple:
+        return ("unrolled", self.d)
+
+
+Segment = Union[ScanBucketPlan, UnrolledLevelPlan]
+
+
+def _bucket_cost(shapes: Sequence[LevelShape], bounds: Tuple[int, int, int,
+                                                             int]) -> int:
+    b, p, k, a = bounds
+    return len(shapes) * (b * p + 3 * b + 2 * k * a)
+
+
+def _real_cost(shapes: Sequence[LevelShape]) -> int:
+    return sum(
+        s.size * s.pmax + 3 * s.children + 2 * s.calls * s.attempts
+        for s in shapes
+    )
+
+
+def _bounds(levels: Sequence[LevelShape], child_size: int
+            ) -> Tuple[int, int, int, int]:
+    return (
+        max([child_size] + [s.size for s in levels]),
+        max(s.pmax for s in levels),
+        max(s.calls for s in levels),
+        max(s.attempts for s in levels),
+    )
+
+
+def plan_segments(
+    shapes: Sequence[LevelShape],
+    waste: float = DEFAULT_WASTE,
+    enabled: bool = True,
+) -> List[Segment]:
+    """Partition the depth levels into scan buckets and unrolled islands.
+
+    Greedy left-to-right: starting at each eligible level, the run is
+    extended while the padded cost (every member at the running bounds,
+    including the carry-width contribution of the run's deepest child
+    level) stays within ``waste`` x the real cost.  Runs shorter than
+    ``MIN_SCAN_LEVELS`` fall back to unrolled segments.
+    """
+    segs: List[Segment] = []
+    n = len(shapes)
+    i = 0
+    while i < n:
+        s = shapes[i]
+        eligible = enabled and not s.leaf and not s.sparse
+        if not eligible:
+            segs.append(UnrolledLevelPlan(i))
+            i += 1
+            continue
+        # try to grow a run [i..j]
+        j = i
+        run = [s]
+        while j + 1 < n:
+            nxt = shapes[j + 1]
+            if nxt.leaf or nxt.sparse:
+                break
+            cand = run + [nxt]
+            # carry width must cover the candidate run's child level too
+            child_size = shapes[j + 2].size if j + 2 < n else 0
+            bounds = _bounds(cand, child_size)
+            if _bucket_cost(cand, bounds) > waste * _real_cost(cand):
+                break
+            run = cand
+            j += 1
+        if len(run) >= MIN_SCAN_LEVELS:
+            child_size = shapes[j + 1].size if j + 1 < n else 0
+            b, p, k, a = _bounds(run, child_size)
+            segs.append(ScanBucketPlan(i, j, b, p, k, a))
+            i = j + 1
+        else:
+            segs.append(UnrolledLevelPlan(i))
+            i += 1
+    return segs
+
+
+def plan_signature(segs: Sequence[Segment]) -> tuple:
+    """Hashable shape signature of a plan — part of the AOT cache key."""
+    return tuple(s.signature() for s in segs)

@@ -1,0 +1,157 @@
+"""AOT executable cache + persistent XLA compilation cache wiring.
+
+Two layers keep repeated runs of the same topology family from paying
+XLA again:
+
+- **In-process executable cache** (:data:`executable_cache`): jitted
+  entry points are stored process-wide, keyed by the engine's *shape
+  signature* — the bucket plan bounds, request-block shape, load kind,
+  feature flags, and a content digest of every constant the traced
+  program closes over.  Re-instantiating a ``Simulator`` for the same
+  compiled topology (same signature) reuses the already-traced — and,
+  after first execution, already-compiled — function instead of
+  retracing.  The digest makes sharing *sound*: two engines share an
+  executable only when every baked constant is byte-identical.
+- **Persistent on-disk cache** (:func:`enable_persistent_cache`): JAX's
+  compilation cache, keyed by XLA on the optimized HLO, so separate
+  *processes* (bench.py's per-case subprocesses, repeated CLI runs of
+  one suite) skip the XLA backend compile entirely.  The directory
+  comes from the ``ISOTOPE_COMPILE_CACHE`` env knob or an explicit
+  path; unset means disabled.
+"""
+from __future__ import annotations
+
+import hashlib
+import os
+from collections import OrderedDict
+from typing import Callable, Optional
+
+#: env knob for the persistent compilation cache directory; the values
+#: "", "0", "off" and "none" (case-insensitive) disable it explicitly.
+ENV_CACHE_DIR = "ISOTOPE_COMPILE_CACHE"
+
+_persistent_dir: Optional[str] = None
+
+
+def persistent_cache_dir() -> Optional[str]:
+    """The currently wired persistent cache dir (None when disabled)."""
+    return _persistent_dir
+
+
+def enable_persistent_cache(path: Optional[str] = None) -> Optional[str]:
+    """Point JAX's persistent compilation cache at ``path``.
+
+    ``path=None`` reads ``$ISOTOPE_COMPILE_CACHE``; when that is unset
+    (or explicitly off) this is a no-op returning ``None``.  Idempotent
+    — safe to call from every entry point (bench, CLI, sharded runner).
+    """
+    global _persistent_dir
+    if path is None:
+        path = os.environ.get(ENV_CACHE_DIR)
+    if not path or str(path).strip().lower() in ("0", "off", "none"):
+        return None
+    path = os.path.abspath(os.path.expanduser(str(path)))
+    if _persistent_dir == path:
+        return path
+    import jax
+
+    os.makedirs(path, exist_ok=True)
+    jax.config.update("jax_compilation_cache_dir", path)
+    # jax initializes its cache object lazily ONCE; re-pointing the dir
+    # after something already compiled needs an explicit reset
+    try:
+        from jax.experimental.compilation_cache import (
+            compilation_cache as _cc,
+        )
+
+        _cc.reset_cache()
+    except Exception:  # pragma: no cover - cache not initialized yet
+        pass
+    # cache every entry: the sweep programs are exactly the long-compile
+    # artifacts the cache exists for, and tiny entries are harmless
+    for knob, val in (
+        ("jax_persistent_cache_min_compile_time_secs", 0.0),
+        ("jax_persistent_cache_min_entry_size_bytes", -1),
+    ):
+        try:
+            jax.config.update(knob, val)
+        except AttributeError:  # pragma: no cover - newer/older jax
+            pass
+    _persistent_dir = path
+    return path
+
+
+def array_digest(*chunks) -> str:
+    """SHA-256 over a heterogeneous sequence of arrays / reprs.
+
+    Used to fingerprint every constant a traced program bakes in:
+    NumPy (or JAX) arrays hash their raw bytes + shape + dtype, and
+    anything else hashes its ``repr``.  ``None`` entries are skipped.
+    """
+    import numpy as np
+
+    h = hashlib.sha256()
+    for c in chunks:
+        if c is None:
+            continue
+        a = None
+        if isinstance(c, np.ndarray):
+            a = c
+        elif hasattr(c, "__array__") and not isinstance(c, (str, bytes)):
+            a = np.asarray(c)
+        if a is not None:
+            h.update(str(a.dtype).encode())
+            h.update(str(a.shape).encode())
+            h.update(np.ascontiguousarray(a).tobytes())
+        else:
+            h.update(repr(c).encode())
+    return h.hexdigest()
+
+
+class ExecutableCache:
+    """Process-wide LRU of jitted entry points, keyed by shape signature.
+
+    The stored value is the ``jax.jit``-wrapped callable; JAX's own jit
+    cache then holds the compiled executable behind it, so a signature
+    hit skips both retracing AND recompiling.
+
+    Retention caveat: each entry's closure pins its builder Simulator's
+    device constants until eviction, so ``max_entries`` bounds how many
+    otherwise-dead engines a long multi-topology sweep keeps resident —
+    sized for a sweep's load-shape grid over a few topologies, not a
+    museum of every graph ever built.  Call :meth:`clear` to release
+    everything (e.g. between unrelated experiments in one process).
+    """
+
+    def __init__(self, max_entries: int = 32):
+        self.max_entries = max_entries
+        self._fns: "OrderedDict[tuple, object]" = OrderedDict()
+        self.hits = 0
+        self.misses = 0
+
+    def get_or_build(self, key: tuple, build: Callable[[], object]):
+        if key in self._fns:
+            self.hits += 1
+            self._fns.move_to_end(key)
+            return self._fns[key]
+        self.misses += 1
+        fn = build()
+        self._fns[key] = fn
+        while len(self._fns) > self.max_entries:
+            self._fns.popitem(last=False)
+        return fn
+
+    def __contains__(self, key: tuple) -> bool:
+        return key in self._fns
+
+    def __len__(self) -> int:
+        return len(self._fns)
+
+    def clear(self) -> None:
+        self._fns.clear()
+        self.hits = 0
+        self.misses = 0
+
+
+#: the process-wide instance every Simulator / ShardedSimulator consults
+executable_cache = ExecutableCache()

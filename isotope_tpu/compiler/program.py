@@ -149,6 +149,31 @@ class CompiledGraph:
     def depth(self) -> int:
         return len(self.levels)
 
+    def shape_signature(self) -> tuple:
+        """Hashable shape-only fingerprint of the lowered program.
+
+        Two compiled graphs with equal signatures produce identically
+        *shaped* tensor programs (same level sizes, call/attempt
+        tables, step width) — the coarse half of the AOT executable
+        cache key (compiler/cache.py); value equality is established
+        separately by the engine's constant digest.
+        """
+        return (
+            self.num_hops,
+            self.num_services,
+            self.max_steps,
+            self.depth,
+            tuple(
+                (
+                    lvl.num_hops,
+                    lvl.num_children,
+                    lvl.num_calls,
+                    lvl.max_attempts,
+                )
+                for lvl in self.levels
+            ),
+        )
+
     def expected_visits(self, hop_multiplier=None) -> np.ndarray:
         """Expected hops per root request, per service (f64, shape (S,)).
 

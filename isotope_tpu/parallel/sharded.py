@@ -26,6 +26,10 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
+from isotope_tpu.compiler.cache import (
+    enable_persistent_cache,
+    executable_cache,
+)
 from isotope_tpu.compiler.program import CompiledGraph
 from isotope_tpu.metrics.prometheus import MetricsCollector, ServiceMetrics
 from isotope_tpu.parallel.mesh import SVC_AXIS
@@ -36,6 +40,26 @@ from isotope_tpu.sim.summary import RunSummary, reduce_stacked, summarize
 # back-compat alias: the sharded path now returns the same summary type
 # the single-device scan path produces
 ShardedSummary = RunSummary
+
+
+def _shard_map(body, mesh, in_specs, out_specs):
+    """``jax.shard_map`` across jax versions.
+
+    Newer jax exposes it top-level with ``check_vma``; older releases
+    (<= 0.4.x) ship ``jax.experimental.shard_map`` whose equivalent
+    knob is ``check_rep``.
+    """
+    if hasattr(jax, "shard_map"):
+        return jax.shard_map(
+            body, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+            check_vma=False,
+        )
+    from jax.experimental.shard_map import shard_map
+
+    return shard_map(
+        body, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+        check_rep=False,
+    )
 
 
 class ShardedSimulator:
@@ -52,6 +76,10 @@ class ShardedSimulator:
     ):
         self.compiled = compiled
         self.mesh = mesh
+        # persistent XLA cache (no-op unless $ISOTOPE_COMPILE_CACHE is
+        # set): the sharded sweep programs are the most expensive
+        # compiles in the system, so wire the disk cache here too
+        enable_persistent_cache()
         self.sim = Simulator(compiled, params, chaos, churn, mtls=mtls)
         self.collector = MetricsCollector(compiled)
         if SVC_AXIS not in mesh.axis_names:
@@ -159,7 +187,7 @@ class ShardedSimulator:
         if cache_key not in self._fns:
             body = partial(self._body, block, num_blocks, kind, conns_local,
                            trim, sat_conns)
-            mapped = jax.shard_map(
+            mapped = _shard_map(
                 body,
                 mesh=self.mesh,
                 in_specs=tuple(P() for _ in range(8)),
@@ -191,9 +219,16 @@ class ShardedSimulator:
                     utilization=P(),
                     unstable=P(),
                 ),
-                check_vma=False,
             )
-            self._fns[cache_key] = jax.jit(mapped)
+            mesh_sig = (
+                tuple(self.mesh.axis_names),
+                tuple(int(self.mesh.shape[a]) for a in self.mesh.axis_names),
+                tuple(d.id for d in self.mesh.devices.flat),
+            )
+            self._fns[cache_key] = executable_cache.get_or_build(
+                ("sharded", self.sim.signature, mesh_sig) + cache_key,
+                lambda: jax.jit(mapped),
+            )
         return self._fns[cache_key]
 
     def _body(

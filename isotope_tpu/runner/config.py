@@ -15,7 +15,11 @@ from __future__ import annotations
 
 import dataclasses
 import pathlib
-import tomllib
+
+try:
+    import tomllib
+except ModuleNotFoundError:  # Python < 3.11: the tomli backport is the
+    import tomli as tomllib  # same parser under its pre-stdlib name
 from typing import Dict, List, Optional, Tuple
 
 from isotope_tpu.sim.config import (

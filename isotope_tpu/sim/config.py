@@ -103,12 +103,31 @@ class SimParams:
     # (ORACLE.md r4 "known out-of-envelope" #1); 0.9 measured: +4.1%
     # p50 / +2.1% p99 at rho=0.9, monotone improvements at 0.3-0.85,
     # saturated sampler untouched.  Fit against the DES oracle like r.
+    # SCOPE (ADVICE r5): only MULTI-MEMBER sibling groups — real
+    # concurrent fan-outs / retry fans — join the hierarchy; singleton
+    # groups (sequential single calls) keep their flat independent
+    # factor, so r * gamma^L is NOT applied between a fan-out and a
+    # same-depth single-call cousin on mixed sequential/concurrent
+    # graphs.  Deliberate: a dense factor row per singleton group
+    # captured ~7 GB of constants on a 30k-hop sequential graph (see
+    # engine), and a singleton's own wait has no within-group
+    # correlation to transfer in the first place.
     hierarchical_copula_gamma: float = 0.9
     # Dense-grid element threshold above which a skewed level (grid
     # > 4x its real call-step count) switches to the sparse call-slot
     # step encoding (engine._SparseSteps) — the star-10k mitigation.
     # Lower it to force the sparse path on small graphs (tests).
     sparse_level_elems: int = 262_144
+    # Bucketed level-scan executor (sim/levelscan.py): consecutive
+    # depth levels with close shapes are padded to shared bounds and
+    # swept by ONE lax.scan body per bucket, so trace/HLO size is
+    # O(buckets) instead of O(depth) — the large-graph compile-wall
+    # fix.  ``level_bucket_waste`` caps the padded/real element ratio
+    # a bucket may cost (compiler/buckets.py); raise it to force wider
+    # buckets (tests do), set ``bucketed_scan=False`` to fall back to
+    # the fully unrolled trace.  Results are bit-identical either way.
+    bucketed_scan: bool = True
+    level_bucket_waste: float = 1.6
 
     def __post_init__(self):
         if self.service_time not in (
@@ -133,6 +152,8 @@ class SimParams:
             raise ValueError("sibling_copula_r must be in [0, 1)")
         if not 0.0 <= self.retry_copula_r < 1.0:
             raise ValueError("retry_copula_r must be in [0, 1)")
+        if self.level_bucket_waste < 1.0:
+            raise ValueError("level_bucket_waste must be >= 1")
         # (sibling_copula_r + retry_copula_r < 1 is required only for
         # hops inside a multi-attempt call; the Simulator enforces it
         # when such calls exist)

@@ -27,8 +27,13 @@ One jit-compiled tensor program replaces the reference's entire data plane:
   step (concurrent siblings in that step still run, executable.go:148-179)
   and itself returns a 500 upward.
 
-Everything is static-shaped: (num_requests x num_hops) event tensors, depth
-levels unrolled at trace time, RNG via ``jax.random`` keys.
+Everything is static-shaped: (num_requests x num_hops) event tensors, RNG
+via ``jax.random`` keys.  Depth levels execute through the bucketed
+``lax.scan`` executor by default (close-shaped consecutive levels are
+padded to shared bounds and swept by one traced body per bucket —
+sim/levelscan.py / compiler/buckets.py, trace size O(buckets)); levels
+that don't bucket (skewed sparse levels, leaves, geometric trees) keep
+their specialized unrolled per-level trace, bit-identical either way.
 """
 from __future__ import annotations
 
@@ -40,8 +45,10 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from isotope_tpu.compiler import buckets
+from isotope_tpu.compiler.cache import array_digest, executable_cache
 from isotope_tpu.compiler.program import CompiledGraph, hop_wire_times
-from isotope_tpu.sim import queueing
+from isotope_tpu.sim import levelscan, queueing
 from isotope_tpu.sim.config import (
     CLOSED_LOOP,
     OPEN_LOOP,
@@ -178,27 +185,9 @@ class _SparseSteps:
     slot_sleep_prefix: jax.Array  # (S,) static sleep before the slot
 
 
-def _call_outcome(t, timeout, down_child):
-    """(transport_failure, duration) of one call attempt.
-
-    ``t`` is the attempt's would-be round trip; a finite ``timeout``
-    clamps it and fails the call past it (executable.go's http client
-    timeout); a down callee (``down_child``) transport-fails at ~zero
-    cost — the connection is refused, nothing runs.  ``None`` inputs
-    mean the failure mode is statically impossible, and a ``None``
-    transport result means no transport failure can occur at all.
-    """
-    transport = None
-    dur = t
-    if timeout is not None:
-        transport = t > timeout
-        dur = jnp.minimum(t, timeout)
-    if down_child is not None:
-        transport = (
-            down_child if transport is None else (down_child | transport)
-        )
-        dur = jnp.where(down_child, 0.0, dur)
-    return transport, dur
+# one definition serves both executors: the scan twin's bit-for-bit
+# contract requires the attempt-outcome ops to stay in exact lockstep
+_call_outcome = levelscan.call_outcome
 
 
 class Simulator:
@@ -578,6 +567,7 @@ class Simulator:
         self._need_err = bool((t.error_rate[hs] > 0.0).any())
 
         levels: List[_Level] = []
+        np_meta: List[dict] = []  # host-side shapes for bucket planning
         offset = 0
         for lvl in compiled.levels:
             cids = lvl.child_ids
@@ -687,6 +677,34 @@ class Simulator:
                             jnp.float32,
                         ),
                     )
+            meta = dict(
+                size=lvl.num_hops, pmax=pmax, C=len(cids), K=n_calls,
+                A=lvl.att_child.shape[0], offset=offset,
+                sparse=sparse is not None, leaf=n_calls == 0,
+            )
+            if params.bucketed_scan and not (meta["sparse"]
+                                             or meta["leaf"]):
+                # dense host copies only for scan-ELIGIBLE levels — a
+                # sparse level's (size x pmax) grid is exactly what the
+                # sparse encoding exists to avoid materializing
+                meta.update(
+                    step_mask=lvl.step_is_real[:, :pmax]
+                    .astype(np.float32),
+                    step_base=np.asarray(
+                        lvl.step_base[:, :pmax], np.float32
+                    ),
+                    parent_local=parent_local, child_step=child_step,
+                    child_rtt=(net_out[cids] + net_back[cids]),
+                    child_net_out=net_out[cids],
+                    child_send_prob=compiled.hop_send_prob[cids],
+                    child_churn_entry=(
+                        self._hop_churn_entry[cids] if churn else None
+                    ),
+                    call_local=call_local, call_step=call_step,
+                    call_timeout=lvl.call_timeout,
+                    att_child=lvl.att_child, att_valid=lvl.att_valid,
+                )
+            np_meta.append(meta)
             levels.append(
                 _Level(
                     offset=offset,
@@ -725,6 +743,69 @@ class Simulator:
             )
             offset += lvl.num_hops
         self._levels: Tuple[_Level, ...] = tuple(levels)
+
+        # -- bucketed level-scan plan (compiler/buckets.py) -----------------
+        # Consecutive close-shaped levels collapse into lax.scan buckets
+        # (sim/levelscan.py): the sweep body is traced once per bucket,
+        # keeping trace/HLO size O(buckets) on deep graphs.  Sparse and
+        # leaf levels keep their specialized unrolled path.
+        self._track_err = (
+            self._need_err
+            or bool(chaos)
+            or any(
+                bool(np.isfinite(l.call_timeout).any())
+                for l in compiled.levels
+            )
+        )
+        shapes = [
+            buckets.LevelShape(
+                size=m["size"], pmax=m["pmax"], children=m["C"],
+                calls=m["K"], attempts=m["A"], sparse=m["sparse"],
+                offset=m["offset"],
+            )
+            for m in np_meta
+        ]
+        plan = buckets.plan_segments(
+            shapes,
+            waste=params.level_bucket_waste,
+            enabled=params.bucketed_scan,
+        )
+        self._segments = tuple(
+            levelscan.build_bucket(p, np_meta, len(self._churn))
+            if isinstance(p, buckets.ScanBucketPlan)
+            else p
+            for p in plan
+        )
+        self._plan_sig = buckets.plan_signature(plan)
+
+        # -- AOT shape signature (compiler/cache.py) ------------------------
+        # Everything a traced entry point bakes in: the bucket plan, the
+        # compiled graph's shape, and a content digest of every closed-
+        # over constant — so two Simulator instances share executables
+        # exactly when the traced programs would be identical.
+        self.signature = (
+            "engine-v1",
+            self._plan_sig,
+            compiled.shape_signature(),
+            array_digest(
+                repr(params), repr(tuple(chaos)), repr(self._churn),
+                repr(mtls), repr(t.names),
+                compiled.hop_service, compiled.hop_parent,
+                compiled.hop_step, compiled.hop_attempt,
+                compiled.hop_send_prob, compiled.hop_request_size,
+                compiled.hop_reach, t.replicas, t.error_rate,
+                t.response_size, t.cluster,
+                *[
+                    a
+                    for l in compiled.levels
+                    for a in (
+                        l.step_is_real, l.step_base, l.child_ids,
+                        l.child_seg, l.call_seg, l.call_timeout,
+                        l.att_child, l.att_valid,
+                    )
+                ],
+            ),
+        )
 
         # -- sibling copula: static hop -> group id map ---------------------
         # Concurrent sibling hops (children spawned by the same parent
@@ -1455,8 +1536,15 @@ class Simulator:
              sat: bool = False):
         key = (n, kind, connections, sat)
         if key not in self._fns:
-            self._fns[key] = jax.jit(
-                partial(self._simulate, n, kind, connections, sat)
+            # process-wide AOT reuse: an equal signature means the
+            # traced program would be identical (compiler/cache.py), so
+            # a re-instantiated Simulator for the same topology family
+            # skips retracing AND recompiling
+            self._fns[key] = executable_cache.get_or_build(
+                ("simulate", self.signature) + key,
+                lambda: jax.jit(
+                    partial(self._simulate, n, kind, connections, sat)
+                ),
             )
         return self._fns[key]
 
@@ -1504,7 +1592,10 @@ class Simulator:
                 )
                 return summary_mod.reduce_stacked(parts)
 
-            self._summary_fns[cache_key] = jax.jit(scanfn)
+            self._summary_fns[cache_key] = executable_cache.get_or_build(
+                ("summary", self.signature) + cache_key,
+                lambda: jax.jit(scanfn),
+            )
         return self._summary_fns[cache_key]
 
     def _sample_service_time(self, key: jax.Array, shape) -> jax.Array:
@@ -1766,6 +1857,7 @@ class Simulator:
         # requests like the chaos phases do.  ``combo_idx`` linearizes
         # the schedules' cycle positions for the queueing-phase tables.
         combo_idx = None
+        churn_w = None
         if self._churn:
             cols = []
             combo_idx = jnp.zeros(n, jnp.int32)
@@ -1953,12 +2045,51 @@ class Simulator:
         # err_lvls[d] is None when no hop can 500, fail_lvls[d] is None
         # when no call can transport-fail, used_lvls[d] is None when every
         # call is deterministically sent.
+        # Scan-bucket segments (sim/levelscan.py) sweep several levels
+        # with one traced body; unrolled/sparse islands keep the
+        # specialized per-level trace below.  Boundary levels (a
+        # bucket's shallowest, every unrolled level) are materialized
+        # into the per-level lists so neighbors compose transparently.
         lat_lvls: List[Optional[jax.Array]] = [None] * len(self._levels)
         err_lvls: List[Optional[jax.Array]] = [None] * len(self._levels)
         fail_lvls: List[Optional[jax.Array]] = [None] * len(self._levels)
         used_lvls: List[Optional[jax.Array]] = [None] * len(self._levels)
         off_lvls: List[Optional[jax.Array]] = [None] * len(self._levels)
-        for d in reversed(range(len(self._levels))):
+        ctx = levelscan.SweepCtx(
+            n=n, wait=wait, svc_time=svc_time, err_coin=err_coin,
+            u_send=u_send, down=down, tax=tax, churn_w=churn_w,
+            track_err=self._track_err,
+        )
+        bucket_ys: Dict[int, dict] = {}
+        up_units: List[tuple] = []
+        for si in reversed(range(len(self._segments))):
+            seg = self._segments[si]
+            if isinstance(seg, levelscan.ScanBucket):
+                up_units.append(("bucket", si))
+            else:
+                up_units.append(("lvl", seg.d))
+        for _kind, _idx in up_units:
+            if _kind == "bucket":
+                seg = self._segments[_idx]
+                B = seg.plan.bound_hops
+                d0, d1 = seg.plan.d0, seg.plan.d1
+                lat_init = levelscan.pad_cols(lat_lvls[d1 + 1], B)
+                err_init = None
+                if self._track_err:
+                    ce = err_lvls[d1 + 1]
+                    err_init = (
+                        levelscan.pad_cols(ce, B)
+                        if ce is not None
+                        else jnp.zeros((n, B), bool)
+                    )
+                ys = levelscan.up_sweep(ctx, seg, lat_init, err_init)
+                bucket_ys[_idx] = ys
+                s0 = seg.sizes[0]
+                lat_lvls[d0] = ys["lat"][0][:, :s0]
+                if self._track_err:
+                    err_lvls[d0] = ys["err"][0][:, :s0]
+                continue
+            d = _idx
             lvl = self._levels[d]
             sl = slice(lvl.offset, lvl.offset + lvl.size)
             P = lvl.pmax
@@ -2240,15 +2371,31 @@ class Simulator:
         # a down ENTRY service refuses the client's connection itself
         if down is not None:
             root_down = down[:, 0]
-            sent_lvls: List[jax.Array] = [~root_down[:, None]]
+            sent_cur: jax.Array = ~root_down[:, None]
         else:
             root_down = None
-            sent_lvls = [jnp.ones((n, 1), bool)]
-        for d, lvl in enumerate(self._levels[:-1]):
+            sent_cur = jnp.ones((n, 1), bool)
+        last_level = len(self._levels) - 1
+        sent_chunks: List[jax.Array] = []
+        for si, seg in enumerate(self._segments):
+            if isinstance(seg, levelscan.ScanBucket):
+                own, sent_cur = levelscan.sent_sweep(
+                    ctx, seg, bucket_ys[si],
+                    levelscan.pad_cols(sent_cur, seg.plan.bound_hops),
+                )
+                sent_chunks.append(
+                    levelscan.gather_levels(own, seg.sizes)
+                )
+                continue
+            d = seg.d
+            sent_chunks.append(sent_cur)
+            if d >= last_level:
+                continue
+            lvl = self._levels[d]
             sl = slice(lvl.offset, lvl.offset + lvl.size)
             nxt = self._levels[d + 1]
             csl = slice(nxt.offset, nxt.offset + nxt.size)
-            sent = sent_lvls[d][:, lvl.child_parent_local]
+            sent = sent_cur[:, lvl.child_parent_local]
             if err_coin is not None:
                 sent = sent & ~err_coin[:, sl][:, lvl.child_parent_local]
             if fail_lvls[d] is not None:
@@ -2260,8 +2407,7 @@ class Simulator:
                 sent = sent & used_lvls[d]
             if down is not None:
                 sent = sent & ~down[:, csl]
-            sent_lvls.append(sent)
-        err_hop_lvls = err_lvls
+            sent_cur = sent
 
         # ---- closed-loop arrivals (need latencies) -----------------------
         # a refused connection to the entry costs one wire round trip
@@ -2302,28 +2448,57 @@ class Simulator:
         entry_wire = self._entry_one_way
         if tax is not None:
             entry_wire = entry_wire + tax
-        start_lvls: List[jax.Array] = [
-            (arrivals + entry_wire)[:, None]
-        ]
-        for d in range(len(self._levels) - 1):
+        start_cur: jax.Array = (arrivals + entry_wire)[:, None]
+        start_chunks: List[jax.Array] = []
+        for si, seg in enumerate(self._segments):
+            if isinstance(seg, levelscan.ScanBucket):
+                own, start_cur = levelscan.start_sweep(
+                    ctx, seg, bucket_ys[si],
+                    levelscan.pad_cols(start_cur, seg.plan.bound_hops),
+                )
+                start_chunks.append(
+                    levelscan.gather_levels(own, seg.sizes)
+                )
+                continue
+            d = seg.d
+            start_chunks.append(start_cur)
+            if d >= last_level:
+                continue
             lvl = self._levels[d]
             sl = slice(lvl.offset, lvl.offset + lvl.size)
-            base = (start_lvls[d] + wait[:, sl])[:, lvl.child_parent_local]
+            base = (start_cur + wait[:, sl])[:, lvl.child_parent_local]
             out_wire = lvl.child_net_out
             if tax is not None:
                 out_wire = out_wire + tax[:, None]
-            start_lvls.append(base + off_lvls[d] + out_wire)
+            start_cur = base + off_lvls[d] + out_wire
 
-        hop_sent = jnp.concatenate(sent_lvls, axis=1)
-        hop_lat = jnp.concatenate(lat_lvls, axis=1)
-        hop_start = jnp.concatenate(start_lvls, axis=1)
-        err_hop = jnp.concatenate(
-            [
-                e if e is not None else jnp.zeros((n, lvl.size), bool)
-                for e, lvl in zip(err_hop_lvls, self._levels)
-            ],
-            axis=1,
-        )
+        # ---- per-segment assembly into BFS hop order ---------------------
+        lat_chunks: List[jax.Array] = []
+        err_chunks: List[jax.Array] = []
+        for si, seg in enumerate(self._segments):
+            if isinstance(seg, levelscan.ScanBucket):
+                ys = bucket_ys[si]
+                lat_chunks.append(
+                    levelscan.gather_levels(ys["lat"], seg.sizes)
+                )
+                err_chunks.append(
+                    levelscan.gather_levels(ys["err"], seg.sizes)
+                    if self._track_err
+                    else jnp.zeros((n, seg.num_hops), bool)
+                )
+            else:
+                d = seg.d
+                lat_chunks.append(lat_lvls[d])
+                e = err_lvls[d]
+                err_chunks.append(
+                    e
+                    if e is not None
+                    else jnp.zeros((n, self._levels[d].size), bool)
+                )
+        hop_sent = jnp.concatenate(sent_chunks, axis=1)
+        hop_lat = jnp.concatenate(lat_chunks, axis=1)
+        hop_start = jnp.concatenate(start_chunks, axis=1)
+        err_hop = jnp.concatenate(err_chunks, axis=1)
         client_error = err_hop[:, 0]
         if root_down is not None:
             client_error = client_error | root_down

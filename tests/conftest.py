@@ -6,9 +6,21 @@ separately dry-runs ``__graft_entry__.dryrun_multichip``).
 
 Note: the ambient environment preimports jax at interpreter startup (the
 axon sitecustomize) with ``JAX_PLATFORMS=axon``, so environment variables
-set here are read too late — only ``jax.config.update`` works.
+set here are read too late — only ``jax.config.update`` works.  Older
+jax releases (< 0.5) have no ``jax_num_cpu_devices`` option; there the
+device count comes from ``XLA_FLAGS``, which IS still honored as long
+as no backend has initialized (preimporting jax does not initialize
+one), so set it before the first ``jax.devices()`` call.
 """
+import os
+
 import jax
 
 jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 8)
+try:
+    jax.config.update("jax_num_cpu_devices", 8)
+except AttributeError:
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        + " --xla_force_host_platform_device_count=8"
+    )

@@ -1,0 +1,163 @@
+"""AOT executable cache + persistent compilation cache (compiler/cache.py).
+
+The executable cache shares jitted entry points across Simulator
+instances keyed by the engine shape signature; sharing must be exact —
+identical shape signature (bucket bounds, block shape, feature flags)
+AND identical baked constants — and any bound/flag change must miss.
+"""
+import os
+
+import jax
+import numpy as np
+import pytest
+
+from isotope_tpu.compiler import compile_graph
+from isotope_tpu.compiler.cache import (
+    array_digest,
+    enable_persistent_cache,
+    executable_cache,
+)
+from isotope_tpu.models.graph import ServiceGraph
+from isotope_tpu.sim import LoadModel, SimParams, Simulator
+
+CHAIN = """
+services:
+- name: a
+  isEntrypoint: true
+  script:
+  - call: b
+- name: b
+  script:
+  - call: c
+- name: c
+"""
+
+OPEN = LoadModel(kind="open", qps=100.0)
+KEY = jax.random.PRNGKey(0)
+
+
+def _sim(params=SimParams()):
+    return Simulator(compile_graph(ServiceGraph.from_yaml(CHAIN)), params)
+
+
+def test_identical_topologies_share_one_executable():
+    s1, s2 = _sim(), _sim()
+    assert s1.signature == s2.signature
+    f1 = s1._get(64, "open")
+    f2 = s2._get(64, "open")
+    assert f1 is f2  # one jitted entry point, process-wide
+    # and it runs correctly for the second instance
+    r = f2(KEY, np.float32(100.0), np.float32(0.0), np.float32(100.0),
+           visits_pc=s2._vis_arg(100.0),
+           phase_windows=s2._windows_arg(100.0, False))
+    assert int(r.hop_events) == 64 * 3
+
+
+def test_summary_executable_shared_and_block_size_misses():
+    s1, s2 = _sim(), _sim()
+    f1 = s1._get_summary(64, 2, "open", 0, None)
+    f2 = s2._get_summary(64, 2, "open", 0, None)
+    assert f1 is f2
+    f3 = s2._get_summary(128, 2, "open", 0, None)  # block size change
+    assert f3 is not f1
+
+
+def test_request_shape_misses():
+    s1, s2 = _sim(), _sim()
+    assert s1._get(64, "open") is not s2._get(128, "open")
+    assert s1._get(64, "open") is s2._get(64, "open")
+
+
+def test_bucket_bound_change_misses():
+    # a different waste budget changes the plan bounds => new signature
+    s1 = _sim(SimParams(level_bucket_waste=1.6))
+    s2 = _sim(SimParams(level_bucket_waste=64.0))
+    # same topology — the plans may or may not coincide, but the
+    # signature must incorporate the params either way
+    assert s1.signature != s2.signature
+    assert s1._get(64, "open") is not s2._get(64, "open")
+
+
+def test_feature_flag_change_misses():
+    s1 = _sim(SimParams())
+    s2 = _sim(SimParams(service_time="deterministic"))
+    s3 = _sim(SimParams(bucketed_scan=False))
+    assert len({s1.signature, s2.signature, s3.signature}) == 3
+
+
+def test_different_constants_same_shape_miss():
+    """Same tensor shapes, different sleep constant: must NOT share."""
+    other = CHAIN.replace("- name: c", "- name: c\n  script:\n  - sleep: 1ms")
+    s1 = _sim()
+    s2 = Simulator(compile_graph(ServiceGraph.from_yaml(other)))
+    # shapes differ here (extra step) — craft a pure-constant change:
+    g3 = ServiceGraph.from_yaml(CHAIN)
+    g3.services[2].num_replicas = 7
+    s3 = Simulator(compile_graph(g3))
+    assert s1.signature != s2.signature
+    assert s1.signature != s3.signature
+
+
+def test_signature_stable_across_runs():
+    s = _sim()
+    sig = s.signature
+    s.run(OPEN, 64, KEY)
+    assert s.signature == sig
+
+
+def test_array_digest_discriminates():
+    a = np.arange(6, dtype=np.float32)
+    assert array_digest(a) == array_digest(a.copy())
+    assert array_digest(a) != array_digest(a.reshape(2, 3))
+    assert array_digest(a) != array_digest(a.astype(np.float64))
+    assert array_digest(a, "x") != array_digest(a, "y")
+    assert array_digest(None, a) == array_digest(a)
+
+
+def test_executable_cache_lru_bounds_memory():
+    from isotope_tpu.compiler.cache import ExecutableCache
+
+    c = ExecutableCache(max_entries=2)
+    c.get_or_build(("a",), lambda: 1)
+    c.get_or_build(("b",), lambda: 2)
+    c.get_or_build(("a",), lambda: 99)   # hit, refreshes recency
+    c.get_or_build(("c",), lambda: 3)    # evicts ("b",)
+    assert ("a",) in c and ("c",) in c and ("b",) not in c
+    assert c.hits == 1 and c.misses == 3
+
+
+def test_persistent_cache_env_and_disable(tmp_path, monkeypatch):
+    import isotope_tpu.compiler.cache as cache_mod
+
+    monkeypatch.setattr(cache_mod, "_persistent_dir", None)
+    monkeypatch.setenv(cache_mod.ENV_CACHE_DIR, "off")
+    assert enable_persistent_cache() is None
+    d = tmp_path / "xla"
+    got = enable_persistent_cache(str(d))
+    assert got == str(d) and os.path.isdir(got)
+    # idempotent re-enable
+    assert enable_persistent_cache(str(d)) == got
+
+
+def test_persistent_cache_writes_entries(tmp_path, monkeypatch):
+    """Compiling through the wired cache leaves entries on disk."""
+    import isotope_tpu.compiler.cache as cache_mod
+
+    monkeypatch.setattr(cache_mod, "_persistent_dir", None)
+    d = str(tmp_path / "xla")
+    enable_persistent_cache(d)
+    try:
+        sim = _sim(SimParams(cpu_time_s=1.0 / 9_999.0))  # fresh program
+        sim.run(OPEN, 32, KEY)
+        assert os.listdir(d), "no persistent cache entries written"
+    finally:
+        jax.config.update("jax_compilation_cache_dir", None)
+        monkeypatch.setattr(cache_mod, "_persistent_dir", None)
+
+
+def test_executable_cache_stats_visible():
+    executable_cache.clear()
+    _sim()._get(48, "open")
+    before = executable_cache.hits
+    _sim()._get(48, "open")
+    assert executable_cache.hits == before + 1

@@ -5,6 +5,12 @@ import json
 import pytest
 import yaml
 
+# the generator signs real JWKS material; without the optional
+# cryptography wheel these tests cannot run (don't fail a CPU-only
+# image over a missing native dep — gate, per the repo's no-new-deps
+# policy)
+pytest.importorskip("cryptography")
+
 from isotope_tpu import cli
 from isotope_tpu.convert.security import (
     AuthZ,

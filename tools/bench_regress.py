@@ -70,7 +70,8 @@ def _cases(doc: dict, prefer_best: bool = False) -> dict:
     for k, v in extra.items():
         if not isinstance(v, (int, float)):
             continue
-        if k.endswith(("_inflight", "_spread", "_census", "_best")):
+        if k.endswith(("_inflight", "_spread", "_census", "_best",
+                       "_compile_s")):
             continue  # evidence / variance keys, not rates
         cases[k] = float(v)
     if prefer_best:
@@ -85,7 +86,11 @@ def previous_capture() -> tuple:
     """(path, parsed_doc) of the newest BENCH_r*.json, or (None, None)."""
     files = sorted(
         glob.glob(os.path.join(REPO_ROOT, "BENCH_r*.json")),
-        key=lambda p: int(re.search(r"r(\d+)", p).group(1)),
+        # match against the BASENAME only: a checkout path containing
+        # "r<digit>" (e.g. /home/r2/repo) must not key the ordering
+        key=lambda p: int(
+            re.search(r"r(\d+)", os.path.basename(p)).group(1)
+        ),
     )
     if not files:
         return None, None
