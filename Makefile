@@ -6,7 +6,7 @@ PY ?= python
 QPS ?= 1000
 DURATION ?= 120s
 
-.PHONY: test bench examples canonical tree star multitier \
+.PHONY: test bench telemetry-smoke examples canonical tree star multitier \
 	auxiliary-services star-auxiliary latency cpu_mem dot clean
 
 test:
@@ -20,6 +20,20 @@ bench:
 	$(PY) bench.py > .bench_capture.json
 	@cat .bench_capture.json
 	$(PY) tools/bench_regress.py .bench_capture.json
+
+# tiny end-to-end engine-telemetry check: run a 3-service chain with
+# --telemetry=detail (segment fences armed) and validate the emitted
+# JSONL against the schema (telemetry/core.py validate_jsonl).
+telemetry-smoke:
+	rm -f /tmp/isotope_telemetry_smoke.jsonl
+	$(PY) -m isotope_tpu simulate examples/topologies/chain-3-services.yaml \
+		--qps 50 --duration 2s --load-kind open --max-requests 256 \
+		--telemetry=detail \
+		--telemetry-out /tmp/isotope_telemetry_smoke.jsonl --flat \
+		> /dev/null
+	$(PY) -c "from isotope_tpu.telemetry import validate_jsonl; \
+		n = validate_jsonl('/tmp/isotope_telemetry_smoke.jsonl'); \
+		print(f'telemetry-smoke: {n} valid record(s)')"
 
 examples:
 	$(PY) tools/gen_examples.py

@@ -87,19 +87,24 @@ def _rate(sim, load, num_requests, block_size, *, warm=3, iters=3,
     window.  ``first_s`` is the first-call wall time — trace + XLA
     compile (+ the closed-loop rate solve where applicable) — the
     compile-wall evidence the level-scan executor and the persistent
-    compilation cache exist to shrink.
+    compilation cache exist to shrink.  It is sourced from the engine
+    telemetry phase timers (telemetry/core.py), which also split it
+    into trace/lower/backend in the case's telemetry block.
     """
     import jax
+
+    from isotope_tpu import telemetry
 
     key = jax.random.PRNGKey(0)
 
     def once(k):
         return sim.run_summary(load, num_requests, k, block_size=block_size)
 
-    t0 = time.perf_counter()
-    s = once(key)
-    jax.block_until_ready(s.count)
-    first_s = time.perf_counter() - t0
+    before = telemetry.phase_seconds("bench.first_call")
+    with telemetry.phase("bench.first_call"):
+        s = once(key)
+        jax.block_until_ready(s.count)
+    first_s = telemetry.phase_seconds("bench.first_call") - before
     hops = float(s.hop_events)
     for i in range(warm):
         s = once(jax.random.fold_in(key, 1000 + i))
@@ -126,8 +131,14 @@ def run_case(name: str) -> dict:
     import yaml
 
     from __graft_entry__ import _flagship
+    from isotope_tpu import telemetry
     from isotope_tpu.compiler import compile_graph
     from isotope_tpu.compiler.cache import enable_persistent_cache
+
+    # fresh per-case registry (each case runs in its own subprocess
+    # anyway — this guards direct run_case() callers like tests)
+    telemetry.reset()
+    telemetry.install_jax_hooks()
 
     # persistent XLA cache across the per-case subprocesses (and across
     # whole bench runs): repeated topology families skip the backend
@@ -279,8 +290,15 @@ def run_case(name: str) -> dict:
     out["spread"] = spread
     out["best"] = best
     # first-call wall time (trace + XLA compile): the compile-wall
-    # evidence for the bucketed level-scan executor / compile cache
+    # evidence for the bucketed level-scan executor / compile cache —
+    # sourced from the telemetry phase timer (see _rate)
     out["compile_s"] = first_s
+    # the engine telemetry block: compile-phase split, cache hit
+    # ratios, padding waste, device-memory high-water — lands in the
+    # BENCH json per case so tools/bench_regress.py can gate on
+    # compile-time / memory regressions, not just throughput
+    telemetry.record_device_memory()
+    out["telemetry"] = telemetry.summary_block()
     if cache_dir:
         out["compile_cache"] = cache_dir
     return out
@@ -342,8 +360,11 @@ def main() -> None:
         # honest median
         extra[f"{name}_best"] = round(res["best"])
         extra[f"{name}_compile_s"] = round(res.get("compile_s", 0.0), 2)
+        if res.get("telemetry"):
+            extra[f"{name}_telemetry"] = res["telemetry"]
         for k, v in res.items():
-            if k not in ("median", "spread", "best", "compile_s"):
+            if k not in ("median", "spread", "best", "compile_s",
+                         "telemetry"):
                 extra[k] = v
         print(f"bench: {name}: {res['median'] / 1e9:.3f}B "
               f"(spread {res['spread']:.0%}, first-call "

@@ -12,8 +12,14 @@ def register_all(sub) -> None:
     # simulate_cmd/suite_cmd defer their jax-dependent imports into the
     # handlers (so --help stays instant); a jax-less environment gets a
     # clean error at run time from _require_jax, not a hidden subcommand.
-    from isotope_tpu.commands import fidelity_cmd, simulate_cmd, suite_cmd
+    from isotope_tpu.commands import (
+        fidelity_cmd,
+        simulate_cmd,
+        suite_cmd,
+        telemetry_cmd,
+    )
 
     simulate_cmd.register(sub)
     suite_cmd.register(sub)
     fidelity_cmd.register(sub)
+    telemetry_cmd.register(sub)

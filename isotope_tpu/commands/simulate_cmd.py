@@ -65,6 +65,19 @@ def register(sub) -> None:
                    default="chrome")
     s.add_argument("--trace-requests", type=int, default=32,
                    help="how many requests to trace (sampled dense run)")
+    s.add_argument("--telemetry", nargs="?", const="on",
+                   choices=("on", "detail"), default=None,
+                   help="emit engine self-telemetry: isotope_engine_* "
+                        "series appended to --prometheus output, a "
+                        "telemetry.jsonl record, and a summary block on "
+                        "stderr.  'detail' additionally fences at "
+                        "segment granularity (eager execution — for "
+                        "diagnosis, not benchmarking).  Defaults the "
+                        "persistent compile cache to .xla-cache so "
+                        "repeated runs show cache hits")
+    s.add_argument("--telemetry-out", metavar="FILE",
+                   default="telemetry.jsonl",
+                   help="where --telemetry appends its JSONL record")
     s.set_defaults(func=run_simulate)
 
     k = sub.add_parser(
@@ -107,6 +120,12 @@ def register(sub) -> None:
                         "bigquery:project.dataset.table or "
                         "gcs:gs://bucket/path (the collector's upload "
                         "hook, fortio.py:235-242); repeatable")
+    w.add_argument("--telemetry", nargs="?", const="on",
+                   choices=("on", "detail"), default=None,
+                   help="emit engine self-telemetry per run: "
+                        "isotope_engine_* series in each .prom artifact "
+                        "plus <out>/telemetry.jsonl ('detail' adds "
+                        "segment fences — diagnosis, not benchmarking)")
     w.set_defaults(func=run_sweep)
 
     p = sub.add_parser(
@@ -137,8 +156,23 @@ def _require_jax() -> None:
 def run_simulate(args) -> int:
     # jax-dependent imports stay inside the handler so `--help` is instant
     _require_jax()
-    from isotope_tpu.compiler.cache import enable_persistent_cache
+    import os
 
+    from isotope_tpu import telemetry
+    from isotope_tpu.compiler.cache import ENV_CACHE_DIR, enable_persistent_cache
+
+    if args.telemetry:
+        telemetry.enable(detail=args.telemetry == "detail")
+        if (args.telemetry == "on" and args.compile_cache is None
+                and ENV_CACHE_DIR not in os.environ):
+            # any explicit env setting — including the disable values
+            # "", "0", "off", "none" — wins over this default
+            # telemetry runs measure cache effectiveness: default the
+            # persistent cache on (bench.py's .xla-cache convention) so
+            # a second identical run shows persistent_cache_hits > 0.
+            # Detail mode is excluded: eager execution compiles op-by-op
+            # and would fill the cache with per-primitive noise.
+            args.compile_cache = ".xla-cache"
     enable_persistent_cache(args.compile_cache)
     from isotope_tpu.runner.config import (
         DEFAULT_ENVIRONMENTS,
@@ -180,6 +214,11 @@ def run_simulate(args) -> int:
     if args.prometheus:
         with open(args.prometheus, "w") as f:
             f.write(result.prometheus_text)
+    if args.telemetry and result.telemetry is not None:
+        rec = telemetry.RunTelemetry.from_dict(result.telemetry)
+        rec.append_jsonl(args.telemetry_out)
+        print(f"{telemetry.summary_line()} -> {args.telemetry_out}",
+              file=sys.stderr)
     if args.trace:
         # traces are sampled: re-run a small dense batch (the load path
         # keeps only histograms, like the reference's samplers)
@@ -293,6 +332,10 @@ def run_sweep(args) -> int:
     _require_jax()
     from isotope_tpu.compiler.cache import enable_persistent_cache
 
+    if args.telemetry:
+        from isotope_tpu import telemetry
+
+        telemetry.enable(detail=args.telemetry == "detail")
     enable_persistent_cache(args.compile_cache)
     from isotope_tpu.runner.config import load_toml
     from isotope_tpu.runner.run import run_experiment

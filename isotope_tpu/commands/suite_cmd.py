@@ -33,12 +33,23 @@ def register(sub) -> None:
                    help="persistent XLA compilation cache directory "
                         "(default: $ISOTOPE_COMPILE_CACHE); a suite "
                         "re-run of the same topology set skips XLA")
+    s.add_argument("--telemetry", nargs="?", const="on",
+                   choices=("on", "detail"), default=None,
+                   help="emit engine self-telemetry per run: "
+                        "isotope_engine_* series in each .prom artifact "
+                        "plus a telemetry.jsonl per config ('detail' "
+                        "adds segment fences — diagnosis, not "
+                        "benchmarking)")
     s.set_defaults(func=run_suite_cmd)
 
 
 def run_suite_cmd(args) -> int:
     from isotope_tpu.compiler.cache import enable_persistent_cache
 
+    if args.telemetry:
+        from isotope_tpu import telemetry
+
+        telemetry.enable(detail=args.telemetry == "detail")
     enable_persistent_cache(args.compile_cache)
     from isotope_tpu.runner.suite import run_suite
 

@@ -24,6 +24,8 @@ from __future__ import annotations
 import dataclasses
 from typing import List, Sequence, Tuple, Union
 
+from isotope_tpu import telemetry
+
 #: padded-elements / real-elements budget for one bucket (see plan_segments)
 DEFAULT_WASTE = 1.6
 
@@ -155,9 +157,68 @@ def plan_segments(
         else:
             segs.append(UnrolledLevelPlan(i))
             i += 1
+    _record_plan(shapes, segs)
     return segs
 
 
 def plan_signature(segs: Sequence[Segment]) -> tuple:
     """Hashable shape signature of a plan — part of the AOT cache key."""
     return tuple(s.signature() for s in segs)
+
+
+def plan_stats(shapes: Sequence[LevelShape],
+               segs: Sequence[Segment]) -> dict:
+    """Padding/coverage accounting of one plan (telemetry + tests).
+
+    ``padded_elems`` / ``real_elems`` count only the SCAN buckets —
+    unrolled islands pay no padding — so ``padding_waste_fraction`` is
+    the fraction of bucket element-slots that are pure padding.
+    """
+    buckets_list = [s for s in segs if isinstance(s, ScanBucketPlan)]
+    padded = real = 0
+    per_bucket = []
+    for b in buckets_list:
+        members = shapes[b.d0:b.d1 + 1]
+        bounds = (b.bound_hops, b.bound_steps, b.bound_calls,
+                  b.bound_attempts)
+        p = _bucket_cost(members, bounds)
+        r = _real_cost(members)
+        padded += p
+        real += r
+        per_bucket.append(
+            {"d0": b.d0, "d1": b.d1, "levels": b.num_levels,
+             "padded_elems": p, "real_elems": r,
+             "padded_rows": b.num_levels * b.bound_hops
+             - sum(s.size for s in members)}
+        )
+    return {
+        "num_segments": len(segs),
+        "num_buckets": len(buckets_list),
+        "levels_bucketed": sum(b.num_levels for b in buckets_list),
+        "levels_unrolled": len(segs) - len(buckets_list),
+        "padded_elems": padded,
+        "real_elems": real,
+        "padding_waste_fraction": (
+            (padded - real) / padded if padded else 0.0
+        ),
+        "buckets": per_bucket,
+    }
+
+
+def _record_plan(shapes: Sequence[LevelShape],
+                 segs: Sequence[Segment]) -> None:
+    """Fold one plan's stats into the engine telemetry registry."""
+    st = plan_stats(shapes, segs)
+    telemetry.counter_inc("bucket_plans")
+    telemetry.counter_inc("buckets_formed", st["num_buckets"])
+    telemetry.counter_inc("levels_bucketed", st["levels_bucketed"])
+    telemetry.counter_inc("levels_unrolled", st["levels_unrolled"])
+    telemetry.counter_inc("bucket_padded_elems", st["padded_elems"])
+    telemetry.counter_inc("bucket_real_elems", st["real_elems"])
+    telemetry.counter_inc(
+        "bucket_padded_rows",
+        sum(b["padded_rows"] for b in st["buckets"]),
+    )
+    telemetry.gauge_set(
+        "bucket_padding_waste_fraction", st["padding_waste_fraction"]
+    )

@@ -22,9 +22,14 @@ XLA again:
 from __future__ import annotations
 
 import hashlib
+import logging
 import os
 from collections import OrderedDict
-from typing import Callable, Optional
+from typing import Callable, List, Optional
+
+from isotope_tpu import telemetry
+
+logger = logging.getLogger(__name__)
 
 #: env knob for the persistent compilation cache directory; the values
 #: "", "0", "off" and "none" (case-insensitive) disable it explicitly.
@@ -53,6 +58,10 @@ def enable_persistent_cache(path: Optional[str] = None) -> Optional[str]:
     path = os.path.abspath(os.path.expanduser(str(path)))
     if _persistent_dir == path:
         return path
+    # persistent-cache hit/miss counts come from jax's own monitoring
+    # events — subscribe before anything compiles through the cache
+    telemetry.install_jax_hooks()
+    telemetry.counter_inc("persistent_cache_enables")
     import jax
 
     os.makedirs(path, exist_ok=True)
@@ -128,18 +137,51 @@ class ExecutableCache:
         self._fns: "OrderedDict[tuple, object]" = OrderedDict()
         self.hits = 0
         self.misses = 0
+        self.evictions = 0
+
+    @staticmethod
+    def key_digest(key: tuple) -> str:
+        """Short stable digest of a cache key (log/stats identity)."""
+        return hashlib.sha256(repr(key).encode()).hexdigest()[:12]
 
     def get_or_build(self, key: tuple, build: Callable[[], object]):
         if key in self._fns:
             self.hits += 1
+            telemetry.counter_inc("executable_cache_hits")
             self._fns.move_to_end(key)
             return self._fns[key]
         self.misses += 1
+        telemetry.counter_inc("executable_cache_misses")
         fn = build()
         self._fns[key] = fn
         while len(self._fns) > self.max_entries:
             self._fns.popitem(last=False)
+            self.evictions += 1
+            telemetry.counter_inc("executable_cache_evictions")
+        telemetry.gauge_set("executable_cache_entries", len(self._fns))
+        logger.debug(
+            "executable-cache miss #%d key=%s (hits=%d entries=%d)",
+            self.misses, self.key_digest(key), self.hits, len(self._fns),
+        )
         return fn
+
+    def cache_stats(self) -> dict:
+        """Introspection: counts plus the resident keys' digests."""
+        keys: List[str] = [self.key_digest(k) for k in self._fns]
+        return {
+            "hits": self.hits,
+            "misses": self.misses,
+            "evictions": self.evictions,
+            "entries": len(self._fns),
+            "max_entries": self.max_entries,
+            "keys": keys,
+        }
+
+    def reset_stats(self) -> None:
+        """Zero the counters WITHOUT dropping entries (test hook)."""
+        self.hits = 0
+        self.misses = 0
+        self.evictions = 0
 
     def __contains__(self, key: tuple) -> bool:
         return key in self._fns
@@ -151,7 +193,13 @@ class ExecutableCache:
         self._fns.clear()
         self.hits = 0
         self.misses = 0
+        self.evictions = 0
 
 
 #: the process-wide instance every Simulator / ShardedSimulator consults
 executable_cache = ExecutableCache()
+
+
+def cache_stats() -> dict:
+    """Stats of the process-wide executable cache (see ExecutableCache)."""
+    return executable_cache.cache_stats()

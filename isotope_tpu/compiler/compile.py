@@ -20,6 +20,7 @@ from typing import List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from isotope_tpu import telemetry
 from isotope_tpu.compiler.program import CompiledGraph, HopLevel, ServiceTable
 from isotope_tpu.models.graph import ServiceGraph
 from isotope_tpu.models.script import (
@@ -133,6 +134,19 @@ def compile_graph(
     the reference's Fortio client is pointed at
     (isotope/convert/pkg/kubernetes/fortio_client.go:28-78).
     """
+    with telemetry.phase("compile.unroll"):
+        compiled = _compile_graph(graph, entry, max_hops)
+    telemetry.counter_inc("graphs_compiled")
+    telemetry.gauge_set("last_graph_hops", compiled.num_hops)
+    telemetry.gauge_set("last_graph_levels", len(compiled.levels))
+    return compiled
+
+
+def _compile_graph(
+    graph: ServiceGraph,
+    entry: Optional[str],
+    max_hops: int,
+) -> CompiledGraph:
     if not graph.services:
         raise NoEntrypointError()
     names = tuple(s.name for s in graph.services)

@@ -26,6 +26,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
+from isotope_tpu import telemetry
 from isotope_tpu.compiler.cache import (
     enable_persistent_cache,
     executable_cache,
@@ -171,13 +172,40 @@ class ShardedSimulator:
         sat_conns = (
             load.connections if self.sim._saturated(load) else 0
         )
-        return self._get(block, num_blocks, load.kind, conns_local, trim,
-                         sat_conns)(
+        # shard balance: the rows actually simulated are num_blocks *
+        # block per shard (shard fill + connection rounding + block
+        # rounding), so the gauge is the fraction simulated beyond the
+        # request count asked for — the parallel path's padding waste
+        telemetry.counter_inc("sharded_runs")
+        telemetry.gauge_set("shard_count", self.n_shards)
+        telemetry.gauge_set(
+            "shard_rows_imbalance_fraction",
+            (num_blocks * block * self.n_shards - num_requests)
+            / max(num_requests, 1),
+        )
+        fn = self._get(block, num_blocks, load.kind, conns_local, trim,
+                       sat_conns)
+        # args_put covers building + transferring the per-run argument
+        # tables (visit fixed points, phase windows) to the devices; the
+        # explicit put + block is DETAIL-ONLY so the default path keeps
+        # its async dispatch (no added sync points)
+        with telemetry.phase("sharded.args_put"):
+            vis = self.sim._vis_arg(float(offered))
+            windows = self.sim._windows_arg(float(offered), sat_conns > 0)
+            if telemetry.detail_enabled():
+                vis = jax.device_put(vis)
+                windows = jax.device_put(windows)
+                jax.block_until_ready((vis, windows))
+        out = fn(
             key, offered, gap, nominal_gap,
             jnp.float32(window[0]), jnp.float32(window[1]),
-            self.sim._vis_arg(float(offered)),
-            self.sim._windows_arg(float(offered), sat_conns > 0),
+            vis, windows,
         )
+        if telemetry.detail_enabled():
+            with telemetry.phase("sharded.gather"):
+                jax.block_until_ready(out.count)
+            telemetry.record_device_memory()
+        return out
 
     # ------------------------------------------------------------------
 

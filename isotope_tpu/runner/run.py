@@ -28,6 +28,7 @@ from typing import List, Optional, Sequence
 
 import jax
 
+from isotope_tpu import telemetry
 from isotope_tpu.compiler import compile_graph
 from isotope_tpu.metrics.fortio import (
     DEFAULT_CSV_KEYS,
@@ -54,6 +55,9 @@ class RunResult:
     window: WindowSummary
     fortio_json: dict
     prometheus_text: str
+    # engine self-telemetry snapshot (RunTelemetry.to_dict()); None when
+    # telemetry emission is off or the run was restored from checkpoint
+    telemetry: Optional[dict] = None
 
 
 def _label(topo_path: str, env: str, load: LoadModel, extra: str) -> str:
@@ -266,6 +270,12 @@ def run_experiment(
                         continue
                     if progress:
                         progress(label)
+                    if telemetry.emitting():
+                        # per-run records: each telemetry.jsonl line
+                        # covers exactly ONE run (the README reading
+                        # guide depends on it) — reset before this
+                        # run's simulators build/compile/execute
+                        telemetry.reset()
                     run_key = jax.random.fold_in(key, run_index)
                     sim, sharded = topo.sims(env)
                     n = _num_requests(
@@ -317,6 +327,13 @@ def run_experiment(
                     # full exposition: the five service series plus the
                     # sim-side resource series the alarm queries read
                     prom_text = topo.collector.full_text(summary)
+                    run_telem = None
+                    if telemetry.emitting():
+                        # one scrape sees workload AND engine: append
+                        # the isotope_engine_* series to the exposition
+                        telemetry.record_device_memory()
+                        run_telem = telemetry.snapshot(label=label)
+                        prom_text += run_telem.prometheus_text()
                     result = RunResult(
                         label=label,
                         topology=topo_path,
@@ -325,6 +342,9 @@ def run_experiment(
                         window=window,
                         fortio_json=doc,
                         prometheus_text=prom_text,
+                        telemetry=(
+                            run_telem.to_dict() if run_telem else None
+                        ),
                     )
                     results.append(result)
                     if out is not None:
@@ -333,6 +353,8 @@ def run_experiment(
                         with open(out / f"{label}.json", "w") as f:
                             json.dump(doc, f, indent=2)
                         (out / f"{label}.prom").write_text(prom_text)
+                        if run_telem is not None:
+                            run_telem.append_jsonl(out / "telemetry.jsonl")
                         ckpt_file.write(
                             json.dumps(
                                 {

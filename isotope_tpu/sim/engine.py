@@ -37,7 +37,9 @@ their specialized unrolled per-level trace, bit-identical either way.
 """
 from __future__ import annotations
 
+import contextlib
 import dataclasses
+import time
 from functools import partial
 from typing import Dict, List, NamedTuple, Optional, Sequence, Tuple
 
@@ -45,6 +47,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from isotope_tpu import telemetry
 from isotope_tpu.compiler import buckets
 from isotope_tpu.compiler.cache import array_digest, executable_cache
 from isotope_tpu.compiler.program import CompiledGraph, hop_wire_times
@@ -201,6 +204,11 @@ class Simulator:
         churn: Sequence[TrafficSplit] = (),
         mtls: Optional[MtlsSchedule] = None,
     ):
+        # engine.build covers everything below: device-constant upload,
+        # bucket planning, copula tables — the host-side cost a compile
+        # report should show next to trace/lower/backend seconds
+        telemetry.install_jax_hooks()
+        _t_build = time.perf_counter()
         self.compiled = compiled
         self.params = params
         # auto-mTLS switching: a time-phased extra one-way latency on
@@ -957,6 +965,8 @@ class Simulator:
         self._fns: Dict[Tuple[int, str, bool], "jax.stages.Wrapped"] = {}
         self._summary_fns: Dict[tuple, "jax.stages.Wrapped"] = {}
         self._rate_cache: Dict[tuple, float] = {}
+        telemetry.counter_inc("simulators_built")
+        telemetry.phase_add("engine.build", time.perf_counter() - _t_build)
 
     def _phase_reach_multipliers(self, svc_down_np: np.ndarray) -> np.ndarray:
         """(P, H) static reach multipliers from outage-driven script
@@ -1313,12 +1323,13 @@ class Simulator:
         few pilot iterations before the full run.
         """
         if load.kind == OPEN_LOOP:
-            return self._get(num_requests, OPEN_LOOP)(
-                key, jnp.float32(load.qps), jnp.float32(0.0),
-                jnp.float32(load.qps), jnp.float32(0.0),
-                visits_pc=self._vis_arg(load.qps),
-                phase_windows=self._windows_arg(load.qps, False),
-            )
+            with self._detail_ctx():
+                return self._get(num_requests, OPEN_LOOP)(
+                    key, jnp.float32(load.qps), jnp.float32(0.0),
+                    jnp.float32(load.qps), jnp.float32(0.0),
+                    visits_pc=self._vis_arg(load.qps),
+                    phase_windows=self._windows_arg(load.qps, False),
+                )
         lam = self.solve_closed_rate(load, num_requests, key,
                                      fixed_point_iters)
         gap = (
@@ -1332,12 +1343,23 @@ class Simulator:
         # would silently skip chaos phases.
         nominal_gap = jnp.float32(load.connections / lam)
         sat = self._saturated(load)
-        return self._get(num_requests, CLOSED_LOOP, load.connections,
-                         sat=sat)(
-            key, jnp.float32(lam), gap, jnp.float32(lam), nominal_gap,
-            visits_pc=self._vis_arg(lam),
-            phase_windows=self._windows_arg(lam, sat),
-        )
+        with self._detail_ctx():
+            return self._get(num_requests, CLOSED_LOOP, load.connections,
+                             sat=sat)(
+                key, jnp.float32(lam), gap, jnp.float32(lam), nominal_gap,
+                visits_pc=self._vis_arg(lam),
+                phase_windows=self._windows_arg(lam, sat),
+            )
+
+    @staticmethod
+    def _detail_ctx():
+        """Telemetry detail mode runs the tensor program EAGERLY (under
+        ``jax.disable_jit``) so the per-segment fences see concrete
+        arrays and can block at segment boundaries.  Fences serialize
+        dispatch — detail mode is for diagnosis, not benchmarking."""
+        if telemetry.detail_enabled():
+            return jax.disable_jit()
+        return contextlib.nullcontext()
 
     def _saturated(self, load: LoadModel) -> bool:
         """True when the run uses the finite-population (MVA) wait law:
@@ -1500,13 +1522,16 @@ class Simulator:
         sat = self._saturated(load)
         fn = self._get_summary(block, num_blocks, load.kind, conns,
                                collector, trim, sat=sat)
-        return fn(
-            key, jnp.float32(offered), jnp.float32(pace),
-            jnp.float32(offered), jnp.float32(nominal),
-            jnp.float32(window[0]), jnp.float32(window[1]),
-            self._vis_arg(offered),
-            self._windows_arg(offered, sat),
-        )
+        telemetry.gauge_set("engine_block_requests", block)
+        telemetry.gauge_set("engine_num_blocks", num_blocks)
+        with self._detail_ctx():
+            return fn(
+                key, jnp.float32(offered), jnp.float32(pace),
+                jnp.float32(offered), jnp.float32(nominal),
+                jnp.float32(window[0]), jnp.float32(window[1]),
+                self._vis_arg(offered),
+                self._windows_arg(offered, sat),
+            )
 
     def default_block_size(self, budget_elems: int = 33_554_432) -> int:
         """A block size keeping each (block, H) event tensor near
@@ -1542,8 +1567,11 @@ class Simulator:
             # skips retracing AND recompiling
             self._fns[key] = executable_cache.get_or_build(
                 ("simulate", self.signature) + key,
-                lambda: jax.jit(
-                    partial(self._simulate, n, kind, connections, sat)
+                lambda: telemetry.time_first_call(
+                    jax.jit(
+                        partial(self._simulate, n, kind, connections, sat)
+                    ),
+                    "compile.jit_first_call",
                 ),
             )
         return self._fns[key]
@@ -1563,6 +1591,12 @@ class Simulator:
             def scanfn(key, offered_qps, pace_gap, arrival_qps,
                        nominal_gap, win_lo, win_hi, visits_pc,
                        phase_windows):
+                telemetry.record_trace(
+                    ("summary", self.signature[3]) + cache_key,
+                    tracing=isinstance(key, jax.core.Tracer),
+                    requests=block, hops=self.compiled.num_hops,
+                )
+
                 def body(carry, b):
                     t0, conn_t0, req_off = carry
                     # disjoint fold domain: the closed-loop rate solver's
@@ -1594,7 +1628,9 @@ class Simulator:
 
             self._summary_fns[cache_key] = executable_cache.get_or_build(
                 ("summary", self.signature) + cache_key,
-                lambda: jax.jit(scanfn),
+                lambda: telemetry.time_first_call(
+                    jax.jit(scanfn), "compile.jit_first_call"
+                ),
             )
         return self._summary_fns[cache_key]
 
@@ -1638,6 +1674,15 @@ class Simulator:
         phase_windows: Optional[jax.Array] = None,
     ) -> SimResults:
         """One self-contained block starting at t=0 (see _simulate_core)."""
+        # host-side telemetry: this body executes once per TRACE (jit)
+        # or once per eager call (detail mode) — never per request, so
+        # the counters survive the jit boundary by construction, and a
+        # repeated trace of one signature is a retrace detection
+        telemetry.record_trace(
+            ("simulate", self.signature[3], n, kind, connections, sat),
+            tracing=isinstance(key, jax.core.Tracer),
+            requests=n, hops=self.compiled.num_hops,
+        )
         if nominal_gap is None:
             nominal_gap = pace_gap
         c = max(connections, 1)
@@ -1686,6 +1731,7 @@ class Simulator:
         count — the ``-qps max`` mode where the open-loop M/M/k law
         misrepresents the C-bounded sojourn tail (ORACLE.md)."""
         H = self.compiled.num_hops
+        telemetry.fence_reset()
         any_copula = self._copula_active or self._retry_active
         if any_copula:
             (k_send, k_err, k_wait_u, k_svc, k_arr, k_wait2,
@@ -2088,6 +2134,9 @@ class Simulator:
                 lat_lvls[d0] = ys["lat"][0][:, :s0]
                 if self._track_err:
                     err_lvls[d0] = ys["err"][0][:, :s0]
+                telemetry.segment_fence(
+                    f"up.scan[{d0}-{d1}]", lat_lvls[d0]
+                )
                 continue
             d = _idx
             lvl = self._levels[d]
@@ -2366,6 +2415,7 @@ class Simulator:
                         used_lvls[d] * att_off[:, : lvl.num_children]
                     )
                 off_lvls[d] = off
+            telemetry.segment_fence(f"up.lvl[{d}]", lat_lvls[d])
 
         # ---- downward pass: which hops actually execute ------------------
         # a down ENTRY service refuses the client's connection itself
@@ -2450,6 +2500,7 @@ class Simulator:
             entry_wire = entry_wire + tax
         start_cur: jax.Array = (arrivals + entry_wire)[:, None]
         start_chunks: List[jax.Array] = []
+        telemetry.fence_reset()
         for si, seg in enumerate(self._segments):
             if isinstance(seg, levelscan.ScanBucket):
                 own, start_cur = levelscan.start_sweep(
@@ -2459,9 +2510,14 @@ class Simulator:
                 start_chunks.append(
                     levelscan.gather_levels(own, seg.sizes)
                 )
+                telemetry.segment_fence(
+                    f"start.scan[{seg.plan.d0}-{seg.plan.d1}]",
+                    start_chunks[-1],
+                )
                 continue
             d = seg.d
             start_chunks.append(start_cur)
+            telemetry.segment_fence(f"start.lvl[{d}]", start_cur)
             if d >= last_level:
                 continue
             lvl = self._levels[d]

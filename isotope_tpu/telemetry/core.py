@@ -1,0 +1,538 @@
+"""Engine self-telemetry: counters, gauges, phase timers, run records.
+
+The simulated *workload* is already observable (metrics/prometheus.py
+renders the reference's five service series), but the engine that runs
+it — level bucketing, padding, the two-layer compile cache, segment
+scheduling, mesh sharding — made consequential decisions invisibly.
+This module is the always-on instrumentation layer those decisions
+report through:
+
+- **Counters / gauges / phase timers** live in one process-wide
+  registry (plain host dicts — recording is a dict update, never a
+  device op).  Instrumented code calls :func:`counter_inc`,
+  :func:`gauge_set` / :func:`gauge_max`, and ``with phase("name"):``
+  unconditionally; the cost is negligible and nothing is traced into
+  compiled programs.  Counters recorded inside a jitted function body
+  therefore count *traces* (host executions), not executed requests —
+  which is exactly what makes them retrace detectors.
+- **JAX monitoring hooks** (:func:`install_jax_hooks`) subscribe to
+  jax's own event stream, splitting compile wall time into trace /
+  lower / backend-compile phases and counting persistent-compilation-
+  cache hits and misses — measurements the engine could not take from
+  the outside.
+- **Detail mode** (:func:`enable` with ``detail=True``) additionally
+  arms :func:`segment_fence`: the engine executes eagerly (under
+  ``jax.disable_jit``) and blocks at segment boundaries so each scan
+  bucket / unrolled island gets its own wall-time phase.  The fences
+  serialize dispatch, so detail mode is for *diagnosis*, not
+  benchmarking; with detail off the fence helper returns before
+  touching jax (zero added sync points — tests/test_telemetry.py pins
+  this with a fence-counter monkeypatch).
+- **Exposition**: :func:`snapshot` freezes the registry into a
+  :class:`RunTelemetry` record that serializes to ``telemetry.jsonl``
+  lines, and :func:`prometheus_text` renders the same state as
+  ``isotope_engine_*`` Prometheus series so one scrape sees the
+  workload *and* the engine.
+
+jax is imported lazily throughout: the converter-only environment
+(no jax installed) can still import this module.
+"""
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+import json
+import time
+from typing import Any, Dict, Iterator, List, Optional, Set
+
+SCHEMA = "isotope-engine-telemetry/v1"
+
+#: jax duration events -> phase names (the trace/lower/compile split)
+_JAX_EVENT_PHASES = {
+    "/jax/core/compile/jaxpr_trace_duration": "compile.trace",
+    "/jax/core/compile/jaxpr_to_mlir_module_duration": "compile.lower",
+    "/jax/core/compile/backend_compile_duration": "compile.backend",
+    "/jax/compilation_cache/cache_retrieval_time_sec":
+        "compile.persistent_read",
+    "/jax/compilation_cache/compile_time_saved_sec":
+        "compile.persistent_saved",
+}
+
+#: jax counter events -> counter names (persistent-cache visibility)
+_JAX_EVENT_COUNTERS = {
+    "/jax/compilation_cache/cache_hits": "persistent_cache_hits",
+    "/jax/compilation_cache/cache_misses": "persistent_cache_misses",
+}
+
+
+class _State:
+    """The process-wide registry (one instance, module-level)."""
+
+    def __init__(self) -> None:
+        self.counters: Dict[str, float] = {}
+        self.gauges: Dict[str, float] = {}
+        self.phases: Dict[str, float] = {}
+        self.emit = False          # artifact emission requested (--telemetry)
+        self.detail = False        # segment fencing armed (--telemetry=detail)
+        self.trace_keys: Set[tuple] = set()
+        self.last_fence_t: Optional[float] = None
+
+
+_STATE = _State()
+_HOOKS_INSTALLED = False
+
+
+# -- mode switches ---------------------------------------------------------
+
+def enable(detail: bool = False) -> None:
+    """Request artifact emission (and optionally detail-mode fencing)."""
+    _STATE.emit = True
+    _STATE.detail = bool(detail)
+
+
+def disable() -> None:
+    _STATE.emit = False
+    _STATE.detail = False
+
+
+def emitting() -> bool:
+    """Whether the caller asked for telemetry artifacts (``--telemetry``)."""
+    return _STATE.emit
+
+
+def detail_enabled() -> bool:
+    return _STATE.detail
+
+
+def reset() -> None:
+    """Clear every counter/gauge/phase (tests, per-bench-case isolation).
+
+    Leaves the emit/detail switches and installed jax hooks in place.
+    """
+    _STATE.counters.clear()
+    _STATE.gauges.clear()
+    _STATE.phases.clear()
+    _STATE.trace_keys.clear()
+    _STATE.last_fence_t = None
+
+
+# -- counters / gauges / phases --------------------------------------------
+
+def counter_inc(name: str, n: float = 1.0) -> None:
+    _STATE.counters[name] = _STATE.counters.get(name, 0.0) + n
+
+
+def counter_get(name: str) -> float:
+    return _STATE.counters.get(name, 0.0)
+
+
+def _gauge_key(name: str, labels: Dict[str, Any]) -> str:
+    if not labels:
+        return name
+    inner = ",".join(f'{k}="{v}"' for k, v in sorted(labels.items()))
+    return f"{name}{{{inner}}}"
+
+
+def gauge_set(name: str, value: float, **labels: Any) -> None:
+    _STATE.gauges[_gauge_key(name, labels)] = float(value)
+
+
+def gauge_max(name: str, value: float, **labels: Any) -> None:
+    """High-water gauge: keeps the max ever observed (device memory)."""
+    key = _gauge_key(name, labels)
+    prev = _STATE.gauges.get(key)
+    if prev is None or value > prev:
+        _STATE.gauges[key] = float(value)
+
+
+def gauge_get(name: str, **labels: Any) -> Optional[float]:
+    return _STATE.gauges.get(_gauge_key(name, labels))
+
+
+def phase_add(name: str, seconds: float) -> None:
+    _STATE.phases[name] = _STATE.phases.get(name, 0.0) + seconds
+
+
+def phase_seconds(name: str) -> float:
+    return _STATE.phases.get(name, 0.0)
+
+
+@contextlib.contextmanager
+def phase(name: str) -> Iterator[None]:
+    """Accumulating wall-clock phase timer.
+
+    Re-entering the same name sums; nested phases time independently,
+    so an enclosing phase's seconds include its children's (each name
+    is its own accumulator — there is no implicit hierarchy).
+    """
+    t0 = time.perf_counter()
+    try:
+        yield
+    finally:
+        phase_add(name, time.perf_counter() - t0)
+
+
+def time_first_call(fn, phase_name: str, counter: str = "jit_first_calls"):
+    """Wrap a callable so its FIRST invocation is phase-timed.
+
+    Used on jitted entry points: jax compiles synchronously inside the
+    first call, so its wall time is the trace+lower+compile cost (plus
+    one async dispatch — no fence is added).  Later calls pay one
+    attribute check.
+    """
+
+    class _Timed:
+        __slots__ = ("_fn", "_first_done")
+
+        def __init__(self, inner):
+            self._fn = inner
+            self._first_done = False
+
+        def __call__(self, *args, **kwargs):
+            if self._first_done:
+                return self._fn(*args, **kwargs)
+            if detail_enabled():
+                # detail mode executes eagerly (jax.disable_jit): the
+                # call's wall time is the whole run, not a compile —
+                # leave the first-call slot open for a real jitted call
+                return self._fn(*args, **kwargs)
+            t0 = time.perf_counter()
+            out = self._fn(*args, **kwargs)
+            phase_add(phase_name, time.perf_counter() - t0)
+            counter_inc(counter)
+            self._first_done = True
+            return out
+
+        def __getattr__(self, item):  # lower()/compile() passthrough
+            return getattr(self._fn, item)
+
+    return _Timed(fn)
+
+
+# -- engine hooks ----------------------------------------------------------
+
+def record_trace(sig: tuple, tracing: bool, **shape_gauges: float) -> None:
+    """Called host-side from the engine's tensor-program body.
+
+    ``tracing=True`` means the body is executing under a jit trace: the
+    first trace of a signature counts as ``engine_traces``, any repeat
+    as ``engine_retraces`` (the retrace detector).  ``tracing=False``
+    is an eager (detail-mode) execution and counts separately.  Shape
+    gauges (requests/hops per batch) record either way.
+    """
+    if tracing:
+        counter_inc("engine_traces")
+        if sig in _STATE.trace_keys:
+            counter_inc("engine_retraces")
+        else:
+            _STATE.trace_keys.add(sig)
+    else:
+        counter_inc("engine_eager_calls")
+    for k, v in shape_gauges.items():
+        gauge_set(f"engine_last_{k}", v)
+
+
+def fence_reset() -> None:
+    """Start a new fence epoch (called at the top of a sweep)."""
+    _STATE.last_fence_t = None
+
+
+def segment_fence(label: str, value) -> None:
+    """Detail-mode-only blocking fence at a segment boundary.
+
+    Records the wall time since the previous fence (dispatch + device
+    execution of this segment) under ``segment.<label>``.  With detail
+    off this returns before touching jax — the default path gains zero
+    sync points.  Tracer inputs (a jitted trace in flight) are skipped:
+    fencing is only meaningful on concrete arrays.
+    """
+    if not _STATE.detail or value is None:
+        return
+    import jax
+
+    if isinstance(value, jax.core.Tracer):
+        return
+    t_prev = _STATE.last_fence_t
+    if t_prev is None:
+        t_prev = time.perf_counter()
+    jax.block_until_ready(value)
+    t1 = time.perf_counter()
+    counter_inc("engine_fences")
+    phase_add(f"segment.{label}", t1 - t_prev)
+    _STATE.last_fence_t = t1
+
+
+def record_device_memory() -> Optional[float]:
+    """High-water per-device memory gauges via ``Device.memory_stats()``.
+
+    Returns the max peak bytes across devices, or ``None`` where the
+    backend exposes no stats (CPU).
+    """
+    try:
+        import jax
+
+        devices = jax.local_devices()
+    except Exception:
+        return None
+    peak = None
+    for d in devices:
+        try:
+            ms = d.memory_stats()
+        except Exception:
+            ms = None
+        if not ms:
+            continue
+        v = ms.get("peak_bytes_in_use", ms.get("bytes_in_use"))
+        if v is None:
+            continue
+        gauge_max("device_memory_peak_bytes", float(v), device=str(d.id))
+        peak = max(peak or 0.0, float(v))
+    if peak is not None:
+        gauge_max("device_memory_peak_bytes_max", peak)
+    return peak
+
+
+def install_jax_hooks() -> bool:
+    """Subscribe to jax's monitoring stream (idempotent).
+
+    Maps compile-pipeline duration events onto the ``compile.*`` phases
+    and persistent-compilation-cache events onto counters.  Returns
+    whether the hooks are (now) installed.
+    """
+    global _HOOKS_INSTALLED
+    if _HOOKS_INSTALLED:
+        return True
+    try:
+        from jax import monitoring
+    except Exception:  # pragma: no cover - converter-only env
+        return False
+
+    def _under_disable_jit() -> bool:
+        # eager (detail-mode) execution compiles op-by-op: those
+        # per-primitive cache/compile events would drown the program-
+        # level numbers these hooks exist to surface
+        try:
+            import jax
+
+            return bool(jax.config.jax_disable_jit)
+        except Exception:  # pragma: no cover - defensive
+            return False
+
+    def _on_duration(event, duration, *args, **kwargs):
+        name = _JAX_EVENT_PHASES.get(event)
+        if name is not None and not _under_disable_jit():
+            # clamp at 0: compile_time_saved_sec can go negative (a
+            # cache read costing more than it saved), and a phase is
+            # exported as a Prometheus counter, which must stay >= 0
+            phase_add(name, max(float(duration), 0.0))
+
+    def _on_event(event, *args, **kwargs):
+        name = _JAX_EVENT_COUNTERS.get(event)
+        if name is not None and not _under_disable_jit():
+            counter_inc(name)
+
+    monitoring.register_event_duration_secs_listener(_on_duration)
+    monitoring.register_event_listener(_on_event)
+    _HOOKS_INSTALLED = True
+    return True
+
+
+# -- derived views ---------------------------------------------------------
+
+def summary_block() -> Dict[str, Any]:
+    """The headline numbers every perf report should carry."""
+    c, p, g = _STATE.counters, _STATE.phases, _STATE.gauges
+    hits = c.get("executable_cache_hits", 0.0)
+    misses = c.get("executable_cache_misses", 0.0)
+    total = hits + misses
+    padded = c.get("bucket_padded_elems", 0.0)
+    real = c.get("bucket_real_elems", 0.0)
+    peak = g.get("device_memory_peak_bytes_max")
+    return {
+        "compile_s": round(
+            p.get("compile.trace", 0.0)
+            + p.get("compile.lower", 0.0)
+            + p.get("compile.backend", 0.0),
+            4,
+        ),
+        "trace_s": round(p.get("compile.trace", 0.0), 4),
+        "lower_s": round(p.get("compile.lower", 0.0), 4),
+        "backend_s": round(p.get("compile.backend", 0.0), 4),
+        "cache_hits": int(hits),
+        "cache_misses": int(misses),
+        "cache_hit_ratio": round(hits / total, 4) if total else None,
+        "persistent_cache_hits": int(c.get("persistent_cache_hits", 0.0)),
+        "persistent_cache_misses": int(
+            c.get("persistent_cache_misses", 0.0)
+        ),
+        "padding_waste_fraction": (
+            round((padded - real) / padded, 4) if padded else 0.0
+        ),
+        "peak_device_bytes": peak,
+    }
+
+
+def summary_line() -> str:
+    """One human-readable line over :func:`summary_block` — the shared
+    stderr rendering of the ``simulate --telemetry`` / ``telemetry``
+    commands (one format string, so the two CLIs cannot drift)."""
+    blk = summary_block()
+    peak = blk["peak_device_bytes"]
+    return (
+        "telemetry: compile {compile_s:.2f}s (trace {trace_s:.2f} / "
+        "lower {lower_s:.2f} / backend {backend_s:.2f}), exec-cache "
+        "{cache_hits}h/{cache_misses}m, persistent-cache "
+        "{persistent_cache_hits}h/{persistent_cache_misses}m, padding "
+        "waste {padding_waste_fraction:.1%}, peak device bytes {peak}"
+    ).format(peak="n/a" if peak is None else f"{peak:.0f}", **blk)
+
+
+# -- the per-run record ----------------------------------------------------
+
+@dataclasses.dataclass
+class RunTelemetry:
+    """One frozen snapshot of the registry, serializable to JSONL."""
+
+    label: Optional[str]
+    phases: Dict[str, float]
+    counters: Dict[str, float]
+    gauges: Dict[str, float]
+    meta: Dict[str, Any]
+    schema: str = SCHEMA
+
+    def to_dict(self) -> dict:
+        return {
+            "schema": self.schema,
+            "label": self.label,
+            "phases": self.phases,
+            "counters": self.counters,
+            "gauges": self.gauges,
+            "meta": self.meta,
+        }
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "RunTelemetry":
+        return cls(
+            label=d.get("label"),
+            phases=dict(d.get("phases", {})),
+            counters=dict(d.get("counters", {})),
+            gauges=dict(d.get("gauges", {})),
+            meta=dict(d.get("meta", {})),
+            schema=d.get("schema", SCHEMA),
+        )
+
+    def to_json_line(self) -> str:
+        return json.dumps(self.to_dict(), sort_keys=True)
+
+    def append_jsonl(self, path) -> None:
+        with open(path, "a") as f:
+            f.write(self.to_json_line() + "\n")
+
+    def prometheus_text(self) -> str:
+        return _render_prometheus(self.phases, self.counters, self.gauges)
+
+
+def snapshot(label: Optional[str] = None) -> RunTelemetry:
+    """Freeze the current registry (refreshing device-memory gauges)."""
+    record_device_memory()
+    meta: Dict[str, Any] = {"unix_time": time.time()}
+    try:
+        import jax
+
+        meta["backend"] = jax.default_backend()
+        meta["device_count"] = jax.device_count()
+        meta["jax_version"] = jax.__version__
+    except Exception:  # pragma: no cover - converter-only env
+        pass
+    return RunTelemetry(
+        label=label,
+        phases={k: round(v, 6) for k, v in sorted(_STATE.phases.items())},
+        counters=dict(sorted(_STATE.counters.items())),
+        gauges={k: float(v) for k, v in sorted(_STATE.gauges.items())},
+        meta=meta,
+    )
+
+
+# -- Prometheus exposition -------------------------------------------------
+
+def _render_prometheus(phases, counters, gauges) -> str:
+    out: List[str] = []
+    out.append(
+        "# HELP isotope_engine_phase_seconds_total Wall seconds spent in"
+        " each engine phase."
+    )
+    out.append("# TYPE isotope_engine_phase_seconds_total counter")
+    for name, v in sorted(phases.items()):
+        out.append(
+            f'isotope_engine_phase_seconds_total{{phase="{name}"}}'
+            f" {v:.10g}"
+        )
+    out.append(
+        "# HELP isotope_engine_events_total Engine event counters"
+        " (cache hits/misses, buckets formed, traces, fences)."
+    )
+    out.append("# TYPE isotope_engine_events_total counter")
+    for name, v in sorted(counters.items()):
+        out.append(
+            f'isotope_engine_events_total{{event="{name}"}} {v:.10g}'
+        )
+    # gauges carry their own (optional) label block in the key
+    seen_families: Set[str] = set()
+    for key, v in sorted(gauges.items()):
+        family = key.split("{", 1)[0]
+        if family not in seen_families:
+            seen_families.add(family)
+            out.append(
+                f"# HELP isotope_engine_{family} Engine gauge."
+            )
+            out.append(f"# TYPE isotope_engine_{family} gauge")
+        out.append(f"isotope_engine_{key} {v:.10g}")
+    return "\n".join(out) + "\n"
+
+
+def prometheus_text() -> str:
+    """Render the live registry as ``isotope_engine_*`` series."""
+    return _render_prometheus(
+        _STATE.phases, _STATE.counters, _STATE.gauges
+    )
+
+
+# -- JSONL validation (make telemetry-smoke) -------------------------------
+
+def validate_jsonl(path) -> int:
+    """Validate a ``telemetry.jsonl`` file; returns the record count.
+
+    Raises ``ValueError`` on schema violations — the contract the
+    ``make telemetry-smoke`` target enforces.
+    """
+    n = 0
+    with open(path) as f:
+        for i, line in enumerate(f, 1):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                doc = json.loads(line)
+            except json.JSONDecodeError as e:
+                raise ValueError(f"{path}:{i}: not JSON: {e}") from e
+            if doc.get("schema") != SCHEMA:
+                raise ValueError(
+                    f"{path}:{i}: schema {doc.get('schema')!r} != {SCHEMA!r}"
+                )
+            for section in ("phases", "counters", "gauges", "meta"):
+                if not isinstance(doc.get(section), dict):
+                    raise ValueError(
+                        f"{path}:{i}: missing/invalid {section!r} section"
+                    )
+            for section in ("phases", "counters", "gauges"):
+                for k, v in doc[section].items():
+                    if not isinstance(k, str) or not isinstance(
+                        v, (int, float)
+                    ):
+                        raise ValueError(
+                            f"{path}:{i}: {section}[{k!r}] is not numeric"
+                        )
+            n += 1
+    if n == 0:
+        raise ValueError(f"{path}: no telemetry records")
+    return n

@@ -108,6 +108,85 @@ def test_evidence_keys_not_compared(tmp_path, monkeypatch, capsys):
     assert run_gate(tmp_path, monkeypatch, new, base) == 0
 
 
+def test_telemetry_gates_off_by_default(tmp_path, monkeypatch, capsys):
+    # a 10x compile-time and memory blowup passes when no telemetry
+    # threshold env var is armed — the gates are strictly opt-in
+    for var in ("BENCH_REGRESS_COMPILE_THRESHOLD",
+                "BENCH_REGRESS_MEM_THRESHOLD",
+                "BENCH_REGRESS_WASTE_THRESHOLD"):
+        monkeypatch.delenv(var, raising=False)
+    base = capture(2.0e9, {
+        "svc1000": 2.0e9, "svc1000_best": 2.1e9, "svc1000_compile_s": 5.0,
+        "svc1000_telemetry": {"compile_s": 5.0, "peak_device_bytes": 1e8,
+                              "padding_waste_fraction": 0.1},
+    })
+    new = capture(2.0e9, {
+        "svc1000": 2.0e9, "svc1000_best": 2.1e9, "svc1000_compile_s": 50.0,
+        "svc1000_telemetry": {"compile_s": 50.0, "peak_device_bytes": 1e9,
+                              "padding_waste_fraction": 0.9},
+    })
+    assert run_gate(tmp_path, monkeypatch, new, base) == 0
+
+
+def test_telemetry_compile_gate(tmp_path, monkeypatch, capsys):
+    monkeypatch.setenv("BENCH_REGRESS_COMPILE_THRESHOLD", "0.5")
+    base = capture(2.0e9, {
+        "svc1000": 2.0e9, "svc1000_best": 2.1e9, "svc1000_compile_s": 10.0,
+    })
+    new = capture(2.0e9, {
+        "svc1000": 2.0e9, "svc1000_best": 2.1e9, "svc1000_compile_s": 16.0,
+    })
+    assert run_gate(tmp_path, monkeypatch, new, base) == 1
+    assert "svc1000.compile_s" in capsys.readouterr().out
+    # within threshold passes
+    ok = capture(2.0e9, {
+        "svc1000": 2.0e9, "svc1000_best": 2.1e9, "svc1000_compile_s": 14.0,
+    })
+    assert run_gate(tmp_path, monkeypatch, ok, base) == 0
+
+
+def test_telemetry_memory_gate_from_block(tmp_path, monkeypatch, capsys):
+    monkeypatch.setenv("BENCH_REGRESS_MEM_THRESHOLD", "0.2")
+    base = capture(2.0e9, {
+        "svc1000": 2.0e9, "svc1000_best": 2.1e9,
+        "svc1000_telemetry": {"peak_device_bytes": 1.0e8},
+    })
+    new = capture(2.0e9, {
+        "svc1000": 2.0e9, "svc1000_best": 2.1e9,
+        "svc1000_telemetry": {"peak_device_bytes": 1.5e8},
+    })
+    assert run_gate(tmp_path, monkeypatch, new, base) == 1
+    assert "svc1000.peak_device_bytes" in capsys.readouterr().out
+
+
+def test_telemetry_waste_gate_is_absolute(tmp_path, monkeypatch, capsys):
+    monkeypatch.setenv("BENCH_REGRESS_WASTE_THRESHOLD", "0.05")
+    base = capture(2.0e9, {
+        "svc1000": 2.0e9, "svc1000_best": 2.1e9,
+        "svc1000_telemetry": {"padding_waste_fraction": 0.0},
+    })
+    # +0.04 absolute passes even though it is an infinite relative jump
+    ok = capture(2.0e9, {
+        "svc1000": 2.0e9, "svc1000_best": 2.1e9,
+        "svc1000_telemetry": {"padding_waste_fraction": 0.04},
+    })
+    assert run_gate(tmp_path, monkeypatch, ok, base) == 0
+    bad = capture(2.0e9, {
+        "svc1000": 2.0e9, "svc1000_best": 2.1e9,
+        "svc1000_telemetry": {"padding_waste_fraction": 0.12},
+    })
+    assert run_gate(tmp_path, monkeypatch, bad, base) == 1
+    assert "padding_waste_fraction" in capsys.readouterr().out
+
+
+def test_telemetry_block_not_compared_as_rate(tmp_path, monkeypatch):
+    # the embedded dict must never be treated as a per-case rate
+    base = capture(2.0e9, {"svc1000": 2.0e9, "svc1000_best": 2.1e9,
+                           "svc1000_telemetry": {"compile_s": 5.0}})
+    new = capture(2.0e9, {"svc1000": 2.0e9, "svc1000_best": 2.1e9})
+    assert run_gate(tmp_path, monkeypatch, new, base) == 0
+
+
 def test_no_baseline_skips(tmp_path, monkeypatch, capsys):
     new_path = tmp_path / "new.json"
     new_path.write_text(json.dumps(capture(1.0e9, {})))

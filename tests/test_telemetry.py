@@ -1,0 +1,374 @@
+"""Engine self-telemetry (isotope_tpu/telemetry/).
+
+Pins the contracts the tentpole depends on: phase timers nest and sum,
+counters are recorded host-side (once per TRACE, surviving the jit
+boundary), cache hit/miss counts mirror the executable cache, the
+Prometheus exposition parses, telemetry.jsonl round-trips, and —
+critically — telemetry-off mode adds ZERO sync points to the engine's
+default path (asserted via a fence-counter monkeypatch), while detail
+mode fences at segment granularity.
+"""
+import json
+import re
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from isotope_tpu import telemetry
+from isotope_tpu.compiler import buckets, compile_graph
+from isotope_tpu.compiler.cache import cache_stats, executable_cache
+from isotope_tpu.models.graph import ServiceGraph
+from isotope_tpu.sim import LoadModel, SimParams, Simulator
+
+CHAIN = """
+services:
+- name: a
+  isEntrypoint: true
+  script:
+  - call: b
+- name: b
+  script:
+  - call: c
+- name: c
+"""
+
+OPEN = LoadModel(kind="open", qps=100.0)
+KEY = jax.random.PRNGKey(0)
+
+
+@pytest.fixture(autouse=True)
+def clean_registry():
+    """Fresh registry per test; restore the off/off default after."""
+    telemetry.reset()
+    telemetry.disable()
+    yield
+    telemetry.reset()
+    telemetry.disable()
+
+
+def _sim(params=SimParams()):
+    return Simulator(compile_graph(ServiceGraph.from_yaml(CHAIN)), params)
+
+
+# -- phase timers ----------------------------------------------------------
+
+def test_phase_timers_nest_and_sum():
+    with telemetry.phase("outer"):
+        with telemetry.phase("inner"):
+            time.sleep(0.02)
+        with telemetry.phase("inner"):  # re-entry accumulates
+            time.sleep(0.02)
+    assert telemetry.phase_seconds("inner") >= 0.04
+    # the enclosing phase's clock includes its children's
+    assert telemetry.phase_seconds("outer") >= telemetry.phase_seconds(
+        "inner"
+    )
+    # phases are independent accumulators, not a consuming hierarchy
+    with telemetry.phase("outer"):
+        pass
+    assert telemetry.phase_seconds("outer") >= 0.04
+
+
+def test_phase_records_on_exception():
+    with pytest.raises(RuntimeError):
+        with telemetry.phase("boom"):
+            time.sleep(0.01)
+            raise RuntimeError()
+    assert telemetry.phase_seconds("boom") >= 0.01
+
+
+# -- counters across the jit boundary --------------------------------------
+
+def test_counters_recorded_host_side_not_traced():
+    """A counter bumped inside a jitted body counts TRACES, not calls."""
+
+    @jax.jit
+    def f(x):
+        telemetry.counter_inc("traced_bodies")
+        return x * 2.0
+
+    for i in range(3):
+        f(jnp.float32(i)).block_until_ready()
+    assert telemetry.counter_get("traced_bodies") == 1.0
+
+
+def test_engine_trace_and_retrace_detection():
+    telemetry.record_trace(("sig", 1), tracing=True, requests=64, hops=3)
+    telemetry.record_trace(("sig", 2), tracing=True, requests=64, hops=3)
+    assert telemetry.counter_get("engine_traces") == 2.0
+    assert telemetry.counter_get("engine_retraces") == 0.0
+    telemetry.record_trace(("sig", 1), tracing=True, requests=64, hops=3)
+    assert telemetry.counter_get("engine_retraces") == 1.0
+    # eager (detail-mode) executions count separately, never as retraces
+    telemetry.record_trace(("sig", 1), tracing=False, requests=64, hops=3)
+    assert telemetry.counter_get("engine_retraces") == 1.0
+    assert telemetry.counter_get("engine_eager_calls") == 1.0
+    assert telemetry.gauge_get("engine_last_requests") == 64.0
+
+
+# -- cache hit/miss parity with the executable cache -----------------------
+
+def test_cache_counters_match_executable_cache():
+    """The telemetry counters move in lockstep with the cache's own
+    hit/miss counts under the test_compile_cache.py sharing scenario:
+    two identical Simulators share one executable (1 hit), a different
+    request shape misses."""
+    h0 = telemetry.counter_get("executable_cache_hits")
+    m0 = telemetry.counter_get("executable_cache_misses")
+    ch0, cm0 = executable_cache.hits, executable_cache.misses
+    s1, s2 = _sim(), _sim()
+    assert s1._get(48, "open") is s2._get(48, "open")   # miss then hit
+    s2._get(96, "open")                                 # second miss
+    dh = telemetry.counter_get("executable_cache_hits") - h0
+    dm = telemetry.counter_get("executable_cache_misses") - m0
+    assert dh == executable_cache.hits - ch0 == 1
+    assert dm == executable_cache.misses - cm0 == 2
+
+
+def test_cache_stats_introspection():
+    st0 = cache_stats()
+    _sim()._get(52, "open")
+    st = cache_stats()
+    assert st["misses"] == st0["misses"] + 1
+    assert st["entries"] == len(executable_cache)
+    assert len(st["keys"]) == st["entries"]
+    assert all(re.fullmatch(r"[0-9a-f]{12}", k) for k in st["keys"])
+    # reset hook zeroes counters without dropping entries
+    executable_cache.reset_stats()
+    st2 = cache_stats()
+    assert st2["hits"] == st2["misses"] == st2["evictions"] == 0
+    assert st2["entries"] == st["entries"]
+
+
+def test_cache_miss_logs_debug_summary(caplog):
+    import logging
+
+    with caplog.at_level(logging.DEBUG, logger="isotope_tpu.compiler.cache"):
+        executable_cache.get_or_build(
+            ("telemetry-log-probe", time.time()), lambda: object()
+        )
+    assert any("executable-cache miss" in r.message for r in caplog.records)
+
+
+# -- bucket-plan accounting ------------------------------------------------
+
+def test_bucket_plan_stats_recorded():
+    shapes = [
+        buckets.LevelShape(size=4, pmax=2, children=4, calls=4,
+                           attempts=1, sparse=False, offset=0),
+        buckets.LevelShape(size=2, pmax=2, children=2, calls=2,
+                           attempts=1, sparse=False, offset=4),
+        buckets.LevelShape(size=2, pmax=1, children=0, calls=0,
+                           attempts=1, sparse=False, offset=6),
+    ]
+    segs = buckets.plan_segments(shapes, waste=4.0)
+    st = buckets.plan_stats(shapes, segs)
+    assert st["num_buckets"] == 1 and st["levels_bucketed"] == 2
+    assert st["padded_elems"] > st["real_elems"] > 0
+    assert 0.0 < st["padding_waste_fraction"] < 1.0
+    assert telemetry.counter_get("buckets_formed") >= 1.0
+    assert telemetry.counter_get("bucket_padded_elems") >= st[
+        "padded_elems"
+    ]
+    assert telemetry.gauge_get("bucket_padding_waste_fraction") == (
+        pytest.approx(st["padding_waste_fraction"])
+    )
+
+
+# -- zero sync points with telemetry off -----------------------------------
+
+def test_off_mode_adds_zero_sync_points(monkeypatch):
+    sim = _sim()
+    calls = {"n": 0}
+    orig = jax.block_until_ready
+
+    def counting(x):
+        calls["n"] += 1
+        return orig(x)
+
+    monkeypatch.setattr(jax, "block_until_ready", counting)
+    res = sim.run(OPEN, 64, KEY)
+    assert calls["n"] == 0, "default path must not fence"
+    assert telemetry.counter_get("engine_fences") == 0.0
+    monkeypatch.undo()
+    assert int(res.hop_events) == 64 * 3
+
+
+def test_detail_mode_fences_per_segment():
+    sim = _sim()
+    telemetry.enable(detail=True)
+    res = sim.run(OPEN, 64, KEY)
+    assert telemetry.counter_get("engine_fences") > 0.0
+    seg_phases = [
+        k for k in telemetry.snapshot().phases if k.startswith("segment.")
+    ]
+    assert seg_phases, "detail mode must record per-segment phases"
+    # eager execution, exact same results contract
+    assert int(res.hop_events) == 64 * 3
+
+
+# -- first-call compile timing ---------------------------------------------
+
+def test_first_call_phase_timer():
+    before = telemetry.counter_get("jit_first_calls")
+    sim = Simulator(
+        compile_graph(ServiceGraph.from_yaml(CHAIN)),
+        SimParams(cpu_time_s=1.0 / 7_777.0),  # fresh program
+    )
+    sim.run(OPEN, 40, KEY)
+    assert telemetry.counter_get("jit_first_calls") == before + 1
+    assert telemetry.phase_seconds("compile.jit_first_call") > 0.0
+
+
+# -- exposition ------------------------------------------------------------
+
+PROM_LINE = re.compile(
+    r"^[a-zA-Z_:][a-zA-Z0-9_:]*(\{[^{}]*\})? [0-9.eE+-]+(\s|$)"
+)
+
+
+def test_prometheus_exposition_parses():
+    telemetry.counter_inc("probe_events", 3)
+    telemetry.gauge_set("probe_gauge", 1.5)
+    telemetry.gauge_set("probe_labeled", 2.0, device="0")
+    with telemetry.phase("probe.phase"):
+        pass
+    text = telemetry.prometheus_text()
+    assert text.endswith("\n")
+    for line in text.splitlines():
+        if not line or line.startswith("#"):
+            continue
+        assert PROM_LINE.match(line), f"unparseable line: {line!r}"
+    assert 'isotope_engine_events_total{event="probe_events"} 3' in text
+    assert "isotope_engine_probe_gauge 1.5" in text
+    assert 'isotope_engine_probe_labeled{device="0"} 2' in text
+    assert (
+        'isotope_engine_phase_seconds_total{phase="probe.phase"}' in text
+    )
+
+
+# -- JSONL round trip ------------------------------------------------------
+
+def test_run_telemetry_jsonl_round_trip(tmp_path):
+    telemetry.counter_inc("x", 2)
+    telemetry.gauge_set("g", 0.5, device="1")
+    telemetry.phase_add("p", 1.25)
+    rec = telemetry.snapshot(label="roundtrip")
+    line = rec.to_json_line()
+    back = telemetry.RunTelemetry.from_dict(json.loads(line))
+    assert back.to_dict() == rec.to_dict()
+    path = tmp_path / "telemetry.jsonl"
+    rec.append_jsonl(path)
+    rec.append_jsonl(path)
+    assert telemetry.validate_jsonl(path) == 2
+
+
+def test_validate_jsonl_rejects_bad_schema(tmp_path):
+    p = tmp_path / "bad.jsonl"
+    p.write_text('{"schema": "nope", "phases": {}}\n')
+    with pytest.raises(ValueError, match="schema"):
+        telemetry.validate_jsonl(p)
+    p.write_text("")
+    with pytest.raises(ValueError, match="no telemetry records"):
+        telemetry.validate_jsonl(p)
+    rec = telemetry.snapshot()
+    doc = rec.to_dict()
+    doc["counters"] = {"k": "not-a-number"}
+    p.write_text(json.dumps(doc) + "\n")
+    with pytest.raises(ValueError, match="not numeric"):
+        telemetry.validate_jsonl(p)
+
+
+# -- summary block ---------------------------------------------------------
+
+def test_summary_block_derivations():
+    telemetry.counter_inc("executable_cache_hits", 3)
+    telemetry.counter_inc("executable_cache_misses", 1)
+    telemetry.counter_inc("bucket_padded_elems", 200)
+    telemetry.counter_inc("bucket_real_elems", 150)
+    telemetry.phase_add("compile.trace", 1.0)
+    telemetry.phase_add("compile.backend", 2.0)
+    blk = telemetry.summary_block()
+    assert blk["cache_hit_ratio"] == pytest.approx(0.75)
+    assert blk["padding_waste_fraction"] == pytest.approx(0.25)
+    assert blk["compile_s"] == pytest.approx(3.0)
+    assert blk["peak_device_bytes"] is None  # CPU: no memory_stats
+
+
+# -- runner integration ----------------------------------------------------
+
+def test_runner_emits_telemetry_artifacts(tmp_path):
+    import pathlib
+
+    from isotope_tpu.runner.config import DEFAULT_ENVIRONMENTS, ExperimentConfig
+    from isotope_tpu.runner.run import run_experiment
+
+    topo = (
+        pathlib.Path(__file__).parent.parent
+        / "examples/topologies/canonical.yaml"
+    )
+    telemetry.enable()
+    config = ExperimentConfig(
+        topology_paths=(str(topo),),
+        environments=(DEFAULT_ENVIRONMENTS["NONE"],),
+        qps=(200.0,),
+        connections=(4,),
+        duration_s=1.0,
+        load_kind="open",
+        num_requests=200,
+        seed=3,
+    )
+    (result,) = run_experiment(config, out_dir=str(tmp_path / "out"))
+    assert result.telemetry is not None
+    assert result.telemetry["schema"] == telemetry.SCHEMA
+    assert result.telemetry["phases"].get("engine.build", 0) > 0
+    assert "isotope_engine_events_total" in result.prometheus_text
+    jsonl = tmp_path / "out" / "telemetry.jsonl"
+    assert telemetry.validate_jsonl(jsonl) == 1
+    # the workload series are still there alongside the engine series
+    assert "service_incoming_requests_total" in result.prometheus_text
+
+
+def test_runner_skips_telemetry_when_off(tmp_path):
+    import pathlib
+
+    from isotope_tpu.runner.config import DEFAULT_ENVIRONMENTS, ExperimentConfig
+    from isotope_tpu.runner.run import run_experiment
+
+    topo = (
+        pathlib.Path(__file__).parent.parent
+        / "examples/topologies/chain-2-services.yaml"
+    )
+    config = ExperimentConfig(
+        topology_paths=(str(topo),),
+        environments=(DEFAULT_ENVIRONMENTS["NONE"],),
+        qps=(200.0,),
+        connections=(4,),
+        duration_s=1.0,
+        load_kind="open",
+        num_requests=100,
+        seed=3,
+    )
+    (result,) = run_experiment(config, out_dir=str(tmp_path / "out"))
+    assert result.telemetry is None
+    assert "isotope_engine_" not in result.prometheus_text
+    assert not (tmp_path / "out" / "telemetry.jsonl").exists()
+
+
+# -- jax monitoring hooks --------------------------------------------------
+
+def test_jax_hooks_split_compile_phases():
+    telemetry.install_jax_hooks()
+    t0 = telemetry.phase_seconds("compile.trace")
+    b0 = telemetry.phase_seconds("compile.backend")
+
+    @jax.jit
+    def f(x):
+        return jnp.sin(x) * np.float32(2.0)
+
+    f(jnp.arange(8, dtype=jnp.float32)).block_until_ready()
+    assert telemetry.phase_seconds("compile.trace") > t0
+    assert telemetry.phase_seconds("compile.backend") > b0

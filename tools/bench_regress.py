@@ -15,6 +15,18 @@ The driver's BENCH files wrap the parsed line under ``"parsed"``; a raw
 present in both captures are compared (evidence keys like
 ``*_inflight`` and spread keys are skipped); the headline ``value`` is
 compared as case ``tree121``.
+
+Optional telemetry gates — each armed by setting its env var to a
+threshold (unset = not gated), compared per case over the
+``<case>_telemetry`` blocks bench.py embeds:
+
+- ``BENCH_REGRESS_COMPILE_THRESHOLD``: relative increase allowed on
+  first-call compile seconds (``<case>_compile_s``, falling back to
+  the telemetry block's ``compile_s``), e.g. ``0.5`` = +50%;
+- ``BENCH_REGRESS_MEM_THRESHOLD``: relative increase allowed on
+  ``peak_device_bytes``;
+- ``BENCH_REGRESS_WASTE_THRESHOLD``: ABSOLUTE increase allowed on
+  ``padding_waste_fraction`` (it is already a ratio).
 """
 from __future__ import annotations
 
@@ -82,6 +94,70 @@ def _cases(doc: dict, prefer_best: bool = False) -> dict:
     return cases
 
 
+def _telemetry_value(extra: dict, case: str, field: str):
+    """A case's telemetry field: the legacy flat ``<case>_compile_s``
+    key wins for compile seconds (it predates the telemetry block),
+    then the ``<case>_telemetry`` dict."""
+    if field == "compile_s":
+        flat = extra.get(f"{case}_compile_s")
+        if isinstance(flat, (int, float)) and flat > 0:
+            return float(flat)
+    blk = extra.get(f"{case}_telemetry")
+    if isinstance(blk, dict):
+        v = blk.get(field)
+        if isinstance(v, (int, float)):
+            return float(v)
+    return None
+
+
+def telemetry_failures(prev_doc: dict, new_doc: dict) -> list:
+    """Env-armed per-case gates on the embedded telemetry fields.
+
+    Reads the thresholds at call time (not import) so one process can
+    evaluate several configurations; an unset env var disarms its gate.
+    """
+    gates = (
+        # (field, env var, relative?)
+        ("compile_s", "BENCH_REGRESS_COMPILE_THRESHOLD", True),
+        ("peak_device_bytes", "BENCH_REGRESS_MEM_THRESHOLD", True),
+        ("padding_waste_fraction", "BENCH_REGRESS_WASTE_THRESHOLD",
+         False),
+    )
+    prev_extra = prev_doc.get("extra", {})
+    new_extra = new_doc.get("extra", {})
+    cases = sorted(
+        {k[: -len("_telemetry")] for k in prev_extra if
+         k.endswith("_telemetry")}
+        | {k[: -len("_compile_s")] for k in prev_extra if
+           k.endswith("_compile_s")}
+    )
+    failures = []
+    for field, env, relative in gates:
+        raw = os.environ.get(env)
+        if raw is None or raw == "":
+            continue
+        thr = float(raw)
+        for case in cases:
+            old = _telemetry_value(prev_extra, case, field)
+            new = _telemetry_value(new_extra, case, field)
+            if old is None or new is None:
+                continue
+            if relative:
+                if old <= 0:
+                    continue
+                bad = new > old * (1.0 + thr)
+                delta = f"{(new / old - 1) * 100:+.1f}%"
+            else:
+                bad = new > old + thr
+                delta = f"{new - old:+.4f}"
+            verdict = "REGRESSION" if bad else "OK"
+            print(f"bench_regress: {case}.{field}: {old:.4g} -> "
+                  f"{new:.4g} ({delta}) {verdict}")
+            if bad:
+                failures.append(f"{case}.{field}")
+    return failures
+
+
 def previous_capture() -> tuple:
     """(path, parsed_doc) of the newest BENCH_r*.json, or (None, None)."""
     files = sorted(
@@ -138,6 +214,7 @@ def main() -> int:
             failures.append(case)
         print(f"bench_regress: {case}: {old_rate:.4g} -> "
               f"{new[case]:.4g} ({(ratio - 1) * 100:+.1f}%) {verdict}")
+    failures.extend(telemetry_failures(prev_doc, new_doc))
     if failures:
         print(f"bench_regress: FAIL vs {prev_path}: "
               f"{', '.join(failures)} regressed >"

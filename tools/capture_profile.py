@@ -1,44 +1,22 @@
-"""Capture a jax.profiler trace of the bench step and dump HLO op stats."""
-import glob
+"""Thin shim over isotope_tpu.telemetry.profile (the promoted backend).
+
+Kept so existing ``python tools/capture_profile.py`` invocations keep
+working; the real capture path now lives in the package and also backs
+``isotope-tpu telemetry --xla-trace``.
+"""
 import os
 import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-import jax
-import jax.numpy as jnp
-
-from __graft_entry__ import _flagship
-from isotope_tpu.metrics.histogram import latency_histogram
-from isotope_tpu.sim.config import OPEN_LOOP
-from isotope_tpu.sim.engine import Simulator
-
 OUT = "/tmp/jaxprof"
 
 
 def main():
-    compiled = _flagship()
-    sim = Simulator(compiled)
-    n = 65_536
-    qps = jnp.float32(100_000.0)
+    from isotope_tpu.telemetry.profile import capture_xla_trace
 
-    @jax.jit
-    def step(key):
-        res = sim._simulate(n, OPEN_LOOP, 0, False, key, qps,
-                            jnp.float32(0.0), qps)
-        return res.hop_events, latency_histogram(res.client_latency)
-
-    key = jax.random.PRNGKey(0)
-    jax.block_until_ready(step(key))
-
-    with jax.profiler.trace(OUT):
-        out = None
-        for i in range(3):
-            out = step(jax.random.fold_in(key, i))
-        jax.block_until_ready(out)
-
-    xplanes = glob.glob(os.path.join(OUT, "**", "*.xplane.pb"),
-                        recursive=True)
+    out = sys.argv[1] if len(sys.argv) > 1 else OUT
+    xplanes = capture_xla_trace(out)
     print("xplane files:", xplanes)
 
 
