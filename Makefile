@@ -6,8 +6,9 @@ PY ?= python
 QPS ?= 1000
 DURATION ?= 120s
 
-.PHONY: test bench telemetry-smoke examples canonical tree star multitier \
-	auxiliary-services star-auxiliary latency cpu_mem dot clean
+.PHONY: test bench telemetry-smoke resilience-smoke examples canonical \
+	tree star multitier auxiliary-services star-auxiliary latency \
+	cpu_mem dot clean
 
 test:
 	$(PY) -m pytest tests/ -x -q
@@ -34,6 +35,31 @@ telemetry-smoke:
 	$(PY) -c "from isotope_tpu.telemetry import validate_jsonl; \
 		n = validate_jsonl('/tmp/isotope_telemetry_smoke.jsonl'); \
 		print(f'telemetry-smoke: {n} valid record(s)')"
+
+# engine-chaos end-to-end check: inject a transient failure AND an OOM
+# into the run phase (resilience/faults.py), then assert the run still
+# produced output — retried (retries_total >= 1) and degraded down the
+# ladder (degradations_total >= 1, degraded_to recorded) instead of
+# crashing.  The injected faults are deterministic; no flakiness.
+resilience-smoke:
+	rm -f /tmp/isotope_resilience_smoke.jsonl
+	ISOTOPE_FAULT_INJECT=transient:engine.run:1,oom:engine.run:1 \
+	ISOTOPE_COMPILE_CACHE=off \
+	$(PY) -m isotope_tpu simulate examples/topologies/chain-3-services.yaml \
+		--qps 50 --duration 2s --load-kind open --max-requests 256 \
+		--telemetry \
+		--telemetry-out /tmp/isotope_resilience_smoke.jsonl --flat \
+		> /tmp/isotope_resilience_smoke.json
+	$(PY) -c "import json; from isotope_tpu.telemetry import iter_jsonl; \
+		rec = list(iter_jsonl('/tmp/isotope_resilience_smoke.jsonl'))[-1]; \
+		assert rec.counters.get('retries_total', 0) >= 1, rec.counters; \
+		assert rec.counters.get('degradations_total', 0) >= 1, rec.counters; \
+		assert rec.meta.get('degraded_to'), rec.meta; \
+		doc = json.load(open('/tmp/isotope_resilience_smoke.json')); \
+		assert float(doc['ActualQPS']) > 0, doc; \
+		print('resilience-smoke: degraded to', rec.meta['degraded_to'], \
+		      '| retries', int(rec.counters['retries_total']), \
+		      '| output intact (ActualQPS', doc['ActualQPS'], ')')"
 
 examples:
 	$(PY) tools/gen_examples.py

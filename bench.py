@@ -90,20 +90,55 @@ def _rate(sim, load, num_requests, block_size, *, warm=3, iters=3,
     compilation cache exist to shrink.  It is sourced from the engine
     telemetry phase timers (telemetry/core.py), which also split it
     into trace/lower/backend in the case's telemetry block.
+
+    The first call runs under the resilience supervisor's OOM ladder
+    (resilience/supervisor.py): a case that exhausts HBM serves its
+    windows from a fallback rung — recorded as ``degraded_to`` in the
+    case's telemetry block — instead of hard-crashing the capture, and
+    ``tools/bench_regress.py`` fails the round if a previously-clean
+    case degrades.  The surviving rung serves every subsequent window,
+    so the measured rate and its label agree.
     """
+    import contextlib
+
     import jax
 
     from isotope_tpu import telemetry
+    from isotope_tpu.resilience import ResiliencePolicy, run_ladder
 
     key = jax.random.PRNGKey(0)
+    serving = {"block": block_size, "eager": False}
 
     def once(k):
-        return sim.run_summary(load, num_requests, k, block_size=block_size)
+        ctx = (
+            jax.disable_jit() if serving["eager"]
+            else contextlib.nullcontext()
+        )
+        with ctx:
+            return sim.run_summary(
+                load, num_requests, k, block_size=serving["block"]
+            )
 
+    def rung(block, eager):
+        def thunk():
+            serving.update(block=block, eager=eager)
+            s = once(key)
+            jax.block_until_ready(s.count)
+            return s
+        return thunk
+
+    half = max(256, block_size // 2)
     before = telemetry.phase_seconds("bench.first_call")
     with telemetry.phase("bench.first_call"):
-        s = once(key)
-        jax.block_until_ready(s.count)
+        s, _degraded = run_ladder(
+            [
+                ("scan", rung(block_size, False)),
+                ("half-block", rung(half, False)),
+                ("cpu-eager", rung(half, True)),
+            ],
+            ResiliencePolicy.from_env(),
+            site_prefix="bench",
+        )
     first_s = telemetry.phase_seconds("bench.first_call") - before
     hops = float(s.hop_events)
     for i in range(warm):

@@ -18,6 +18,30 @@ import sys
 from isotope_tpu.utils import duration as dur
 
 
+def _add_resilience_args(parser) -> None:
+    """The run supervisor's knobs (resilience/supervisor.py), shared by
+    every run-executing subcommand."""
+    parser.add_argument(
+        "--max-retries", type=int, default=None, metavar="N",
+        help="transient-failure retries per phase before the case "
+             "fails (default: $ISOTOPE_MAX_RETRIES or 3; backoff is "
+             "exponential with deterministic jitter)")
+    parser.add_argument(
+        "--no-degrade", action="store_true",
+        help="disable the OOM degradation ladder (halve request "
+             "chunk, sharded -> single-device -> CPU eager); an OOM "
+             "then fails the case immediately")
+
+
+def _policy(args):
+    from isotope_tpu.resilience import ResiliencePolicy
+
+    return ResiliencePolicy.from_env(
+        max_retries=args.max_retries,
+        degrade=False if args.no_degrade else None,
+    )
+
+
 def register(sub) -> None:
     s = sub.add_parser(
         "simulate", help="simulate one topology under one load"
@@ -78,6 +102,7 @@ def register(sub) -> None:
     s.add_argument("--telemetry-out", metavar="FILE",
                    default="telemetry.jsonl",
                    help="where --telemetry appends its JSONL record")
+    _add_resilience_args(s)
     s.set_defaults(func=run_simulate)
 
     k = sub.add_parser(
@@ -126,6 +151,7 @@ def register(sub) -> None:
                         "isotope_engine_* series in each .prom artifact "
                         "plus <out>/telemetry.jsonl ('detail' adds "
                         "segment fences — diagnosis, not benchmarking)")
+    _add_resilience_args(w)
     w.set_defaults(func=run_sweep)
 
     p = sub.add_parser(
@@ -207,7 +233,10 @@ def run_simulate(args) -> int:
         entry=args.entry,
         **extra,
     )
-    (result,) = run_experiment(config)
+    (result,) = run_experiment(config, policy=_policy(args))
+    if result.failed:
+        print(f"error: run failed: {result.error}", file=sys.stderr)
+        return 1
     doc = result.flat if args.flat else result.fortio_json
     json.dump(doc, sys.stdout, indent=None if args.flat else 2)
     sys.stdout.write("\n")
@@ -348,11 +377,29 @@ def run_sweep(args) -> int:
         resume=not args.fresh,
         profile_dir=args.profile,
         export=args.export,
+        policy=_policy(args),
     )
     discarded = [r.label for r in results if r.window.discarded]
+    failed = [r.label for r in results if r.failed]
+    degraded = [r.label for r in results if r.degraded_to is not None]
     print(
         f"{len(results)} runs -> {args.out}/ "
         f"({len(discarded)} would be discarded by the collector)",
         file=sys.stderr,
     )
+    if degraded:
+        print(
+            f"{len(degraded)} run(s) completed DEGRADED: "
+            f"{', '.join(degraded)}",
+            file=sys.stderr,
+        )
+    if failed:
+        # the failed cases are checkpointed: the same invocation
+        # retries exactly them
+        print(
+            f"{len(failed)} run(s) FAILED (recorded in the checkpoint; "
+            f"re-run to retry): {', '.join(failed)}",
+            file=sys.stderr,
+        )
+        return 1
     return 0

@@ -40,6 +40,9 @@ def register(sub) -> None:
                         "plus a telemetry.jsonl per config ('detail' "
                         "adds segment fences — diagnosis, not "
                         "benchmarking)")
+    from isotope_tpu.commands.simulate_cmd import _add_resilience_args
+
+    _add_resilience_args(s)
     s.set_defaults(func=run_suite_cmd)
 
 
@@ -51,6 +54,7 @@ def run_suite_cmd(args) -> int:
 
         telemetry.enable(detail=args.telemetry == "detail")
     enable_persistent_cache(args.compile_cache)
+    from isotope_tpu.commands.simulate_cmd import _policy
     from isotope_tpu.runner.suite import run_suite
 
     result = run_suite(
@@ -62,12 +66,14 @@ def run_suite_cmd(args) -> int:
         mem_limit_mib=args.mem_limit,
         progress=lambda label: print(f"running {label}", file=sys.stderr),
         resume=not args.fresh,
+        policy=_policy(args),
     )
     m = result.manifest
     print(
         f"suite {m['id']}: {m['total_runs']} runs across "
-        f"{len(m['configs'])} configs, {m['total_alarms']} alarms -> "
+        f"{len(m['configs'])} configs, {m['total_alarms']} alarms, "
+        f"{m['total_failed']} failed, {m['total_degraded']} degraded -> "
         f"{result.publish_dir}",
         file=sys.stderr,
     )
-    return 1 if m["total_alarms"] else 0
+    return 1 if (m["total_alarms"] or m["total_failed"]) else 0

@@ -35,7 +35,92 @@ logger = logging.getLogger(__name__)
 #: "", "0", "off" and "none" (case-insensitive) disable it explicitly.
 ENV_CACHE_DIR = "ISOTOPE_COMPILE_CACHE"
 
+#: sidecar recording each cache entry's content digest (scan_cache_dir)
+DIGEST_SIDECAR = ".isotope-digests.json"
+#: subdirectory corrupted entries are moved into (never deleted: a
+#: quarantined entry is evidence, and XLA just retraces without it)
+QUARANTINE_DIR = "quarantine"
+
 _persistent_dir: Optional[str] = None
+
+
+def scan_cache_dir(path: str) -> dict:
+    """Integrity-scan a persistent cache dir, quarantining bad entries.
+
+    A corrupted entry (truncated write on a killed run, bit rot, a
+    concurrent writer) used to surface as an unpickle/deserialize crash
+    *inside* XLA's cache read — killing the run that was supposed to be
+    saved compile time.  This scan runs at :func:`enable_persistent_cache`
+    time: every entry file is digested; an EMPTY file, an unreadable
+    file, or one whose digest no longer matches the recorded sidecar
+    digest is moved to ``<dir>/quarantine/`` (counter
+    ``compile_cache_quarantined``) so XLA simply misses and retraces.
+    Fresh entries get their digest recorded for the next scan.  Never
+    raises — a broken cache must degrade to "no cache", not crash.
+    """
+    stats = {"checked": 0, "quarantined": [], "recorded": 0}
+    try:
+        import json
+        import shutil
+
+        sidecar = os.path.join(path, DIGEST_SIDECAR)
+        digests = {}
+        try:
+            with open(sidecar) as f:
+                digests = json.load(f)
+            if not isinstance(digests, dict):
+                digests = {}
+        except (OSError, ValueError):
+            digests = {}  # missing/corrupt sidecar: rebuild from scratch
+        qdir = os.path.join(path, QUARANTINE_DIR)
+        fresh = {}
+        for name in sorted(os.listdir(path)):
+            fpath = os.path.join(path, name)
+            if (
+                name == DIGEST_SIDECAR
+                or name.startswith(".")
+                or not os.path.isfile(fpath)
+            ):
+                continue
+            stats["checked"] += 1
+            digest = None
+            try:
+                with open(fpath, "rb") as f:
+                    data = f.read()
+                if data:
+                    digest = hashlib.sha256(data).hexdigest()
+            except OSError:
+                digest = None
+            bad = digest is None or (
+                name in digests and digests[name] != digest
+            )
+            if bad:
+                os.makedirs(qdir, exist_ok=True)
+                try:
+                    shutil.move(fpath, os.path.join(qdir, name))
+                except OSError:  # pragma: no cover - best effort
+                    try:
+                        os.unlink(fpath)
+                    except OSError:
+                        continue
+                stats["quarantined"].append(name)
+                telemetry.counter_inc("compile_cache_quarantined")
+                logger.warning(
+                    "quarantined corrupted compile-cache entry %s "
+                    "(%s) — it will be retraced", name,
+                    "unreadable/empty" if digest is None
+                    else "digest mismatch",
+                )
+            else:
+                fresh[name] = digest
+        stats["recorded"] = len(fresh)
+        tmp = sidecar + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(fresh, f)
+        os.replace(tmp, sidecar)
+    except Exception:  # pragma: no cover - scan must never kill a run
+        logger.warning("compile-cache scan failed", exc_info=True)
+    return stats
 
 
 def persistent_cache_dir() -> Optional[str]:
@@ -65,6 +150,9 @@ def enable_persistent_cache(path: Optional[str] = None) -> Optional[str]:
     import jax
 
     os.makedirs(path, exist_ok=True)
+    # evict corrupted entries BEFORE jax reads any (a bad entry then
+    # costs a retrace, never a crash)
+    scan_cache_dir(path)
     jax.config.update("jax_compilation_cache_dir", path)
     # jax initializes its cache object lazily ONCE; re-pointing the dir
     # after something already compiled needs an explicit reset
@@ -152,7 +240,7 @@ class ExecutableCache:
             return self._fns[key]
         self.misses += 1
         telemetry.counter_inc("executable_cache_misses")
-        fn = build()
+        fn = self._build_quarantining(build)
         self._fns[key] = fn
         while len(self._fns) > self.max_entries:
             self._fns.popitem(last=False)
@@ -164,6 +252,32 @@ class ExecutableCache:
             self.misses, self.key_digest(key), self.hits, len(self._fns),
         )
         return fn
+
+    @staticmethod
+    def _build_quarantining(build: Callable[[], object]):
+        """Build an entry, absorbing corrupted persistent-cache reads.
+
+        A digest-mismatch / unpickle failure surfacing from the
+        persistent cache is the one DETERMINISTIC error with a better
+        move than failing: quarantine the bad entries (scan_cache_dir)
+        and retrace once.  Everything else propagates untouched.
+        """
+        from isotope_tpu.resilience import faults, taxonomy
+
+        try:
+            faults.check("cache.load")
+            return build()
+        except Exception as e:
+            if not taxonomy.is_cache_corruption(e):
+                raise
+            telemetry.counter_inc("compile_cache_quarantine_retries")
+            logger.warning(
+                "corrupted persistent-cache entry (%s) — quarantining "
+                "and retracing", e,
+            )
+            if _persistent_dir is not None:
+                scan_cache_dir(_persistent_dir)
+            return build()
 
     def cache_stats(self) -> dict:
         """Introspection: counts plus the resident keys' digests."""

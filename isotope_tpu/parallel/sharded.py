@@ -20,10 +20,11 @@ the only communication is the metric reduction — the design that makes
 from __future__ import annotations
 
 from functools import partial
-from typing import Dict, Tuple
+from typing import Dict, NamedTuple, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
 
 from isotope_tpu import telemetry
@@ -31,6 +32,7 @@ from isotope_tpu.compiler.cache import (
     enable_persistent_cache,
     executable_cache,
 )
+from isotope_tpu.resilience import faults
 from isotope_tpu.compiler.program import CompiledGraph
 from isotope_tpu.metrics.prometheus import MetricsCollector, ServiceMetrics
 from isotope_tpu.parallel.mesh import SVC_AXIS
@@ -41,6 +43,23 @@ from isotope_tpu.sim.summary import RunSummary, reduce_stacked, summarize
 # back-compat alias: the sharded path now returns the same summary type
 # the single-device scan path produces
 ShardedSummary = RunSummary
+
+
+class _RunPlan(NamedTuple):
+    """Everything a run's physical execution shape depends on — shared
+    between the shard_map path and the single-device emulation so the
+    degradation ladder reproduces the exact same request streams."""
+
+    offered: float
+    gap: float
+    nominal_gap: float
+    conns_local: int
+    block: int
+    num_blocks: int
+    window: Tuple[float, float]
+    sat_conns: int
+    kind: str
+    trim: bool
 
 
 def _shard_map(body, mesh, in_specs, out_specs):
@@ -119,11 +138,45 @@ class ShardedSimulator:
         ``trim=True`` accumulates the collector's steady-state window
         into the summary's ``win_*`` fields (see Simulator.run_summary).
         """
+        plan = self._plan_run(load, num_requests, key, offered_qps,
+                              block_size, trim)
+        # shard balance: the rows actually simulated are num_blocks *
+        # block per shard (shard fill + connection rounding + block
+        # rounding), so the gauge is the fraction simulated beyond the
+        # request count asked for — the parallel path's padding waste
+        telemetry.counter_inc("sharded_runs")
+        telemetry.gauge_set("shard_count", self.n_shards)
+        telemetry.gauge_set(
+            "shard_rows_imbalance_fraction",
+            (plan.num_blocks * plan.block * self.n_shards - num_requests)
+            / max(num_requests, 1),
+        )
+        fn = self._get(plan.block, plan.num_blocks, plan.kind,
+                       plan.conns_local, plan.trim, plan.sat_conns)
+        vis, windows = self._args_put(plan)
+        faults.check("sharded.compute")
+        out = fn(
+            key, jnp.float32(plan.offered), jnp.float32(plan.gap),
+            jnp.float32(plan.nominal_gap),
+            jnp.float32(plan.window[0]), jnp.float32(plan.window[1]),
+            vis, windows,
+        )
+        if telemetry.detail_enabled():
+            with telemetry.phase("sharded.gather"):
+                jax.block_until_ready(out.count)
+            telemetry.record_device_memory()
+        faults.check("sharded.gather")
+        return out
+
+    def _plan_run(self, load, num_requests: int, key,
+                  offered_qps=None, block_size: int = 65_536,
+                  trim: bool = False) -> _RunPlan:
+        """Resolve the physical run shape (see :class:`_RunPlan`)."""
         n_local = -(-num_requests // self.n_shards)
         if load.kind == OPEN_LOOP:
-            offered = jnp.float32(load.qps)
-            gap = jnp.float32(0.0)
-            nominal_gap = jnp.float32(0.0)
+            offered = float(load.qps)
+            gap = 0.0
+            nominal_gap = 0.0
             conns_local = 0
             block = max(1, min(block_size, n_local))
         else:
@@ -145,13 +198,13 @@ class ShardedSimulator:
                 offered_qps = self.sim.solve_closed_rate(
                     load, n_solve, key
                 )
-            offered = jnp.float32(offered_qps)
+            offered = float(offered_qps)
             gap = (
-                jnp.float32(load.connections / load.qps)
+                load.connections / load.qps
                 if load.qps is not None
-                else jnp.float32(0.0)
+                else 0.0
             )
-            nominal_gap = jnp.float32(load.connections / float(offered_qps))
+            nominal_gap = load.connections / offered
             conns_local = max(load.connections // self.n_shards, 1)
             # block_size is a soft HBM bound: when per-shard connections
             # exceed it the block grows to ``conns_local`` requests
@@ -162,7 +215,7 @@ class ShardedSimulator:
             from isotope_tpu.metrics.fortio import trim_window_bounds
 
             window = trim_window_bounds(
-                num_blocks * block * self.n_shards, float(offered)
+                num_blocks * block * self.n_shards, offered
             )
         else:
             window = (0.0, float("inf"))
@@ -172,40 +225,31 @@ class ShardedSimulator:
         sat_conns = (
             load.connections if self.sim._saturated(load) else 0
         )
-        # shard balance: the rows actually simulated are num_blocks *
-        # block per shard (shard fill + connection rounding + block
-        # rounding), so the gauge is the fraction simulated beyond the
-        # request count asked for — the parallel path's padding waste
-        telemetry.counter_inc("sharded_runs")
-        telemetry.gauge_set("shard_count", self.n_shards)
-        telemetry.gauge_set(
-            "shard_rows_imbalance_fraction",
-            (num_blocks * block * self.n_shards - num_requests)
-            / max(num_requests, 1),
+        return _RunPlan(
+            offered=offered, gap=gap, nominal_gap=nominal_gap,
+            conns_local=conns_local, block=block, num_blocks=num_blocks,
+            window=window, sat_conns=sat_conns, kind=load.kind,
+            trim=trim,
         )
-        fn = self._get(block, num_blocks, load.kind, conns_local, trim,
-                       sat_conns)
-        # args_put covers building + transferring the per-run argument
-        # tables (visit fixed points, phase windows) to the devices; the
-        # explicit put + block is DETAIL-ONLY so the default path keeps
-        # its async dispatch (no added sync points)
+
+    def _args_put(self, plan: _RunPlan):
+        """Per-run argument tables (visit fixed points, phase windows).
+
+        args_put covers building + transferring them to the devices;
+        the explicit put + block is DETAIL-ONLY so the default path
+        keeps its async dispatch (no added sync points).
+        """
         with telemetry.phase("sharded.args_put"):
-            vis = self.sim._vis_arg(float(offered))
-            windows = self.sim._windows_arg(float(offered), sat_conns > 0)
+            faults.check("sharded.args_put")
+            vis = self.sim._vis_arg(plan.offered)
+            windows = self.sim._windows_arg(
+                plan.offered, plan.sat_conns > 0
+            )
             if telemetry.detail_enabled():
                 vis = jax.device_put(vis)
                 windows = jax.device_put(windows)
                 jax.block_until_ready((vis, windows))
-        out = fn(
-            key, offered, gap, nominal_gap,
-            jnp.float32(window[0]), jnp.float32(window[1]),
-            vis, windows,
-        )
-        if telemetry.detail_enabled():
-            with telemetry.phase("sharded.gather"):
-                jax.block_until_ready(out.count)
-            telemetry.record_device_memory()
-        return out
+        return vis, windows
 
     # ------------------------------------------------------------------
 
@@ -259,7 +303,7 @@ class ShardedSimulator:
             )
         return self._fns[cache_key]
 
-    def _body(
+    def _local_scan(
         self,
         block: int,
         num_blocks: int,
@@ -267,6 +311,7 @@ class ShardedSimulator:
         conns_local: int,
         trim: bool,
         sat_conns: int,
+        shard: jax.Array,
         key: jax.Array,
         offered_qps: jax.Array,
         pace_gap: jax.Array,
@@ -276,10 +321,13 @@ class ShardedSimulator:
         visits_pc: jax.Array,
         phase_windows: jax.Array,
     ) -> RunSummary:
-        both = tuple(self.mesh.axis_names)
-        shard = jnp.int32(0)
-        for a in self.mesh.axis_names:
-            shard = shard * self.mesh.shape[a] + jax.lax.axis_index(a)
+        """One shard's pre-collective block scan.
+
+        Shared verbatim between the shard_map body and the single-device
+        emulation (``run_emulated``): the shard's RNG streams depend only
+        on ``shard``/``key``, so the degraded path replays bit-identical
+        per-shard computations.
+        """
         # disjoint fold domains: the rate solver's pilots consumed
         # fold_in(key, 0..iters) on the same base key
         local_key = jax.random.fold_in(key, 500_000 + shard)
@@ -317,7 +365,34 @@ class ShardedSimulator:
             jnp.float32(0.0),
         )
         _, parts = jax.lax.scan(block_body, carry0, jnp.arange(num_blocks))
-        local = reduce_stacked(parts)
+        return reduce_stacked(parts)
+
+    def _body(
+        self,
+        block: int,
+        num_blocks: int,
+        kind: str,
+        conns_local: int,
+        trim: bool,
+        sat_conns: int,
+        key: jax.Array,
+        offered_qps: jax.Array,
+        pace_gap: jax.Array,
+        nominal_gap: jax.Array,
+        win_lo: jax.Array,
+        win_hi: jax.Array,
+        visits_pc: jax.Array,
+        phase_windows: jax.Array,
+    ) -> RunSummary:
+        both = tuple(self.mesh.axis_names)
+        shard = jnp.int32(0)
+        for a in self.mesh.axis_names:
+            shard = shard * self.mesh.shape[a] + jax.lax.axis_index(a)
+        local = self._local_scan(
+            block, num_blocks, kind, conns_local, trim, sat_conns,
+            shard, key, offered_qps, pace_gap, nominal_gap,
+            win_lo, win_hi, visits_pc, phase_windows,
+        )
 
         def allsum(x):
             return jax.lax.psum(x, both)
@@ -371,4 +446,146 @@ class ShardedSimulator:
             metrics=metrics,
             utilization=local.utilization,
             unstable=local.unstable,
+        )
+
+    # -- single-device degradation rung --------------------------------
+
+    def run_emulated(
+        self,
+        load: LoadModel,
+        num_requests: int,
+        key: jax.Array,
+        offered_qps=None,
+        block_size: int = 65_536,
+        trim: bool = False,
+    ) -> RunSummary:
+        """The sharded program replayed shard-by-shard on one device.
+
+        The OOM degradation ladder's ``single-device`` rung: when the
+        full mesh program exhausts HBM (or devices are lost), each
+        shard's block scan — bit-identical RNG streams, identical
+        blocking, via the shared ``_local_scan`` body — executes
+        serially on the default device, and the metric collectives are
+        replayed on host (sums in f64, Chan/Welford merge in the same
+        f32 steps the mesh reduction takes).  Peak live memory is one
+        shard's event tensors instead of the whole mesh's.  Results
+        match the shard_map path to f32 reduction-order precision
+        (<= 1 ULP on every field; pinned by tests/test_resilience.py).
+        """
+        plan = self._plan_run(load, num_requests, key, offered_qps,
+                              block_size, trim)
+        telemetry.counter_inc("sharded_emulated_runs")
+        telemetry.gauge_set("shard_count", self.n_shards)
+        fn = self._get_local_fn(plan)
+        vis, windows = self._args_put(plan)
+        shards = []
+        with telemetry.phase("sharded.emulated"):
+            for s in range(self.n_shards):
+                out = fn(
+                    jnp.int32(s), key,
+                    jnp.float32(plan.offered), jnp.float32(plan.gap),
+                    jnp.float32(plan.nominal_gap),
+                    jnp.float32(plan.window[0]),
+                    jnp.float32(plan.window[1]),
+                    vis, windows,
+                )
+                # serialize: live memory stays bounded by ONE shard
+                jax.block_until_ready(out.count)
+                shards.append(out)
+        return self._merge_shard_summaries(shards)
+
+    def _get_local_fn(self, plan: _RunPlan):
+        cache_key = (plan.block, plan.num_blocks, plan.kind,
+                     plan.conns_local, plan.trim, plan.sat_conns)
+        full_key = ("sharded-local", self.sim.signature,
+                    self.n_shards) + cache_key
+        return executable_cache.get_or_build(
+            full_key,
+            lambda: telemetry.time_first_call(
+                jax.jit(partial(self._local_scan, *cache_key)),
+                "compile.jit_first_call",
+            ),
+        )
+
+    def _merge_shard_summaries(self, shards) -> RunSummary:
+        """Host replay of the mesh collectives over per-shard summaries.
+
+        Cross-shard sums accumulate SEQUENTIALLY in shard order at the
+        summaries' own dtype — the reduction order XLA's CPU psum uses
+        (measured: 200/200 random draws bit-equal; a tree-order backend
+        would still land within ~log2(shards) ULP) — and the Welford
+        cross-shard term repeats the exact f32 steps of the device
+        merge, so the degraded path's results are indistinguishable
+        from the mesh path's.
+        """
+        def stack(get):
+            return np.stack([np.asarray(get(s)) for s in shards])
+
+        def allsum(get):
+            acc = np.asarray(get(shards[0]))
+            for s in shards[1:]:
+                acc = acc + np.asarray(get(s))  # elementwise, own dtype
+            return acc
+
+        def scatter_svc(get):
+            # psum over request axes + tiled psum_scatter over svc ==
+            # the zero-padded total sum laid out over the svc axis
+            x = allsum(get)
+            pad = self.s_pad - x.shape[0]
+            if pad:
+                x = np.pad(x, [(0, pad)] + [(0, 0)] * (x.ndim - 1))
+            return x
+
+        counts = stack(lambda s: s.count)          # (R,) f32
+        sums = stack(lambda s: s.latency_sum)
+        m2s = stack(lambda s: s.latency_m2)
+        n_tot = allsum(lambda s: s.count)
+        s_tot = allsum(lambda s: s.latency_sum)
+        mean_local = sums / np.maximum(counts, counts.dtype.type(1.0))
+        mean_tot = s_tot / np.maximum(n_tot, n_tot.dtype.type(1.0))
+        terms = m2s + counts * (mean_local - mean_tot) ** 2
+        m2_tot = terms[0]
+        for t in terms[1:]:
+            m2_tot = m2_tot + t
+        m = shards[0].metrics
+        metrics = None
+        if m is not None:
+            metrics = ServiceMetrics(
+                incoming_total=allsum(lambda s: s.metrics.incoming_total),
+                outgoing_total=allsum(lambda s: s.metrics.outgoing_total),
+                outgoing_size_hist=allsum(
+                    lambda s: s.metrics.outgoing_size_hist
+                ),
+                outgoing_size_sum=allsum(
+                    lambda s: s.metrics.outgoing_size_sum
+                ),
+                duration_hist=scatter_svc(
+                    lambda s: s.metrics.duration_hist
+                ),
+                duration_sum=allsum(lambda s: s.metrics.duration_sum),
+                response_size_hist=scatter_svc(
+                    lambda s: s.metrics.response_size_hist
+                ),
+                response_size_sum=allsum(
+                    lambda s: s.metrics.response_size_sum
+                ),
+            )
+        return RunSummary(
+            count=n_tot,
+            error_count=allsum(lambda s: s.error_count),
+            hop_events=allsum(lambda s: s.hop_events),
+            latency_sum=s_tot,
+            latency_m2=m2_tot,
+            latency_min=stack(lambda s: s.latency_min).min(axis=0),
+            latency_max=stack(lambda s: s.latency_max).max(axis=0),
+            latency_hist=allsum(lambda s: s.latency_hist),
+            end_max=stack(lambda s: s.end_max).max(axis=0),
+            win_lo=np.asarray(shards[0].win_lo),
+            win_hi=np.asarray(shards[0].win_hi),
+            win_count=allsum(lambda s: s.win_count),
+            win_error_count=allsum(lambda s: s.win_error_count),
+            win_latency_hist=allsum(lambda s: s.win_latency_hist),
+            metrics=metrics,
+            utilization=np.asarray(shards[0].utilization),
+            unstable=np.asarray(shards[0].unstable),
         )

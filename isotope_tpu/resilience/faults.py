@@ -1,0 +1,209 @@
+"""Deterministic engine-level fault injection (chaos for the engine).
+
+The workload simulator already has chaos schedules (replica killers,
+outages); this module aims the same discipline at the ENGINE: every
+recovery path in the supervisor — retry, degradation ladder, cache
+quarantine, numeric sentinels — must be exercisable on CPU in tests
+and smoke targets, not just on a TPU that happens to OOM.
+
+Spec syntax (``$ISOTOPE_FAULT_INJECT`` or :func:`install`)::
+
+    ISOTOPE_FAULT_INJECT=oom:sharded.gather:1,nan:segment:2
+
+comma-separated ``kind:site[:arg]`` entries:
+
+- ``oom:<site>[:count]`` — raise a ``RESOURCE_EXHAUSTED``-shaped fault
+  the first ``count`` times ``check(site)`` runs (default 1);
+- ``transient:<site>[:count]`` — same, ``UNAVAILABLE``-shaped;
+- ``corrupt:<site>[:count]`` — same, shaped like a corrupted
+  persistent-cache entry (unpickle/digest failure);
+- ``nan:segment:<index>`` — poison the output of tensor-program
+  segment ``<index>`` with NaN at trace time (``arg`` is the segment
+  index, not a count; exercises the numeric sentinels and detail-mode
+  localization).
+
+Sites are the supervisor's phase names: ``engine.build``,
+``engine.run``, ``sharded.args_put``, ``sharded.compute``,
+``sharded.gather``, ``cache.load``.  ``check(site)`` is a dict lookup
+returning immediately when no plan is armed — the default no-fault
+path gains zero work and zero sync points.
+"""
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Dict, List, Optional
+
+from isotope_tpu import telemetry
+from isotope_tpu.resilience.taxonomy import (
+    DETERMINISTIC,
+    RESOURCE_EXHAUSTED,
+    TRANSIENT,
+    InjectedFault,
+)
+
+ENV_FAULT_INJECT = "ISOTOPE_FAULT_INJECT"
+
+KINDS = ("oom", "transient", "corrupt", "nan")
+
+#: fault kind -> (message template, taxonomy class).  Messages imitate
+#: the real failure text so the taxonomy classifies injected faults by
+#: the same patterns as real ones (the explicit class is a backstop).
+_SHAPES = {
+    "oom": (
+        "RESOURCE_EXHAUSTED: out of memory while running {site} "
+        "(injected fault)",
+        RESOURCE_EXHAUSTED,
+    ),
+    "transient": (
+        "UNAVAILABLE: injected transient fault at {site}",
+        TRANSIENT,
+    ),
+    "corrupt": (
+        "corrupted persistent-cache entry at {site}: digest mismatch "
+        "(injected fault, unpickling failed)",
+        DETERMINISTIC,
+    ),
+}
+
+
+@dataclasses.dataclass
+class _Entry:
+    kind: str
+    site: str
+    arg: int          # fire count (oom/transient/corrupt) or segment (nan)
+    remaining: int
+
+
+class FaultPlan:
+    """A parsed, mutable injection plan (per-entry fire budgets)."""
+
+    def __init__(self, entries: List[_Entry]):
+        self.entries = entries
+        self._by_site: Dict[str, List[_Entry]] = {}
+        for e in entries:
+            if e.kind != "nan":
+                self._by_site.setdefault(e.site, []).append(e)
+
+    @classmethod
+    def parse(cls, spec: str) -> "FaultPlan":
+        entries: List[_Entry] = []
+        for part in spec.split(","):
+            part = part.strip()
+            if not part:
+                continue
+            bits = part.split(":")
+            if len(bits) not in (2, 3):
+                raise ValueError(
+                    f"bad fault spec {part!r} (want kind:site[:arg])"
+                )
+            kind, site = bits[0].strip(), bits[1].strip()
+            if kind not in KINDS:
+                raise ValueError(
+                    f"unknown fault kind {kind!r} (one of {KINDS})"
+                )
+            arg = int(bits[2]) if len(bits) == 3 else (
+                0 if kind == "nan" else 1
+            )
+            if kind == "nan" and site != "segment":
+                raise ValueError(
+                    f"nan faults target segments (nan:segment:<idx>), "
+                    f"got site {site!r}"
+                )
+            entries.append(
+                _Entry(kind=kind, site=site, arg=arg,
+                       remaining=0 if kind == "nan" else arg)
+            )
+        return cls(entries)
+
+    def pop(self, site: str) -> Optional[_Entry]:
+        """The first live entry at ``site``, its budget decremented."""
+        for e in self._by_site.get(site, ()):
+            if e.remaining > 0:
+                e.remaining -= 1
+                return e
+        return None
+
+    def nan_segment(self) -> Optional[int]:
+        for e in self.entries:
+            if e.kind == "nan":
+                return e.arg
+        return None
+
+    def signature(self) -> str:
+        """Stable identity of the TRACE-AFFECTING part of the plan.
+
+        Only NaN poisoning changes the traced program (it bakes a NaN
+        constant into a segment), so only it participates — the
+        executable caches must not share a poisoned program with a
+        clean one, while pure host-side faults keep full cache reuse.
+        """
+        seg = self.nan_segment()
+        return "" if seg is None else f"nan:segment:{seg}"
+
+
+_plan: Optional[FaultPlan] = None
+_env_loaded = False
+
+
+def _load_env() -> None:
+    global _plan, _env_loaded
+    _env_loaded = True
+    spec = os.environ.get(ENV_FAULT_INJECT)
+    if spec:
+        _plan = FaultPlan.parse(spec)
+        telemetry.counter_inc("fault_plan_armed", 0.0)  # visibility key
+
+
+def install(spec: str) -> FaultPlan:
+    """Arm a plan programmatically (tests); replaces any existing one."""
+    global _plan, _env_loaded
+    _plan = FaultPlan.parse(spec)
+    _env_loaded = True
+    return _plan
+
+
+def clear() -> None:
+    """Disarm injection (and stop re-reading the environment)."""
+    global _plan, _env_loaded
+    _plan = None
+    _env_loaded = True
+
+
+def active() -> bool:
+    if not _env_loaded:
+        _load_env()
+    return _plan is not None
+
+
+def check(site: str) -> None:
+    """Raise the planned fault for ``site``, if any budget remains.
+
+    Called unconditionally from the instrumented phases; with no plan
+    armed this is one boolean test.
+    """
+    if not _env_loaded:
+        _load_env()
+    if _plan is None:
+        return
+    entry = _plan.pop(site)
+    if entry is None:
+        return
+    telemetry.counter_inc("faults_injected")
+    telemetry.counter_inc(f"faults_injected.{entry.kind}")
+    msg, fault_class = _SHAPES[entry.kind]
+    raise InjectedFault(msg.format(site=site), fault_class)
+
+
+def nan_segment() -> Optional[int]:
+    """The segment index to poison with NaN, or None (trace-time hook)."""
+    if not _env_loaded:
+        _load_env()
+    return None if _plan is None else _plan.nan_segment()
+
+
+def signature() -> str:
+    """Trace-affecting plan identity for executable-cache keys."""
+    if not _env_loaded:
+        _load_env()
+    return "" if _plan is None else _plan.signature()

@@ -1,0 +1,101 @@
+"""Error taxonomy: classify engine-phase exceptions by recovery action.
+
+The classes mirror what a training stack builds around device failures
+(PAPERS.md: Pathways' resilient dataflow; JAX persistent-cache
+durability) — WHAT failed matters less than WHAT TO DO NEXT:
+
+- ``TRANSIENT`` — retry with backoff: infrastructure hiccups (RPC
+  deadline, socket reset, preempted device, interrupted syscall) that
+  a later identical attempt is expected to survive.
+- ``RESOURCE_EXHAUSTED`` — degrade, don't retry: the same program at
+  the same shape will OOM again; the supervisor walks the degradation
+  ladder instead (smaller chunks, fewer devices, CPU eager).
+- ``DETERMINISTIC`` — fail the case, keep the sweep: shape errors,
+  invalid arguments, numeric-sentinel violations.  Retrying burns
+  hours reproducing the same traceback, so the case is recorded as
+  failed in the checkpoint and the sweep continues.
+
+Classification is by exception *type* where python gives one
+(``ConnectionError``, ``TimeoutError``) and by message pattern for the
+XLA status strings jaxlib flattens into ``XlaRuntimeError`` text
+(``RESOURCE_EXHAUSTED: ...``, ``UNAVAILABLE: ...``) — there is no
+stable exception subclass per status code across jaxlib versions.
+
+Kept import-light on purpose (no jax): the converter-only environment
+and the fault-injection hooks both load this module.
+"""
+from __future__ import annotations
+
+import re
+
+#: retry with exponential backoff + deterministic jitter
+TRANSIENT = "transient"
+#: walk the degradation ladder (never naively retried)
+RESOURCE_EXHAUSTED = "resource_exhausted"
+#: record the case as failed; the sweep continues
+DETERMINISTIC = "deterministic"
+
+# XLA flattens its absl status codes into the message text; match the
+# canonical code names plus the allocator phrasings TPU/CPU backends
+# emit without a code prefix.
+_RESOURCE_RE = re.compile(
+    r"RESOURCE_EXHAUSTED|OUT_OF_MEMORY|out of memory|out-of-memory"
+    r"|\bOOM\b|[Ff]ailed to allocate|[Aa]llocation .* exceeds"
+    r"|exceeds the memory|[Ii]nsufficient memory",
+)
+_TRANSIENT_RE = re.compile(
+    r"UNAVAILABLE|DEADLINE_EXCEEDED|\bABORTED\b|\bCANCELLED\b"
+    r"|[Cc]onnection reset|[Ss]ocket closed|[Tt]emporarily unavailable"
+    r"|[Tt]ry again|[Pp]reempt",
+)
+_CORRUPT_RE = re.compile(
+    r"unpickl|[Cc]orrupt|[Dd]igest mismatch|deserial|[Bb]ad cache entry"
+    r"|[Tt]runcated cache|zstd|[Ii]nvalid compilation cache",
+)
+
+
+class InjectedFault(RuntimeError):
+    """A deterministic fault raised by :mod:`resilience.faults`.
+
+    Carries its class explicitly so injected faults classify exactly
+    like the real exception they imitate, whatever the message says.
+    """
+
+    def __init__(self, message: str, fault_class: str):
+        super().__init__(message)
+        self.fault_class = fault_class
+
+
+class NumericSentinelError(RuntimeError):
+    """A run produced non-finite or negative outputs (sentinels.py).
+
+    Deterministic by definition: the same program on the same inputs
+    reproduces the same NaN, so the supervisor fails the case instead
+    of retrying.
+    """
+
+    fault_class = DETERMINISTIC
+
+
+def classify(exc: BaseException) -> str:
+    """Map an exception to its recovery class (see module docstring)."""
+    explicit = getattr(exc, "fault_class", None)
+    if explicit in (TRANSIENT, RESOURCE_EXHAUSTED, DETERMINISTIC):
+        return explicit
+    if isinstance(exc, (ConnectionError, TimeoutError, InterruptedError)):
+        return TRANSIENT
+    if isinstance(exc, MemoryError):
+        return RESOURCE_EXHAUSTED
+    text = f"{type(exc).__name__}: {exc}"
+    if _RESOURCE_RE.search(text):
+        return RESOURCE_EXHAUSTED
+    if _TRANSIENT_RE.search(text):
+        return TRANSIENT
+    return DETERMINISTIC
+
+
+def is_cache_corruption(exc: BaseException) -> bool:
+    """Whether ``exc`` looks like a corrupted persistent-cache entry
+    (digest mismatch / unpickle failure) — the one deterministic error
+    with a better move than failing: evict the entry and retrace."""
+    return bool(_CORRUPT_RE.search(f"{type(exc).__name__}: {exc}"))

@@ -41,6 +41,13 @@ from isotope_tpu.metrics.fortio import (
 from isotope_tpu.metrics.prometheus import MetricsCollector
 from isotope_tpu.models.graph import ServiceGraph
 from isotope_tpu.parallel import ShardedSimulator, make_mesh
+from isotope_tpu.resilience import (
+    ResiliencePolicy,
+    call_with_retries,
+    classify,
+    execution_rungs,
+    run_ladder,
+)
 from isotope_tpu.runner.config import ExperimentConfig
 from isotope_tpu.sim.config import OPEN_LOOP, LoadModel
 from isotope_tpu.sim.engine import Simulator
@@ -58,6 +65,20 @@ class RunResult:
     # engine self-telemetry snapshot (RunTelemetry.to_dict()); None when
     # telemetry emission is off or the run was restored from checkpoint
     telemetry: Optional[dict] = None
+    # which degradation-ladder rung served the run (None = undegraded)
+    degraded_to: Optional[str] = None
+    # unrecoverable failure: the case is recorded, the sweep continued
+    failed: bool = False
+    error: Optional[str] = None
+
+
+def _failed_window(reason: str) -> WindowSummary:
+    return WindowSummary(
+        start_s=0.0, duration_s=0.0, count=0, qps=0.0,
+        error_percent=100.0, discarded=True,
+        discard_reason=f"run failed: {reason}",
+        percentiles_us={}, cpu_cores={},
+    )
 
 
 def _label(topo_path: str, env: str, load: LoadModel, extra: str) -> str:
@@ -148,7 +169,15 @@ def _config_fingerprint(config: ExperimentConfig) -> str:
 
 
 def _load_checkpoint(path: pathlib.Path, fingerprint: str) -> List[dict]:
-    """Completed-run records, or [] when absent/config-mismatched."""
+    """Trustworthy records, or [] when absent/config-mismatched.
+
+    A corrupted or truncated line (SIGKILL mid-append, disk trouble) is
+    QUARANTINED — skipped and counted — instead of invalidating
+    everything after it: records are self-contained and matched by
+    label, so one bad line costs exactly one re-run.  Failure records
+    (``"failed": true``) are loaded too; the resume loop re-executes
+    those cases.
+    """
     if not path.exists():
         return []
     lines = path.read_text().splitlines()
@@ -161,17 +190,23 @@ def _load_checkpoint(path: pathlib.Path, fingerprint: str) -> List[dict]:
     if header.get("config") != fingerprint:
         return []
     records = []
-    for line in lines[1:]:
+    for i, line in enumerate(lines[1:], 2):
         line = line.strip()
         if not line:
             continue
         try:
             rec = json.loads(line)
         except json.JSONDecodeError:
-            # a kill mid-write leaves a truncated tail line: that run's
-            # record is lost, so resume re-executes it (and stops
-            # trusting anything after the corruption point)
-            break
+            telemetry.counter_inc("checkpoint_quarantined_records")
+            print(
+                f"warning: quarantined corrupt checkpoint record "
+                f"{path}:{i} (its run will re-execute)",
+                file=sys.stderr,
+            )
+            continue
+        if not isinstance(rec, dict) or "label" not in rec:
+            telemetry.counter_inc("checkpoint_quarantined_records")
+            continue
         records.append(rec)
     return records
 
@@ -188,6 +223,7 @@ def _restore_result(rec: dict, out: pathlib.Path) -> RunResult:
         prometheus_text=(
             prom_path.read_text() if prom_path.exists() else ""
         ),
+        degraded_to=rec.get("degraded_to"),
     )
 
 
@@ -198,13 +234,21 @@ def run_experiment(
     resume: bool = True,
     profile_dir: Optional[str] = None,
     export: Sequence[str] = (),
+    policy: Optional[ResiliencePolicy] = None,
 ) -> List[RunResult]:
     """``profile_dir`` captures a ``jax.profiler`` trace per executed run
     into ``<profile_dir>/<label>/`` — the analogue of the reference's
     per-run ``perf record`` flame capture (runner.py:405-417), readable
     in TensorBoard/XProf.  ``export`` lists exporter specs (e.g.
     ``bigquery:proj.ds.table``) run over the collected results after the
-    CSV is written — the collector's upload hook (fortio.py:235-242)."""
+    CSV is written — the collector's upload hook (fortio.py:235-242).
+
+    Every device-touching phase runs under the resilience supervisor
+    (``policy``; default from ``ISOTOPE_MAX_RETRIES`` /
+    ``ISOTOPE_NO_DEGRADE``): transients retry with backoff, OOM walks
+    the degradation ladder, and an unrecoverable case is recorded as
+    FAILED in the checkpoint while the sweep continues — resume retries
+    failed cases and never re-runs completed ones."""
     # resolve exporter specs up front: a typo'd --export must fail
     # before hours of simulation, not after
     exporters = []
@@ -220,6 +264,8 @@ def run_experiment(
 
         exporters = [resolve_exporter(s) for s in export]
 
+    if policy is None:
+        policy = ResiliencePolicy.from_env()
     results: List[RunResult] = []
     key = jax.random.PRNGKey(config.seed)
     mesh_svc = max(config.mesh_svc, 1)
@@ -229,9 +275,31 @@ def run_experiment(
         else max(jax.device_count() // mesh_svc, 1)
     )
 
+    # Labels are the identity of a run everywhere downstream — the
+    # artifact filenames, the checkpoint restore key, the CSV rows.  A
+    # colliding grid (two topology files with the same stem, or a
+    # duplicated load row) would silently clobber artifacts and restore
+    # the wrong record, so it must fail loudly up front.
+    grid_labels = [
+        _label(topo_path, env.name, load, config.labels)
+        for topo_path in config.topology_paths
+        for env in config.environments
+        for load in config.load_models()
+    ]
+    dupes = {lb for lb in grid_labels if grid_labels.count(lb) > 1}
+    if dupes:
+        raise ValueError(
+            f"duplicate run label(s) in the sweep grid: "
+            f"{sorted(dupes)} — disambiguate the topology filenames "
+            "(labels use the file stem) or the load grid"
+        )
+
     out = ckpt_path = ckpt_file = None
     done_records: List[dict] = []
     fingerprint = _config_fingerprint(config)
+    # label-keyed restore (latest record wins): completed cases are
+    # never re-run, FAILED and quarantined-corrupt cases are
+    done: dict = {}
     if out_dir is not None:
         out = pathlib.Path(out_dir)
         out.mkdir(parents=True, exist_ok=True)
@@ -250,6 +318,8 @@ def run_experiment(
             os.fsync(tmp.fileno())
         os.replace(tmp_path, ckpt_path)
         ckpt_file = open(ckpt_path, "a")
+        for rec in done_records:
+            done[rec["label"]] = rec
 
     try:
         run_index = 0
@@ -258,13 +328,8 @@ def run_experiment(
             for env in config.environments:
                 for load in config.load_models():
                     label = _label(topo_path, env.name, load, config.labels)
-                    if run_index < len(done_records):
-                        rec = done_records[run_index]
-                        if rec["label"] != label:
-                            raise ValueError(
-                                f"checkpoint out of order: run {run_index}"
-                                f" is {rec['label']!r}, expected {label!r}"
-                            )
+                    rec = done.get(label)
+                    if rec is not None and not rec.get("failed"):
                         results.append(_restore_result(rec, out))
                         run_index += 1
                         continue
@@ -277,36 +342,85 @@ def run_experiment(
                         # run's simulators build/compile/execute
                         telemetry.reset()
                     run_key = jax.random.fold_in(key, run_index)
-                    sim, sharded = topo.sims(env)
-                    n = _num_requests(
-                        load, sim.capacity_qps(), config.num_requests
-                    )
-                    # the scan path is the product path: requests stream
-                    # through HBM-bounded blocks, metrics and the trim
-                    # window accumulate on device
-                    block = sim.default_block_size()
-                    use_sharded = sharded is not None and (
-                        load.kind == OPEN_LOOP
-                        or load.connections % sharded.n_shards == 0
-                    )
                     if profile_dir is not None:
                         prof_ctx = jax.profiler.trace(
                             str(pathlib.Path(profile_dir) / label)
                         )
                     else:
                         prof_ctx = contextlib.nullcontext()
-                    with prof_ctx:
-                        if use_sharded:
-                            summary = sharded.run(
-                                load, n, run_key, block_size=block,
-                                trim=True,
+                    try:
+                        with prof_ctx:
+                            # engine build (device-constant upload, first
+                            # compile triggers inside the run) is itself
+                            # a supervised phase
+                            sim, sharded = call_with_retries(
+                                lambda: topo.sims(env),
+                                site="engine.build", policy=policy,
                             )
-                        else:
-                            summary = sim.run_summary(
-                                load, n, run_key, block_size=block,
+                            n = _num_requests(
+                                load, sim.capacity_qps(),
+                                config.num_requests,
+                            )
+                            # the scan path is the product path: requests
+                            # stream through HBM-bounded blocks, metrics
+                            # and the trim window accumulate on device
+                            block = sim.default_block_size()
+                            use_sharded = sharded is not None and (
+                                load.kind == OPEN_LOOP
+                                or load.connections % sharded.n_shards
+                                == 0
+                            )
+                            rungs = execution_rungs(
+                                sim, sharded, use_sharded, load, n,
+                                run_key, block,
                                 collector=topo.collector, trim=True,
                             )
-                        jax.block_until_ready(summary.count)
+                            summary, degraded_to = run_ladder(
+                                rungs, policy, site_prefix="engine"
+                            )
+                    except Exception as e:
+                        # unrecoverable for THIS case (deterministic
+                        # error, retries/ladder exhausted): record it,
+                        # keep the sweep alive — the reference's sweeps
+                        # survive one broken deployment the same way
+                        err_class = classify(e)
+                        err_text = f"{type(e).__name__}: {e}"
+                        telemetry.counter_inc("run_failures")
+                        print(
+                            f"error: run {label} failed "
+                            f"({err_class}): {err_text}",
+                            file=sys.stderr,
+                        )
+                        failed = RunResult(
+                            label=label,
+                            topology=topo_path,
+                            environment=env.name,
+                            flat={"Labels": label, "failed": True,
+                                  "error": err_text},
+                            window=_failed_window(err_text),
+                            fortio_json={},
+                            prometheus_text="",
+                            failed=True,
+                            error=err_text,
+                        )
+                        results.append(failed)
+                        if ckpt_file is not None:
+                            ckpt_file.write(
+                                json.dumps(
+                                    {
+                                        "label": label,
+                                        "topology": topo_path,
+                                        "environment": env.name,
+                                        "failed": True,
+                                        "error": err_text[:1000],
+                                        "error_class": err_class,
+                                    }
+                                )
+                                + "\n"
+                            )
+                            ckpt_file.flush()
+                        run_index += 1
+                        continue
                     doc = fortio_result_from_summary(
                         summary, load, labels=label,
                         response_size_bytes=topo.entry_response_size,
@@ -318,6 +432,12 @@ def run_experiment(
                         replicas=topo.compiled.services.replicas,
                     )
                     flat["windowDiscarded"] = window.discarded
+                    if degraded_to is not None:
+                        # degradation is run METADATA: a sweep row that
+                        # came off a fallback rung must say so (and
+                        # bench_regress fails a capture that degrades a
+                        # previously-clean case)
+                        flat["degraded_to"] = degraded_to
                     flat.update(
                         {
                             "cpu_cores_" + name: round(v, 4)
@@ -345,6 +465,7 @@ def run_experiment(
                         telemetry=(
                             run_telem.to_dict() if run_telem else None
                         ),
+                        degraded_to=degraded_to,
                     )
                     results.append(result)
                     if out is not None:
@@ -355,25 +476,24 @@ def run_experiment(
                         (out / f"{label}.prom").write_text(prom_text)
                         if run_telem is not None:
                             run_telem.append_jsonl(out / "telemetry.jsonl")
-                        ckpt_file.write(
-                            json.dumps(
-                                {
-                                    "label": label,
-                                    "topology": topo_path,
-                                    "environment": env.name,
-                                    "flat": flat,
-                                    "window": dataclasses.asdict(window),
-                                    "fortio_json": doc,
-                                }
-                            )
-                            + "\n"
-                        )
+                        rec_out = {
+                            "label": label,
+                            "topology": topo_path,
+                            "environment": env.name,
+                            "flat": flat,
+                            "window": dataclasses.asdict(window),
+                            "fortio_json": doc,
+                        }
+                        if degraded_to is not None:
+                            rec_out["degraded_to"] = degraded_to
+                        ckpt_file.write(json.dumps(rec_out) + "\n")
                         ckpt_file.flush()
                     run_index += 1
     finally:
         if ckpt_file is not None:
             ckpt_file.close()
 
+    ok = [r for r in results if not r.failed]
     if out is not None:
         with open(out / "results.jsonl", "w") as f:
             for r in results:
@@ -381,16 +501,24 @@ def run_experiment(
         # the per-service cpu_cores_<svc> columns are record-dependent;
         # append them so `plot --metrics cpu_cores_<svc>` works off this CSV
         extra_keys = sorted(
-            {k for r in results for k in r.flat if k.startswith("cpu_cores_")}
+            {k for r in ok for k in r.flat if k.startswith("cpu_cores_")}
         )
         keys = DEFAULT_CSV_KEYS
         if extra_keys:
             keys = keys + "," + ",".join(extra_keys)
         write_csv(
             keys,
-            [r.flat for r in results],
+            [r.flat for r in ok],
             out / "benchmark.csv",
         )
         for exporter in exporters:
             print(exporter(results, out), file=sys.stderr)
+    n_failed = len(results) - len(ok)
+    if n_failed:
+        print(
+            f"warning: {n_failed} run(s) failed and were recorded in "
+            "the checkpoint; re-invoke with the same config to retry "
+            "them",
+            file=sys.stderr,
+        )
     return results

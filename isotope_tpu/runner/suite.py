@@ -56,6 +56,7 @@ def run_suite(
     mem_limit_mib: float = 64.0,
     progress=None,
     resume: bool = True,
+    policy=None,
 ) -> SuiteResult:
     """Run every config, publish one artifact tree, monitor every run."""
     cfgs = [(p, load_toml(p)) for p in config_paths]
@@ -79,7 +80,8 @@ def run_suite(
         stem = pathlib.Path(cfg_path).stem
         out_dir = publish / stem
         results = run_experiment(
-            cfg, out_dir=str(out_dir), progress=progress, resume=resume
+            cfg, out_dir=str(out_dir), progress=progress, resume=resume,
+            policy=policy,
         )
         queries = standard_queries(
             stem, cpu_lim=cpu_limit_mcores, mem_lim=mem_limit_mib
@@ -114,6 +116,13 @@ def run_suite(
                     1 for r in results if r.window.discarded
                 ),
                 "alarms": alarm_count,
+                # engine-level resilience outcomes: cases the supervisor
+                # could not recover (retried on the next resume) and
+                # cases served degraded (counted, never silent)
+                "failed": sum(1 for r in results if r.failed),
+                "degraded": sum(
+                    1 for r in results if r.degraded_to is not None
+                ),
             }
         )
         total_runs += len(results)
@@ -124,6 +133,8 @@ def run_suite(
         "configs": configs_out,
         "total_runs": total_runs,
         "total_alarms": sum(c["alarms"] for c in configs_out),
+        "total_failed": sum(c["failed"] for c in configs_out),
+        "total_degraded": sum(c["degraded"] for c in configs_out),
     }
     with open(publish / "manifest.json", "w") as f:
         json.dump(manifest, f, indent=2)

@@ -50,6 +50,7 @@ import numpy as np
 from isotope_tpu import telemetry
 from isotope_tpu.compiler import buckets
 from isotope_tpu.compiler.cache import array_digest, executable_cache
+from isotope_tpu.resilience import faults
 from isotope_tpu.compiler.program import CompiledGraph, hop_wire_times
 from isotope_tpu.sim import levelscan, queueing
 from isotope_tpu.sim.config import (
@@ -208,6 +209,7 @@ class Simulator:
         # bucket planning, copula tables — the host-side cost a compile
         # report should show next to trace/lower/backend seconds
         telemetry.install_jax_hooks()
+        faults.check("engine.build")
         _t_build = time.perf_counter()
         self.compiled = compiled
         self.params = params
@@ -796,6 +798,10 @@ class Simulator:
             self._plan_sig,
             compiled.shape_signature(),
             array_digest(
+                # an armed NaN-injection plan bakes a poisoned constant
+                # into the traced program: it must never share an
+                # executable with the clean trace (empty when off)
+                faults.signature(),
                 repr(params), repr(tuple(chaos)), repr(self._churn),
                 repr(mtls), repr(t.names),
                 compiled.hop_service, compiled.hop_parent,
@@ -1322,6 +1328,7 @@ class Simulator:
         so we solve ``lam = min(qps, C / E[latency(lam)], capacity)`` by a
         few pilot iterations before the full run.
         """
+        faults.check("engine.run")
         if load.kind == OPEN_LOOP:
             with self._detail_ctx():
                 return self._get(num_requests, OPEN_LOOP)(
@@ -1522,6 +1529,7 @@ class Simulator:
         sat = self._saturated(load)
         fn = self._get_summary(block, num_blocks, load.kind, conns,
                                collector, trim, sat=sat)
+        faults.check("engine.run")
         telemetry.gauge_set("engine_block_requests", block)
         telemetry.gauge_set("engine_num_blocks", num_blocks)
         with self._detail_ctx():
@@ -2111,10 +2119,14 @@ class Simulator:
         for si in reversed(range(len(self._segments))):
             seg = self._segments[si]
             if isinstance(seg, levelscan.ScanBucket):
-                up_units.append(("bucket", si))
+                up_units.append(("bucket", si, si))
             else:
-                up_units.append(("lvl", seg.d))
-        for _kind, _idx in up_units:
+                up_units.append(("lvl", seg.d, si))
+        # engine-level chaos (trace-time): ISOTOPE_FAULT_INJECT
+        # nan:segment:<i> poisons segment i's output so the numeric
+        # sentinels (and detail-mode localization) are CPU-testable
+        nan_seg = faults.nan_segment()
+        for _kind, _idx, _si in up_units:
             if _kind == "bucket":
                 seg = self._segments[_idx]
                 B = seg.plan.bound_hops
@@ -2134,6 +2146,8 @@ class Simulator:
                 lat_lvls[d0] = ys["lat"][0][:, :s0]
                 if self._track_err:
                     err_lvls[d0] = ys["err"][0][:, :s0]
+                if nan_seg == _si:
+                    lat_lvls[d0] = lat_lvls[d0].at[:, 0].set(jnp.nan)
                 telemetry.segment_fence(
                     f"up.scan[{d0}-{d1}]", lat_lvls[d0]
                 )
@@ -2415,6 +2429,8 @@ class Simulator:
                         used_lvls[d] * att_off[:, : lvl.num_children]
                     )
                 off_lvls[d] = off
+            if nan_seg == _si:
+                lat_lvls[d] = lat_lvls[d].at[:, 0].set(jnp.nan)
             telemetry.segment_fence(f"up.lvl[{d}]", lat_lvls[d])
 
         # ---- downward pass: which hops actually execute ------------------

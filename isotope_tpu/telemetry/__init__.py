@@ -18,7 +18,9 @@ from isotope_tpu.telemetry.core import (  # noqa: F401
     gauge_get,
     gauge_max,
     gauge_set,
+    get_meta,
     install_jax_hooks,
+    iter_jsonl,
     phase,
     phase_add,
     phase_seconds,
@@ -27,6 +29,7 @@ from isotope_tpu.telemetry.core import (  # noqa: F401
     record_trace,
     reset,
     segment_fence,
+    set_meta,
     snapshot,
     summary_block,
     summary_line,

@@ -72,6 +72,7 @@ class _State:
         self.counters: Dict[str, float] = {}
         self.gauges: Dict[str, float] = {}
         self.phases: Dict[str, float] = {}
+        self.meta: Dict[str, Any] = {}  # run annotations (degraded_to, ...)
         self.emit = False          # artifact emission requested (--telemetry)
         self.detail = False        # segment fencing armed (--telemetry=detail)
         self.trace_keys: Set[tuple] = set()
@@ -112,6 +113,7 @@ def reset() -> None:
     _STATE.counters.clear()
     _STATE.gauges.clear()
     _STATE.phases.clear()
+    _STATE.meta.clear()
     _STATE.trace_keys.clear()
     _STATE.last_fence_t = None
 
@@ -120,6 +122,19 @@ def reset() -> None:
 
 def counter_inc(name: str, n: float = 1.0) -> None:
     _STATE.counters[name] = _STATE.counters.get(name, 0.0) + n
+
+
+def set_meta(key: str, value: Any) -> None:
+    """Annotate the current run record (e.g. ``degraded_to``).
+
+    Meta entries land in the snapshot's ``meta`` section and the
+    ``summary_block`` headline — not in the numeric Prometheus series.
+    """
+    _STATE.meta[key] = value
+
+
+def get_meta(key: str, default: Any = None) -> Any:
+    return _STATE.meta.get(key, default)
 
 
 def counter_get(name: str) -> float:
@@ -172,6 +187,18 @@ def phase(name: str) -> Iterator[None]:
         phase_add(name, time.perf_counter() - t0)
 
 
+def _under_disable_jit() -> bool:
+    """Whether jax is executing eagerly (detail mode, the resilience
+    ladder's cpu-eager rung): program-level compile timings are
+    meaningless there — an eager 'first call' is the whole run."""
+    try:
+        import jax
+
+        return bool(jax.config.jax_disable_jit)
+    except Exception:  # pragma: no cover - converter-only env
+        return False
+
+
 def time_first_call(fn, phase_name: str, counter: str = "jit_first_calls"):
     """Wrap a callable so its FIRST invocation is phase-timed.
 
@@ -191,10 +218,11 @@ def time_first_call(fn, phase_name: str, counter: str = "jit_first_calls"):
         def __call__(self, *args, **kwargs):
             if self._first_done:
                 return self._fn(*args, **kwargs)
-            if detail_enabled():
-                # detail mode executes eagerly (jax.disable_jit): the
-                # call's wall time is the whole run, not a compile —
-                # leave the first-call slot open for a real jitted call
+            if detail_enabled() or _under_disable_jit():
+                # eager execution (detail mode, or the degradation
+                # ladder's cpu-eager rung): the call's wall time is the
+                # whole run, not a compile — leave the first-call slot
+                # open for a real jitted call
                 return self._fn(*args, **kwargs)
             t0 = time.perf_counter()
             out = self._fn(*args, **kwargs)
@@ -260,6 +288,16 @@ def segment_fence(label: str, value) -> None:
     counter_inc("engine_fences")
     phase_add(f"segment.{label}", t1 - t_prev)
     _STATE.last_fence_t = t1
+    # numeric-sentinel localization: in detail mode the fence already
+    # holds the segment's concrete output, so a NaN is pinned to the
+    # segment that PRODUCED it (the post-run sentinel only sees the
+    # reduced summary).  Never raises — the run-level sentinel decides.
+    import numpy as np
+
+    arr = np.asarray(value)
+    if np.issubdtype(arr.dtype, np.floating) and np.isnan(arr).any():
+        counter_inc("numeric_sentinel_violations")
+        gauge_set("numeric_sentinel", 1.0, segment=label)
 
 
 def record_device_memory() -> Optional[float]:
@@ -307,17 +345,9 @@ def install_jax_hooks() -> bool:
     except Exception:  # pragma: no cover - converter-only env
         return False
 
-    def _under_disable_jit() -> bool:
-        # eager (detail-mode) execution compiles op-by-op: those
-        # per-primitive cache/compile events would drown the program-
-        # level numbers these hooks exist to surface
-        try:
-            import jax
-
-            return bool(jax.config.jax_disable_jit)
-        except Exception:  # pragma: no cover - defensive
-            return False
-
+    # eager execution compiles op-by-op: those per-primitive
+    # cache/compile events would drown the program-level numbers these
+    # hooks exist to surface — same guard as time_first_call
     def _on_duration(event, duration, *args, **kwargs):
         name = _JAX_EVENT_PHASES.get(event)
         if name is not None and not _under_disable_jit():
@@ -348,7 +378,9 @@ def summary_block() -> Dict[str, Any]:
     padded = c.get("bucket_padded_elems", 0.0)
     real = c.get("bucket_real_elems", 0.0)
     peak = g.get("device_memory_peak_bytes_max")
-    return {
+    blk: Dict[str, Any] = {
+        "retries_total": int(c.get("retries_total", 0.0)),
+        "degradations_total": int(c.get("degradations_total", 0.0)),
         "compile_s": round(
             p.get("compile.trace", 0.0)
             + p.get("compile.lower", 0.0)
@@ -370,6 +402,11 @@ def summary_block() -> Dict[str, Any]:
         ),
         "peak_device_bytes": peak,
     }
+    # key PRESENT only when the run actually degraded: bench_regress
+    # keys its degraded-on-a-previously-clean-case gate on presence
+    if _STATE.meta.get("degraded_to"):
+        blk["degraded_to"] = _STATE.meta["degraded_to"]
+    return blk
 
 
 def summary_line() -> str:
@@ -425,8 +462,21 @@ class RunTelemetry:
         return json.dumps(self.to_dict(), sort_keys=True)
 
     def append_jsonl(self, path) -> None:
+        # heal a crash-torn tail before appending: if the file does not
+        # end in a newline (a killed run's half-written record), start
+        # this record on a fresh line so the fragment stays an isolated
+        # bad line (which the readers skip-and-count) instead of
+        # swallowing this record into unreadable garbage
+        lead = ""
+        try:
+            with open(path, "rb") as f:
+                f.seek(-1, 2)
+                if f.read(1) not in (b"\n", b""):
+                    lead = "\n"
+        except OSError:
+            pass  # missing or empty file: nothing to heal
         with open(path, "a") as f:
-            f.write(self.to_json_line() + "\n")
+            f.write(lead + self.to_json_line() + "\n")
 
     def prometheus_text(self) -> str:
         return _render_prometheus(self.phases, self.counters, self.gauges)
@@ -444,6 +494,7 @@ def snapshot(label: Optional[str] = None) -> RunTelemetry:
         meta["jax_version"] = jax.__version__
     except Exception:  # pragma: no cover - converter-only env
         pass
+    meta.update(_STATE.meta)  # run annotations (degraded_to, ...)
     return RunTelemetry(
         label=label,
         phases={k: round(v, 6) for k, v in sorted(_STATE.phases.items())},
@@ -472,10 +523,24 @@ def _render_prometheus(phases, counters, gauges) -> str:
         " (cache hits/misses, buckets formed, traces, fences)."
     )
     out.append("# TYPE isotope_engine_events_total counter")
+    promoted = []
     for name, v in sorted(counters.items()):
+        if name.endswith("_total"):
+            # resilience headline counters (retries_total,
+            # degradations_total, ...) get their own first-class series
+            # — alert rules key on isotope_engine_degradations_total
+            # directly, not on a label of the events grab-bag
+            promoted.append((name, v))
+            continue
         out.append(
             f'isotope_engine_events_total{{event="{name}"}} {v:.10g}'
         )
+    for name, v in promoted:
+        out.append(
+            f"# HELP isotope_engine_{name} Engine resilience counter."
+        )
+        out.append(f"# TYPE isotope_engine_{name} counter")
+        out.append(f"isotope_engine_{name} {v:.10g}")
     # gauges carry their own (optional) label block in the key
     seen_families: Set[str] = set()
     for key, v in sorted(gauges.items()):
@@ -497,42 +562,63 @@ def prometheus_text() -> str:
     )
 
 
-# -- JSONL validation (make telemetry-smoke) -------------------------------
+# -- JSONL validation / iteration (make telemetry-smoke, readers) ----------
+
+def _jsonl_docs(path) -> Iterator[dict]:
+    """Parsed records of a ``telemetry.jsonl`` file.
+
+    An undecodable line — a crash mid-append leaving a torn tail, or a
+    torn fragment a later ``append_jsonl`` healed onto its own line —
+    is skipped and counted under ``telemetry_torn_lines``: one bad
+    line costs one record, never the file.  (Same quarantine policy as
+    the sweep checkpoint loader.)
+    """
+    with open(path) as f:
+        lines = f.read().splitlines()
+    for line in lines:
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            yield json.loads(line)
+        except json.JSONDecodeError:
+            counter_inc("telemetry_torn_lines")
+
+
+def iter_jsonl(path) -> Iterator["RunTelemetry"]:
+    """Iterate a ``telemetry.jsonl`` file as :class:`RunTelemetry`
+    records, quarantining crash-torn lines (see ``_jsonl_docs``)."""
+    for doc in _jsonl_docs(path):
+        yield RunTelemetry.from_dict(doc)
+
 
 def validate_jsonl(path) -> int:
     """Validate a ``telemetry.jsonl`` file; returns the record count.
 
     Raises ``ValueError`` on schema violations — the contract the
-    ``make telemetry-smoke`` target enforces.
+    ``make telemetry-smoke`` target enforces.  A crash-torn line (a
+    killed run's half-written record) is skipped, not an error.
     """
     n = 0
-    with open(path) as f:
-        for i, line in enumerate(f, 1):
-            line = line.strip()
-            if not line:
-                continue
-            try:
-                doc = json.loads(line)
-            except json.JSONDecodeError as e:
-                raise ValueError(f"{path}:{i}: not JSON: {e}") from e
-            if doc.get("schema") != SCHEMA:
+    for i, doc in enumerate(_jsonl_docs(path), 1):
+        if doc.get("schema") != SCHEMA:
+            raise ValueError(
+                f"{path}:{i}: schema {doc.get('schema')!r} != {SCHEMA!r}"
+            )
+        for section in ("phases", "counters", "gauges", "meta"):
+            if not isinstance(doc.get(section), dict):
                 raise ValueError(
-                    f"{path}:{i}: schema {doc.get('schema')!r} != {SCHEMA!r}"
+                    f"{path}:{i}: missing/invalid {section!r} section"
                 )
-            for section in ("phases", "counters", "gauges", "meta"):
-                if not isinstance(doc.get(section), dict):
+        for section in ("phases", "counters", "gauges"):
+            for k, v in doc[section].items():
+                if not isinstance(k, str) or not isinstance(
+                    v, (int, float)
+                ):
                     raise ValueError(
-                        f"{path}:{i}: missing/invalid {section!r} section"
+                        f"{path}:{i}: {section}[{k!r}] is not numeric"
                     )
-            for section in ("phases", "counters", "gauges"):
-                for k, v in doc[section].items():
-                    if not isinstance(k, str) or not isinstance(
-                        v, (int, float)
-                    ):
-                        raise ValueError(
-                            f"{path}:{i}: {section}[{k!r}] is not numeric"
-                        )
-            n += 1
+        n += 1
     if n == 0:
         raise ValueError(f"{path}: no telemetry records")
     return n

@@ -195,3 +195,34 @@ def test_no_baseline_skips(tmp_path, monkeypatch, capsys):
     monkeypatch.setattr(sys, "argv", ["bench_regress", str(new_path)])
     assert br.main() == 0
     assert "skipping" in capsys.readouterr().out
+
+
+def test_degraded_on_previously_clean_case_fails(tmp_path, monkeypatch,
+                                                 capsys):
+    # always-armed gate: a case the resilience supervisor served from a
+    # degradation-ladder rung is not comparable to its clean baseline
+    base = capture(2.0e9, {
+        "svc1000": 2.0e9, "svc1000_best": 2.1e9,
+        "svc1000_telemetry": {"compile_s": 5.0},
+    })
+    bad = capture(2.0e9, {
+        "svc1000": 2.0e9, "svc1000_best": 2.1e9,
+        "svc1000_telemetry": {"compile_s": 5.0,
+                              "degraded_to": "single-device"},
+    })
+    assert run_gate(tmp_path, monkeypatch, bad, base) == 1
+    assert "svc1000.degraded_to" in capsys.readouterr().out
+
+
+def test_degraded_both_rounds_passes(tmp_path, monkeypatch, capsys):
+    # a case that ALREADY ran degraded in the baseline stays comparable
+    base = capture(2.0e9, {
+        "svc1000": 2.0e9, "svc1000_best": 2.1e9,
+        "svc1000_telemetry": {"degraded_to": "half-block"},
+    })
+    new = capture(2.0e9, {
+        "svc1000": 2.0e9, "svc1000_best": 2.1e9,
+        "svc1000_telemetry": {"degraded_to": "half-block"},
+    })
+    assert run_gate(tmp_path, monkeypatch, new, base) == 0
+    assert "OK" in capsys.readouterr().out

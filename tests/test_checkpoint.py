@@ -164,6 +164,68 @@ def test_truncated_tail_record_is_tolerated(tmp_path):
     assert len(results) == 4
 
 
+def test_corrupted_middle_record_quarantined(tmp_path):
+    # bit rot / torn write in the MIDDLE of the checkpoint: only that
+    # record's run re-executes; completed records after it stay trusted
+    # (records are self-contained and label-keyed, not positional)
+    cfg = config(tmp_path)
+    out = tmp_path / "out"
+    run_experiment(cfg, out_dir=str(out))
+    ckpt = out / "checkpoint.jsonl"
+    lines = ckpt.read_text().splitlines()
+    assert len(lines) == 5  # header + 4 records
+    lines[2] = lines[2][: len(lines[2]) // 2]  # corrupt record #2
+    ckpt.write_text("\n".join(lines) + "\n")
+    ran = []
+    results = run_experiment(cfg, out_dir=str(out), progress=ran.append)
+    assert len(ran) == 1  # only the quarantined record's run
+    assert len(results) == 4
+    assert not any(r.failed for r in results)
+
+
+def test_failed_case_recorded_and_sweep_continues(tmp_path):
+    # an unrecoverable OOM (degradation disabled) fails ONE case; the
+    # sweep records it and completes the remaining three
+    from isotope_tpu.resilience import ResiliencePolicy, faults
+
+    cfg = config(tmp_path)
+    out = tmp_path / "out"
+    strict = ResiliencePolicy(max_retries=0, degrade=False,
+                              sleep=lambda s: None)
+    # the test env's 8-device virtual mesh routes runs through the
+    # sharded path; its compute phase is the injection site
+    faults.install("oom:sharded.compute:1")
+    try:
+        results = run_experiment(cfg, out_dir=str(out), policy=strict)
+    finally:
+        faults.clear()
+    assert [r.failed for r in results] == [True, False, False, False]
+    recs = [
+        json.loads(ln)
+        for ln in (out / "checkpoint.jsonl").read_text().splitlines()[1:]
+    ]
+    assert recs[0]["failed"] and "RESOURCE_EXHAUSTED" in recs[0]["error"]
+    assert len(recs) == 4
+    # the failed case's row is absent from the CSV (3 data rows)
+    csv = (out / "benchmark.csv").read_text().splitlines()
+    assert len(csv) == 1 + 3
+
+    # resume: the failed case retries, completed cases don't re-run —
+    # and the final CSV matches an uninterrupted sweep's exactly
+    full_dir = tmp_path / "full"
+    run_experiment(cfg, out_dir=str(full_dir))
+    ran = []
+    results = run_experiment(cfg, out_dir=str(out), progress=ran.append)
+    assert len(ran) == 1
+    assert not any(r.failed for r in results)
+    want = (full_dir / "benchmark.csv").read_text().splitlines()
+    got = (out / "benchmark.csv").read_text().splitlines()
+    for w_line, g_line in zip(want, got):
+        w, g = w_line.split(","), g_line.split(",")
+        del w[1], g[1]  # StartTime
+        assert w == g
+
+
 def test_checkpoint_records_are_wellformed(tmp_path):
     cfg = config(tmp_path)
     out = tmp_path / "out"

@@ -242,15 +242,22 @@ def test_oracle_matches_mm1_closed_form():
 def fidelity_case(yaml_text, load, tol_p50, tol_p99, seed=0,
                   n_engine=200_000, n_oracle=1_000_000, warmup=0.5,
                   params=SimParams()):
+    """``tol_*`` is a symmetric relative tolerance (float) or an
+    asymmetric ``(lo, hi)`` band on the relative error ``e/o - 1`` —
+    used where the engine sits on one documented side of the oracle,
+    so drift in EITHER direction trips the gate."""
     res_e, res_o = both(yaml_text, load, n_engine, n_oracle,
                         params=params, seed=seed)
     lat_e = np.asarray(res_e.client_latency, np.float64)
     lat_o = res_o.client_latency[res_o.client_start >= warmup]
     for q, tol in ((0.5, tol_p50), (0.99, tol_p99)):
         e, o = np.quantile(lat_e, q), np.quantile(lat_o, q)
-        assert e == pytest.approx(o, rel=tol), (
+        lo, hi = tol if isinstance(tol, tuple) else (-tol, tol)
+        rel = e / o - 1.0
+        assert lo <= rel <= hi, (
             f"p{int(q * 100)}: engine={e * 1e3:.4f}ms "
-            f"oracle={o * 1e3:.4f}ms err={(e / o - 1) * 100:+.2f}%"
+            f"oracle={o * 1e3:.4f}ms err={rel * 100:+.2f}% "
+            f"(band [{lo * 100:+.1f}%, {hi * 100:+.1f}%])"
         )
     return res_e, res_o
 
@@ -331,10 +338,15 @@ def test_closed_loop_saturated_throughput():
         # p99 +0.7%; star9 p50 -20.8% / p99 -14.0% — star9's gap is a
         # near-uniform ~1 ms location shift from entry-leaf convoy
         # idleness the per-station census model cannot carry (ORACLE.md
-        # "known out-of-envelope").  tree13's p99 tightens 10% -> 4%;
-        # star9's gates pin the documented model edge.
+        # "known out-of-envelope").  tree13's p99 tightens 10% -> 4%.
         ("tree13", TREE13, 0.09, 0.04),
-        ("star9", STAR9, 0.23, 0.16),
+        # star9 gates ASYMMETRICALLY (ADVICE r5): the engine is
+        # uniformly FAST there, so the band pins the documented edge
+        # from both sides — a tight +3% slow-side bound catches any
+        # regression past the oracle, the fast side catches the known
+        # convoy-idleness gap widening beyond its measured -20.8%/-14.0%
+        # (the convoy-aware census fix is the ROADMAP follow-up).
+        ("star9", STAR9, (-0.23, 0.03), (-0.16, 0.03)),
     ],
 )
 def test_closed_loop_saturated_fidelity(name, yaml_text, tol_p50, tol_p99):

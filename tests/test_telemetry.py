@@ -266,6 +266,72 @@ def test_run_telemetry_jsonl_round_trip(tmp_path):
     assert telemetry.validate_jsonl(path) == 2
 
 
+def test_jsonl_tolerates_crash_torn_final_line(tmp_path):
+    # a SIGKILL mid-append leaves half a record with no newline: both
+    # readers must skip-and-count it, keeping the killed run's
+    # telemetry readable
+    p = tmp_path / "telemetry.jsonl"
+    telemetry.counter_inc("x", 1)
+    rec = telemetry.snapshot(label="kept")
+    rec.append_jsonl(p)
+    rec.append_jsonl(p)
+    with open(p, "a") as f:
+        f.write(rec.to_json_line()[: 40])  # torn tail, no newline
+    assert telemetry.validate_jsonl(p) == 2
+    records = list(telemetry.iter_jsonl(p))
+    assert [r.label for r in records] == ["kept", "kept"]
+    assert telemetry.counter_get("telemetry_torn_lines") >= 1.0
+
+
+def test_jsonl_quarantines_mid_file_corruption(tmp_path):
+    # one bad line (e.g. a healed torn fragment) costs one record,
+    # never the file — same policy as the sweep checkpoint loader
+    p = tmp_path / "telemetry.jsonl"
+    line = telemetry.snapshot(label="ok").to_json_line()
+    p.write_text(line[:30] + "\n" + line + "\n")
+    assert telemetry.validate_jsonl(p) == 1
+    assert [r.label for r in telemetry.iter_jsonl(p)] == ["ok"]
+    assert telemetry.counter_get("telemetry_torn_lines") >= 1.0
+
+
+def test_append_jsonl_heals_torn_tail(tmp_path):
+    # a record appended AFTER a kill must not concatenate onto the
+    # torn fragment: append starts a fresh line, and readers then see
+    # every intact record
+    p = tmp_path / "telemetry.jsonl"
+    rec = telemetry.snapshot(label="ok")
+    rec.append_jsonl(p)
+    with open(p, "a") as f:
+        f.write(rec.to_json_line()[:25])  # SIGKILL mid-append
+    rec.append_jsonl(p)
+    assert telemetry.validate_jsonl(p) == 2
+    assert [r.label for r in telemetry.iter_jsonl(p)] == ["ok", "ok"]
+
+
+def test_degraded_to_meta_lands_in_snapshot_and_summary():
+    telemetry.set_meta("degraded_to", "single-device")
+    telemetry.counter_inc("degradations_total")
+    telemetry.counter_inc("retries_total", 2)
+    snap = telemetry.snapshot()
+    assert snap.meta["degraded_to"] == "single-device"
+    blk = telemetry.summary_block()
+    assert blk["degraded_to"] == "single-device"
+    assert blk["degradations_total"] == 1
+    assert blk["retries_total"] == 2
+    # clean runs carry NO degraded_to key (bench_regress keys on it)
+    telemetry.reset()
+    assert "degraded_to" not in telemetry.summary_block()
+
+
+def test_total_counters_render_as_first_class_series():
+    telemetry.counter_inc("retries_total", 3)
+    telemetry.counter_inc("engine_traces", 2)
+    text = telemetry.prometheus_text()
+    assert "isotope_engine_retries_total 3" in text
+    assert 'events_total{event="retries_total"}' not in text
+    assert 'isotope_engine_events_total{event="engine_traces"} 2' in text
+
+
 def test_validate_jsonl_rejects_bad_schema(tmp_path):
     p = tmp_path / "bad.jsonl"
     p.write_text('{"schema": "nope", "phases": {}}\n')

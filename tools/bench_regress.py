@@ -27,6 +27,11 @@ threshold (unset = not gated), compared per case over the
   ``peak_device_bytes``;
 - ``BENCH_REGRESS_WASTE_THRESHOLD``: ABSOLUTE increase allowed on
   ``padding_waste_fraction`` (it is already a ratio).
+
+Always armed (no env var): a case whose telemetry block carries
+``degraded_to`` — the resilience supervisor served it from a
+degradation-ladder rung — fails the gate if the previous round's
+capture ran that case clean (a degraded number is not comparable).
 """
 from __future__ import annotations
 
@@ -158,6 +163,43 @@ def telemetry_failures(prev_doc: dict, new_doc: dict) -> list:
     return failures
 
 
+def degradation_failures(prev_doc: dict, new_doc: dict) -> list:
+    """Always-armed gate: a case that DEGRADED in the new capture but
+    ran clean in the previous round is a regression.
+
+    The resilience supervisor (isotope_tpu/resilience/) lets an OOM'd
+    case complete on a fallback rung instead of crashing — which must
+    never silently normalize: a benchmark number produced by the
+    half-block or single-device rung is not comparable to the mesh
+    path's, so bench gates on the ``degraded_to`` key the telemetry
+    block carries only when a degradation happened.
+    """
+    prev_extra = prev_doc.get("extra", {})
+    new_extra = new_doc.get("extra", {})
+    failures = []
+    for k, blk in sorted(new_extra.items()):
+        if not k.endswith("_telemetry") or not isinstance(blk, dict):
+            continue
+        degraded = blk.get("degraded_to")
+        if not degraded:
+            continue
+        case = k[: -len("_telemetry")]
+        prev_blk = prev_extra.get(k)
+        prev_degraded = (
+            prev_blk.get("degraded_to")
+            if isinstance(prev_blk, dict)
+            else None
+        )
+        if prev_degraded:
+            print(f"bench_regress: {case}: degraded to {degraded!r} "
+                  f"(previously {prev_degraded!r}) OK")
+            continue
+        print(f"bench_regress: {case}: DEGRADED to {degraded!r} on a "
+              "previously clean case REGRESSION")
+        failures.append(f"{case}.degraded_to")
+    return failures
+
+
 def previous_capture() -> tuple:
     """(path, parsed_doc) of the newest BENCH_r*.json, or (None, None)."""
     files = sorted(
@@ -215,6 +257,7 @@ def main() -> int:
         print(f"bench_regress: {case}: {old_rate:.4g} -> "
               f"{new[case]:.4g} ({(ratio - 1) * 100:+.1f}%) {verdict}")
     failures.extend(telemetry_failures(prev_doc, new_doc))
+    failures.extend(degradation_failures(prev_doc, new_doc))
     if failures:
         print(f"bench_regress: FAIL vs {prev_path}: "
               f"{', '.join(failures)} regressed >"
