@@ -6,12 +6,35 @@ PY ?= python
 QPS ?= 1000
 DURATION ?= 120s
 
-.PHONY: test bench telemetry-smoke resilience-smoke examples canonical \
-	tree star multitier auxiliary-services star-auxiliary latency \
-	cpu_mem dot clean
+.PHONY: test lint vet-smoke bench telemetry-smoke resilience-smoke \
+	examples canonical tree star multitier auxiliary-services \
+	star-auxiliary latency cpu_mem dot clean
 
 test:
 	$(PY) -m pytest tests/ -x -q
+
+# ruff (lint + format check) and the permissive mypy baseline from
+# pyproject.toml when installed; everywhere else tools/lint.py's
+# built-in floor (syntax + unused imports) still gates.  Nonzero exit
+# on any finding, so this composes into CI exactly like the smokes.
+lint:
+	$(PY) tools/lint.py
+
+# static-analysis end-to-end check: the shipped examples must vet
+# clean, and a seeded-defect run (injected host callback + f64 leak,
+# plus a tiny fake device capacity to trip the OOM verdict) must
+# report the planted rules and exit nonzero.
+vet-smoke: lint
+	$(PY) -m isotope_tpu vet examples/topologies/canonical.yaml \
+		examples/topologies/tree-13-services.yaml
+	! ISOTOPE_VET_INJECT=callback,f64 ISOTOPE_VET_DEVICE_BYTES=65536 \
+		$(PY) -m isotope_tpu vet \
+		examples/topologies/chain-3-services.yaml \
+		> /tmp/isotope_vet_smoke.txt 2>&1
+	@grep -q "VET-J001" /tmp/isotope_vet_smoke.txt
+	@grep -q "VET-J002" /tmp/isotope_vet_smoke.txt
+	@grep -q "VET-M001" /tmp/isotope_vet_smoke.txt
+	@echo "vet-smoke: clean examples pass, seeded defects caught"
 
 # bench prints the one-line JSON capture AND gates it against the
 # previous round's driver capture (>15% per-case regression fails).

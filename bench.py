@@ -106,6 +106,18 @@ def _rate(sim, load, num_requests, block_size, *, warm=3, iters=3,
     from isotope_tpu import telemetry
     from isotope_tpu.resilience import ResiliencePolicy, run_ladder
 
+    # static vet pass, no jaxpr trace (the audit trace would perturb
+    # the compile-wall measurement below): rule counters land in the
+    # case's telemetry block (`vet_errors`/`vet_warnings`) so
+    # tools/bench_regress.py can gate on NEW vet errors vs the previous
+    # capture.  Best-effort — a vet crash must never kill a capture.
+    try:
+        from isotope_tpu.analysis import vet_simulator
+
+        vet_simulator(sim, load, block_requests=block_size, trace=False)
+    except Exception:  # pragma: no cover - capture survival
+        pass
+
     key = jax.random.PRNGKey(0)
     serving = {"block": block_size, "eager": False}
 

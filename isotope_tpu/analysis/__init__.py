@@ -1,0 +1,60 @@
+"""Static program & config analysis (``isotope-tpu vet``).
+
+The GSPMD move applied to pre-flight: analyze the program and its
+configuration *before* execution.  Three passes over purely static
+inputs —
+
+- :mod:`~isotope_tpu.analysis.topo_lint` — the topology & experiment
+  config linter (structured rule-id diagnostics over the service graph
+  and sweep grid);
+- :mod:`~isotope_tpu.analysis.jaxpr_audit` — the jaxpr auditor
+  (``jax.make_jaxpr`` traces of the planned tensor program, walked for
+  host-sync points, dtype leaks, nondeterministic accumulation, and
+  retrace hazards — no device execution);
+- :mod:`~isotope_tpu.analysis.costmodel` — the pre-flight cost model
+  (FLOPs, peak bytes, critical path; the memory-vs-capacity verdict
+  that pre-selects the resilience ladder's starting rung).
+
+Surfaced as the ``isotope-tpu vet`` subcommand and the opt-in
+``--vet`` / ``$ISOTOPE_VET`` gate on simulate/sweep/suite.  With the
+gate off, nothing here ever runs — the default path is byte-identical.
+"""
+from isotope_tpu.analysis.findings import (  # noqa: F401
+    RULES,
+    SEV_ERROR,
+    SEV_INFO,
+    SEV_WARN,
+    Finding,
+    Report,
+    suppression_patterns,
+)
+from isotope_tpu.analysis.vet import (  # noqa: F401
+    ENV_VET,
+    ENV_VET_SUPPRESS,
+    MEMORY_RULES,
+    VetError,
+    default_suppressions,
+    vet_config_path,
+    vet_mode,
+    vet_simulator,
+    vet_topology_path,
+)
+
+__all__ = [
+    "RULES",
+    "SEV_ERROR",
+    "SEV_INFO",
+    "SEV_WARN",
+    "Finding",
+    "Report",
+    "suppression_patterns",
+    "ENV_VET",
+    "ENV_VET_SUPPRESS",
+    "MEMORY_RULES",
+    "VetError",
+    "default_suppressions",
+    "vet_config_path",
+    "vet_mode",
+    "vet_simulator",
+    "vet_topology_path",
+]

@@ -1,0 +1,351 @@
+"""Pre-flight cost model: FLOPs, peak bytes, critical path — statically.
+
+Two estimators compose (the critical-path discipline of static schedule
+analysis — PAPERS.md "It's the Critical Path!" — applied to the engine's
+own program):
+
+- **Jaxpr walker** (:func:`jaxpr_cost`): primitive-level FLOP counts,
+  a liveness-sweep working-set high-water mark, and the longest
+  dependency chain through the eqn DAG (``lax.scan`` bodies multiply
+  by their trip count).  Runs on the trace the auditor already took —
+  no device, no XLA.
+- **Plan table** (:func:`segment_table`): per-segment padded element
+  counts straight from the bucket plan (compiler/buckets.py), scaled
+  by the request-block size — the per-segment split the jaxpr (which
+  sees one fused program) cannot provide.
+
+The headline product is the **memory verdict**: the estimated peak
+device bytes of a run at its planned block size, compared against the
+device capacity, selects the resilience ladder rung the run should
+*start* on (runner/run.py) — turning PR 3's OOM-crash-then-degrade
+into a pre-flight decision.
+"""
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from isotope_tpu.analysis.findings import (
+    SEV_ERROR,
+    SEV_WARN,
+    Finding,
+)
+
+ENV_DEVICE_BYTES = "ISOTOPE_VET_DEVICE_BYTES"
+
+#: fraction of reported device capacity the estimate may fill — XLA
+#: needs headroom for fusion temporaries and the allocator never packs
+#: perfectly
+CAPACITY_FILL = 0.85
+
+#: elementwise-ish primitives costed at one flop per output element;
+#: anything unknown falls back to the same rate (a floor, not truth)
+_FREE_PRIMITIVES = frozenset({
+    "broadcast_in_dim", "reshape", "squeeze", "transpose", "copy",
+    "convert_element_type", "bitcast_convert_type", "slice",
+    "dynamic_slice", "dynamic_update_slice", "concatenate", "pad",
+    "gather", "iota", "stop_gradient", "device_put",
+})
+
+
+@dataclasses.dataclass(frozen=True)
+class JaxprCost:
+    flops: float
+    peak_bytes: float          # liveness high-water of the traced block
+    critical_path: int         # longest primitive dependency chain
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+def _aval_bytes(aval) -> float:
+    shape = getattr(aval, "shape", None)
+    dtype = getattr(aval, "dtype", None)
+    if shape is None or dtype is None:
+        return 0.0
+    n = 1
+    for d in shape:
+        n *= int(d)
+    return float(n) * getattr(dtype, "itemsize", 4)
+
+
+def _aval_size(aval) -> float:
+    shape = getattr(aval, "shape", None)
+    if shape is None:
+        return 1.0
+    n = 1
+    for d in shape:
+        n *= int(d)
+    return float(n)
+
+
+def _dot_flops(eqn) -> float:
+    """2 * output elements * contracted extent for dot_general."""
+    out = sum(_aval_size(v.aval) for v in eqn.outvars)
+    dims = eqn.params.get("dimension_numbers")
+    contract = 1.0
+    if dims:
+        (lhs_c, _rhs_c), _batch = dims
+        lhs_shape = getattr(eqn.invars[0].aval, "shape", ())
+        for d in lhs_c:
+            contract *= int(lhs_shape[d])
+    return 2.0 * out * contract
+
+
+def jaxpr_cost(closed_jaxpr) -> JaxprCost:
+    """Static cost of one ClosedJaxpr (recursing into sub-jaxprs)."""
+    import jax
+
+    def cost(jxp) -> Tuple[float, float, int]:
+        # -- liveness sweep: last use index per var -----------------------
+        last_use: Dict[object, int] = {}
+        for i, eqn in enumerate(jxp.eqns):
+            for v in eqn.invars:
+                if not isinstance(v, jax.core.Literal):
+                    last_use[v] = i
+        for v in jxp.outvars:
+            if not isinstance(v, jax.core.Literal):
+                last_use[v] = len(jxp.eqns)
+
+        live = sum(
+            _aval_bytes(v.aval)
+            for v in (*jxp.invars, *jxp.constvars)
+        )
+        peak = live
+        flops = 0.0
+        depth_of: Dict[object, int] = {}
+        max_depth = 0
+
+        for i, eqn in enumerate(jxp.eqns):
+            prim = str(eqn.primitive)
+            sub_f = sub_b = 0.0
+            sub_d = 0
+            trips = 1
+            for v in eqn.params.values():
+                subs = v if isinstance(v, (list, tuple)) else (v,)
+                for s in subs:
+                    inner = None
+                    if isinstance(s, jax.core.ClosedJaxpr):
+                        inner = s.jaxpr
+                    elif isinstance(s, jax.core.Jaxpr):
+                        inner = s
+                    if inner is not None:
+                        f, b, d = cost(inner)
+                        sub_f += f
+                        sub_b = max(sub_b, b)
+                        sub_d = max(sub_d, d)
+            if prim == "scan":
+                trips = int(eqn.params.get("length", 1))
+            out_elems = sum(_aval_size(v.aval) for v in eqn.outvars)
+            if sub_f:
+                flops += sub_f * trips
+            elif prim == "dot_general":
+                flops += _dot_flops(eqn)
+            elif prim in _FREE_PRIMITIVES:
+                pass  # data movement, not arithmetic
+            elif prim.startswith(("scatter", "reduce", "cum", "sort",
+                                  "argsort")):
+                flops += out_elems + sum(
+                    _aval_size(v.aval) for v in eqn.invars
+                )
+            else:
+                flops += out_elems
+
+            # working set: everything live plus this eqn's operands,
+            # outputs, and (for nested bodies) the body's own peak
+            out_bytes = sum(_aval_bytes(v.aval) for v in eqn.outvars)
+            peak = max(peak, live + out_bytes + sub_b)
+            live += out_bytes
+            for v in eqn.invars:
+                if (
+                    not isinstance(v, jax.core.Literal)
+                    and last_use.get(v) == i
+                ):
+                    live -= _aval_bytes(v.aval)
+
+            d_in = max(
+                (
+                    depth_of.get(v, 0)
+                    for v in eqn.invars
+                    if not isinstance(v, jax.core.Literal)
+                ),
+                default=0,
+            )
+            step = max(1, sub_d) * trips
+            d_out = d_in + step
+            for v in eqn.outvars:
+                depth_of[v] = d_out
+            max_depth = max(max_depth, d_out)
+        return flops, peak, max_depth
+
+    jaxpr = getattr(closed_jaxpr, "jaxpr", closed_jaxpr)
+    f, b, d = cost(jaxpr)
+    return JaxprCost(flops=f, peak_bytes=b, critical_path=d)
+
+
+def segment_table(sim, block_requests: int) -> List[dict]:
+    """Per-segment static costs at ``block_requests`` requests.
+
+    One row per executor segment (scan bucket or unrolled island),
+    with padded element counts from the bucket plan — multiplied by
+    the request axis these are the event-tensor footprints each
+    segment's sweep touches."""
+    from isotope_tpu.compiler import buckets
+    from isotope_tpu.sim import levelscan
+
+    rows: List[dict] = []
+    n = int(block_requests)
+    for i, seg in enumerate(sim._segments):
+        if isinstance(seg, levelscan.ScanBucket):
+            elems = n * seg.num_levels * (
+                seg.plan.bound_hops * (seg.plan.bound_steps + 3)
+            )
+            rows.append({
+                "segment": i,
+                "kind": "scan",
+                "levels": seg.num_levels,
+                "elems": elems,
+                "bytes_f32": 4.0 * elems,
+            })
+        elif isinstance(seg, buckets.UnrolledLevelPlan):
+            lvl = sim._levels[seg.d]
+            elems = n * (
+                lvl.size * (lvl.pmax + 3)
+                + 2 * lvl.num_calls * lvl.max_attempts
+            )
+            rows.append({
+                "segment": i,
+                "kind": "sparse" if lvl.sparse is not None else (
+                    "leaf" if lvl.leaf_busy is not None else "unrolled"
+                ),
+                "levels": 1,
+                "elems": elems,
+                "bytes_f32": 4.0 * elems,
+            })
+    return rows
+
+
+def device_capacity_bytes(override: Optional[float] = None
+                          ) -> Optional[float]:
+    """Per-device memory capacity in bytes, or None when unknown.
+
+    Resolution order: explicit override (``--device-bytes``), the
+    ``ISOTOPE_VET_DEVICE_BYTES`` env knob, then the backend's own
+    ``memory_stats()['bytes_limit']`` (TPU/GPU; CPU reports nothing —
+    host RAM is the allocator's problem, not the vet gate's)."""
+    if override is not None:
+        return float(override)
+    env = os.environ.get(ENV_DEVICE_BYTES, "").strip()
+    if env:
+        return float(env)
+    try:
+        import jax
+
+        for d in jax.local_devices():
+            ms = d.memory_stats()
+            if ms and ms.get("bytes_limit"):
+                return float(ms["bytes_limit"])
+    except Exception:
+        pass
+    return None
+
+
+@dataclasses.dataclass(frozen=True)
+class CostEstimate:
+    """The pre-flight verdict for one planned run."""
+
+    block_requests: int
+    trace_requests: int
+    jaxpr: Optional[JaxprCost]      # costs of the traced (small-n) block
+    peak_bytes_at_block: float      # extrapolated to the real block
+    flops_at_block: float
+    critical_path: int
+    segments: List[dict]
+    capacity_bytes: Optional[float]
+
+    def to_dict(self) -> dict:
+        d = dataclasses.asdict(self)
+        if self.jaxpr is not None:
+            d["jaxpr"] = self.jaxpr.to_dict()
+        return d
+
+
+def estimate_run(
+    sim,
+    block_requests: int,
+    closed_jaxpr=None,
+    trace_requests: int = 8,
+    capacity_override: Optional[float] = None,
+) -> CostEstimate:
+    """Estimate one run's per-block cost at ``block_requests``.
+
+    When the auditor already traced the program, its ``closed_jaxpr``
+    (at ``trace_requests`` requests) seeds the estimate and the
+    request-proportional parts scale up linearly; without a trace the
+    plan table alone provides the (coarser) bytes estimate."""
+    segments = segment_table(sim, block_requests)
+    plan_bytes = sum(r["bytes_f32"] for r in segments)
+    jc = None
+    if closed_jaxpr is not None:
+        jc = jaxpr_cost(closed_jaxpr)
+        scale = block_requests / max(trace_requests, 1)
+        peak = jc.peak_bytes * scale
+        flops = jc.flops * scale
+        depth = jc.critical_path
+    else:
+        # plan-only fallback: the live working set is a few event
+        # tensors wide, not the sum over all segments
+        h = max(sim.compiled.num_hops, 1)
+        peak = 10.0 * 4.0 * block_requests * h
+        flops = plan_bytes / 4.0  # ~1 flop per touched element
+        depth = len(segments)
+    return CostEstimate(
+        block_requests=int(block_requests),
+        trace_requests=int(trace_requests),
+        jaxpr=jc,
+        peak_bytes_at_block=float(peak),
+        flops_at_block=float(flops),
+        critical_path=int(depth),
+        segments=segments,
+        capacity_bytes=device_capacity_bytes(capacity_override),
+    )
+
+
+def memory_findings(
+    estimate: CostEstimate,
+    rung_names: Sequence[str] = ("scan", "half-block", "cpu-eager"),
+) -> Tuple[List[Finding], int]:
+    """The VET-M verdict: findings plus the recommended start rung.
+
+    Rung economics mirror the supervisor's ladder
+    (resilience/supervisor.py): the half-block rung halves the live
+    event-tensor footprint; the final rung executes off-device (host
+    RAM) and always "fits".  Unknown capacity (CPU backend, no env
+    override) recommends rung 0 and reports nothing — the vet gate must
+    not invent OOMs it cannot substantiate."""
+    cap = estimate.capacity_bytes
+    if cap is None or cap <= 0:
+        return [], 0
+    budget = CAPACITY_FILL * cap
+    peak = estimate.peak_bytes_at_block
+    if peak <= budget:
+        return [], 0
+    half = peak / 2.0
+    last = len(rung_names) - 1
+    if half <= budget:
+        rung = min(1, last)
+        return [Finding(
+            "VET-M002", SEV_WARN,
+            f"estimated peak {peak:.3g} B exceeds the "
+            f"{budget:.3g} B budget ({CAPACITY_FILL:.0%} of "
+            f"{cap:.3g} B capacity); start the ladder at "
+            f"{rung_names[rung]!r}",
+        )], rung
+    return [Finding(
+        "VET-M001", SEV_ERROR,
+        f"estimated peak {peak:.3g} B exceeds the {budget:.3g} B "
+        f"budget even at half-block ({half:.3g} B): every on-device "
+        f"rung would OOM — only {rung_names[last]!r} (host) is viable; "
+        "shard over a mesh or shrink the block",
+    )], last

@@ -1,0 +1,173 @@
+"""Vet findings: structured diagnostics with rule ids and severities.
+
+Every defect the static passes (topology linter, jaxpr auditor,
+pre-flight cost model — see :mod:`isotope_tpu.analysis`) can report is
+a :class:`Finding`: a stable rule id, a severity, the config/program
+path it anchors to, and a message.  The :class:`Report` aggregates
+findings across passes, applies suppressions, and decides the exit
+status — ``vet`` exits nonzero on errors, ``strict`` mode promotes
+warnings to blocking.
+
+Rule ids are stable API (suppression patterns, bench gates, and alert
+rules key on them): never renumber an existing rule; retire ids by
+leaving a tombstone in :data:`RULES`.
+"""
+from __future__ import annotations
+
+import dataclasses
+import fnmatch
+import json
+from typing import Dict, Iterable, List, Optional, Sequence
+
+SEV_ERROR = "error"
+SEV_WARN = "warn"
+SEV_INFO = "info"
+
+_SEV_ORDER = {SEV_ERROR: 0, SEV_WARN: 1, SEV_INFO: 2}
+
+#: rule id -> one-line description (the README table is generated from
+#: the same text; suppression validation checks membership here)
+RULES: Dict[str, str] = {
+    # -- topology / service-graph linter (host-only) ----------------------
+    "VET-T001": "service is unreachable from the entrypoint",
+    "VET-T002": "call graph contains a cycle reachable from the "
+                "entrypoint (the unroll cannot terminate)",
+    "VET-T003": "no entrypoint service (or unknown --entry override)",
+    "VET-T004": "numReplicas < 1: a zero-capacity queueing station",
+    "VET-T005": "errorRate >= 100%: the service fails every request",
+    "VET-T006": "payload size exceeds the plausible wire budget",
+    "VET-T007": "hop count forces the request block under its floor — "
+                "event tensors exceed the HBM element budget",
+    "VET-T008": "bucket-plan padding waste exceeds level_bucket_waste",
+    # -- experiment-config linter -----------------------------------------
+    "VET-C001": "topology file is missing or unreadable",
+    "VET-C002": "duplicate run labels in the sweep grid",
+    "VET-C003": "chaos/churn schedule targets an unknown service or "
+                "matches no churnable edge",
+    "VET-C004": "chaos/churn/mtls schedule lies beyond the run duration "
+                "(it never fires)",
+    "VET-C005": "open-loop qps meets or exceeds the static capacity "
+                "(unstable queues)",
+    # -- jaxpr auditor ------------------------------------------------------
+    "VET-J001": "host callback / device-to-host sync primitive in the "
+                "hot path",
+    "VET-J002": "float64/complex128 dtype leak in the traced program",
+    "VET-J003": "float scatter-add accumulation (order-nondeterministic "
+                "on parallel backends)",
+    "VET-J004": "executable-cache signature component is unhashable or "
+                "has an id-based repr (retrace hazard: the AOT cache "
+                "key changes every process)",
+    # -- pre-flight cost model ---------------------------------------------
+    "VET-M001": "memory estimate exceeds device capacity on every "
+                "on-device ladder rung (predictable OOM)",
+    "VET-M002": "memory estimate exceeds device capacity at the default "
+                "rung; the resilience ladder should start degraded",
+}
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    """One static-analysis diagnostic."""
+
+    rule: str          # stable id, e.g. "VET-T001"
+    severity: str      # error | warn | info
+    message: str
+    path: str = ""     # config path ("services[3].script[1]") or site
+
+    def render(self) -> str:
+        where = f" {self.path}" if self.path else ""
+        return f"{self.severity:5s} {self.rule}{where}: {self.message}"
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+def suppression_patterns(spec: Optional[str]) -> List[str]:
+    """Parse a comma-separated suppression spec (``--suppress`` /
+    ``$ISOTOPE_VET_SUPPRESS``) into fnmatch patterns over rule ids."""
+    if not spec:
+        return []
+    pats = [p.strip() for p in spec.split(",") if p.strip()]
+    for p in pats:
+        if "*" not in p and "?" not in p and p not in RULES:
+            raise ValueError(
+                f"unknown vet rule in suppression: {p!r} "
+                f"(known rules: {', '.join(sorted(RULES))})"
+            )
+    return pats
+
+
+class Report:
+    """Aggregated vet findings plus the suppression bookkeeping."""
+
+    def __init__(self, suppress: Sequence[str] = ()):
+        self.findings: List[Finding] = []
+        self.suppressed: List[Finding] = []
+        self._patterns = list(suppress)
+        self.meta: Dict[str, object] = {}  # cost estimates, rung advice
+
+    def add(self, finding: Finding) -> None:
+        if any(fnmatch.fnmatchcase(finding.rule, p)
+               for p in self._patterns):
+            self.suppressed.append(finding)
+        else:
+            self.findings.append(finding)
+
+    def extend(self, findings: Iterable[Finding]) -> None:
+        for f in findings:
+            self.add(f)
+
+    def by_severity(self, severity: str) -> List[Finding]:
+        return [f for f in self.findings if f.severity == severity]
+
+    @property
+    def errors(self) -> List[Finding]:
+        return self.by_severity(SEV_ERROR)
+
+    @property
+    def warnings(self) -> List[Finding]:
+        return self.by_severity(SEV_WARN)
+
+    def blocking(self, strict: bool = False,
+                 nonblocking_rules: Sequence[str] = ()) -> List[Finding]:
+        """The findings that make vet fail: errors, plus warns under
+        ``strict``.  ``nonblocking_rules`` exempts rules another layer
+        already handles (the runner exempts the VET-M* memory rules
+        when the degradation ladder is armed — the rung pre-selection
+        IS the recovery, so the finding informs instead of blocking).
+        """
+        sevs = (SEV_ERROR, SEV_WARN) if strict else (SEV_ERROR,)
+        return [
+            f for f in self.findings
+            if f.severity in sevs and f.rule not in nonblocking_rules
+        ]
+
+    def sorted(self) -> List[Finding]:
+        return sorted(
+            self.findings,
+            key=lambda f: (_SEV_ORDER.get(f.severity, 9), f.rule, f.path),
+        )
+
+    def summary_line(self) -> str:
+        n_err = len(self.errors)
+        n_warn = len(self.warnings)
+        n_info = len(self.by_severity(SEV_INFO))
+        extra = (
+            f", {len(self.suppressed)} suppressed" if self.suppressed
+            else ""
+        )
+        return (
+            f"vet: {n_err} error(s), {n_warn} warning(s), "
+            f"{n_info} info{extra}"
+        )
+
+    def to_dict(self) -> dict:
+        return {
+            "findings": [f.to_dict() for f in self.sorted()],
+            "suppressed": [f.to_dict() for f in self.suppressed],
+            "meta": self.meta,
+            "summary": self.summary_line(),
+        }
+
+    def to_json(self) -> str:
+        return json.dumps(self.to_dict(), indent=2, sort_keys=True)

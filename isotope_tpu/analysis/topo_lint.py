@@ -1,0 +1,374 @@
+"""Topology & experiment-config linter (pure host-side, no jax).
+
+Structured diagnostics over the L0 topology IR (models/graph.py) and
+the sweep config (runner/config.py): every rule reports a stable id, a
+severity, and the config path of the offending node, so defects that
+today surface as engine crashes minutes into compile — or never surface
+at all (a service nobody calls silently idles) — become pre-flight
+findings.  The GSPMD discipline applied to configuration: analyze the
+graph before anything executes.
+"""
+from __future__ import annotations
+
+import pathlib
+from typing import Dict, List, Optional, Tuple
+
+from isotope_tpu.analysis.findings import (
+    SEV_ERROR,
+    SEV_WARN,
+    Finding,
+)
+from isotope_tpu.models.graph import ServiceGraph
+from isotope_tpu.models.script import ConcurrentCommand, RequestCommand
+
+#: payloads past this are flagged (VET-T006): at the default 10 Gbit/s
+#: model a 256 MiB body is >200 ms of pure wire time per direction —
+#: beyond any plausible call timeout in these workloads
+PAYLOAD_BOUND_BYTES = 256 * 1024 * 1024
+
+#: the engine's default HBM element budget and block floor
+#: (sim/engine.py default_block_size) — VET-T007 mirrors them
+BLOCK_ELEM_BUDGET = 33_554_432
+BLOCK_FLOOR = 256
+
+
+def _call_targets(script) -> List[str]:
+    out: List[str] = []
+    for cmd in script:
+        if isinstance(cmd, RequestCommand):
+            out.append(cmd.service_name)
+        elif isinstance(cmd, ConcurrentCommand):
+            for sub in cmd:
+                if isinstance(sub, RequestCommand):
+                    out.append(sub.service_name)
+    return out
+
+
+def _adjacency(graph: ServiceGraph) -> Dict[str, List[str]]:
+    return {s.name: _call_targets(s.script) for s in graph.services}
+
+
+def _find_cycle(entry: str, adj: Dict[str, List[str]]
+                ) -> Optional[List[str]]:
+    """First cycle reachable from ``entry`` (as a name path), or None.
+
+    Iterative DFS with an explicit stack: the svc10k/svc100k-scale
+    topologies this pass targets are deeper than Python's recursion
+    limit (a 2000-service chain already blows it)."""
+    WHITE, GRAY, BLACK = 0, 1, 2
+    color = {n: WHITE for n in adj}
+    path: List[str] = []
+    # (node, iterator-over-targets) frames
+    stack = [(entry, iter(adj.get(entry, ())))]
+    color[entry] = GRAY
+    path.append(entry)
+    while stack:
+        node, targets = stack[-1]
+        for t in targets:
+            if t not in color:
+                continue  # undefined target: decode already failed
+            if color[t] == GRAY:
+                return path[path.index(t):] + [t]
+            if color[t] == WHITE:
+                color[t] = GRAY
+                path.append(t)
+                stack.append((t, iter(adj.get(t, ()))))
+                break
+        else:
+            color[node] = BLACK
+            path.pop()
+            stack.pop()
+    return None
+
+
+def lint_graph(
+    graph: ServiceGraph,
+    entry: Optional[str] = None,
+    params=None,
+) -> List[Finding]:
+    """Lint one service graph.  ``params`` (a SimParams) refines the
+    shape-dependent rules (block budget, bucket waste); None uses the
+    engine defaults without importing jax."""
+    findings: List[Finding] = []
+    adj = _adjacency(graph)
+    names = [s.name for s in graph.services]
+    idx = {n: i for i, n in enumerate(names)}
+
+    # -- entrypoint (VET-T003) --------------------------------------------
+    if entry is not None and entry not in idx:
+        findings.append(Finding(
+            "VET-T003", SEV_ERROR,
+            f"--entry names unknown service {entry!r}",
+        ))
+        entry = None
+    if entry is None:
+        entries = [s.name for s in graph.services if s.is_entrypoint]
+        if not entries:
+            findings.append(Finding(
+                "VET-T003", SEV_ERROR,
+                "no service sets isEntrypoint: true",
+            ))
+            return findings  # reachability/cycle need a root
+        entry = entries[0]
+
+    # -- cycles (VET-T002) -------------------------------------------------
+    cycle = _find_cycle(entry, adj)
+    if cycle is not None:
+        findings.append(Finding(
+            "VET-T002", SEV_ERROR,
+            "cycle: " + " -> ".join(cycle) + " (the reproducible-cycle "
+            "solve covers closed-loop rate cycles, not call-graph "
+            "recursion; break the call loop)",
+            path=f"services[{idx[cycle[0]]}]",
+        ))
+
+    # -- reachability (VET-T001) ------------------------------------------
+    seen = set()
+    stack = [entry]
+    while stack:
+        n = stack.pop()
+        if n in seen:
+            continue
+        seen.add(n)
+        stack.extend(t for t in adj.get(n, ()) if t in idx)
+    for i, name in enumerate(names):
+        if name not in seen:
+            findings.append(Finding(
+                "VET-T001", SEV_ERROR,
+                f"service {name!r} is never called from entrypoint "
+                f"{entry!r} (dead capacity, or a mistyped call target)",
+                path=f"services[{i}]",
+            ))
+
+    # -- per-service bounds (VET-T004/T005/T006) ---------------------------
+    for i, svc in enumerate(graph.services):
+        if svc.num_replicas < 1:
+            findings.append(Finding(
+                "VET-T004", SEV_ERROR,
+                f"numReplicas={svc.num_replicas}: the M/M/k station has "
+                "no servers (the compiler would silently clamp to 1)",
+                path=f"services[{i}].numReplicas",
+            ))
+        if float(svc.error_rate) >= 1.0 and svc.name in seen:
+            findings.append(Finding(
+                "VET-T005", SEV_WARN,
+                f"errorRate={svc.error_rate}: every request to "
+                f"{svc.name!r} fails"
+                + (" — the entrypoint 500s the whole run"
+                   if svc.name == entry else ""),
+                path=f"services[{i}].errorRate",
+            ))
+        if int(svc.response_size) > PAYLOAD_BOUND_BYTES:
+            findings.append(Finding(
+                "VET-T006", SEV_WARN,
+                f"responseSize={svc.response_size} exceeds "
+                f"{PAYLOAD_BOUND_BYTES} bytes",
+                path=f"services[{i}].responseSize",
+            ))
+        for j, cmd in enumerate(svc.script):
+            calls = (
+                [c for c in cmd if isinstance(c, RequestCommand)]
+                if isinstance(cmd, ConcurrentCommand)
+                else [cmd] if isinstance(cmd, RequestCommand) else []
+            )
+            for call in calls:
+                if int(call.size) > PAYLOAD_BOUND_BYTES:
+                    findings.append(Finding(
+                        "VET-T006", SEV_WARN,
+                        f"call to {call.service_name!r} sends "
+                        f"{call.size} (> {PAYLOAD_BOUND_BYTES} bytes)",
+                        path=f"services[{i}].script[{j}]",
+                    ))
+
+    return findings
+
+
+def lint_compiled(compiled, params=None) -> List[Finding]:
+    """Shape rules needing the unrolled hop tree (VET-T007/T008).
+
+    Pure NumPy over the CompiledGraph — compiling is host-side, so
+    these rules still run without a device."""
+    from isotope_tpu.compiler import buckets
+    from isotope_tpu.sim.config import SimParams
+
+    if params is None:
+        params = SimParams()
+    findings: List[Finding] = []
+    h = max(compiled.num_hops, 1)
+
+    # VET-T007: the default block floors at BLOCK_FLOOR requests; when
+    # hops alone exceed budget/floor every block busts the element
+    # budget the block size exists to respect (default_block_size)
+    if h * BLOCK_FLOOR > BLOCK_ELEM_BUDGET:
+        findings.append(Finding(
+            "VET-T007", SEV_WARN,
+            f"{h} hops x the {BLOCK_FLOOR}-request block floor = "
+            f"{h * BLOCK_FLOOR} elements per event tensor "
+            f"(budget {BLOCK_ELEM_BUDGET}); expect the OOM ladder "
+            "or shard over a mesh",
+        ))
+
+    # VET-T008: plan the buckets exactly as the engine will and check
+    # the realized padding against the configured budget
+    shapes = []
+    offset = 0
+    for lvl in compiled.levels:
+        pmax = max(int(lvl.step_is_real.sum(1).max(initial=0)), 1)
+        slots = lvl.num_hops * pmax
+        import numpy as np
+
+        sparse = False
+        if lvl.num_calls:
+            n_slots = len(np.unique(lvl.call_seg))
+            sparse = slots > max(4 * n_slots, params.sparse_level_elems)
+        shapes.append(buckets.LevelShape(
+            size=lvl.num_hops, pmax=pmax, children=lvl.num_children,
+            calls=lvl.num_calls, attempts=lvl.max_attempts,
+            sparse=sparse, offset=offset,
+        ))
+        offset += lvl.num_hops
+    plan = buckets.plan_segments(
+        shapes, waste=params.level_bucket_waste,
+        enabled=params.bucketed_scan,
+    )
+    stats = buckets.plan_stats(shapes, plan)
+    waste_budget = params.level_bucket_waste - 1.0
+    if stats["padded_elems"] and stats["padding_waste_fraction"] > max(
+        waste_budget / (1.0 + waste_budget), 0.0
+    ) + 1e-9:
+        findings.append(Finding(
+            "VET-T008", SEV_WARN,
+            f"bucket plan pads {stats['padding_waste_fraction']:.1%} of "
+            f"element slots (budget from level_bucket_waste="
+            f"{params.level_bucket_waste:g}); retune the waste knob for "
+            "this topology family",
+        ))
+    return findings
+
+
+def _capacity_qps(compiled, params) -> float:
+    """Static saturation throughput (the engine's capacity_qps without
+    building a Simulator): bottleneck station capacity over expected
+    visits."""
+    import numpy as np
+
+    visits = compiled.expected_visits()
+    mu = 1.0 / params.cpu_time_s
+    reps = compiled.services.replicas.astype(np.float64)
+    with np.errstate(divide="ignore"):
+        per_svc = np.where(
+            visits > 0, reps * mu / np.maximum(visits, 1e-30), np.inf
+        )
+    return float(per_svc.min())
+
+
+def lint_config(config) -> Tuple[List[Finding], Dict[str, object]]:
+    """Lint an ExperimentConfig (sweep TOML): grid and schedule rules.
+
+    Returns ``(findings, graphs)`` where ``graphs`` maps each readable
+    topology path to its decoded ServiceGraph so callers can chain the
+    per-graph passes without re-reading files."""
+    from isotope_tpu.runner.run import _label  # the label law itself
+
+    findings: List[Finding] = []
+    graphs: Dict[str, object] = {}
+
+    # VET-C001: missing/unreadable/undecodable topologies (YAML syntax
+    # errors are yaml.YAMLError, NOT ValueError — vet must report them,
+    # not crash on them)
+    import yaml
+
+    for i, p in enumerate(config.topology_paths):
+        try:
+            graphs[p] = ServiceGraph.from_yaml_file(p)
+        except OSError as e:
+            findings.append(Finding(
+                "VET-C001", SEV_ERROR, str(e),
+                path=f"topology_paths[{i}]",
+            ))
+        except (ValueError, yaml.YAMLError) as e:
+            findings.append(Finding(
+                "VET-C001", SEV_ERROR, f"{p}: {e}",
+                path=f"topology_paths[{i}]",
+            ))
+
+    # VET-C002: duplicate labels (the runner raises at run time; vet
+    # reports the same defect statically, with the colliding labels)
+    labels = [
+        _label(topo, env.name, load, config.labels)
+        for topo in config.topology_paths
+        for env in config.environments
+        for load in config.load_models()
+    ]
+    dupes = sorted({lb for lb in labels if labels.count(lb) > 1})
+    if dupes:
+        findings.append(Finding(
+            "VET-C002", SEV_ERROR,
+            f"colliding run labels: {', '.join(dupes)} (topology file "
+            "stems and the load grid must disambiguate)",
+        ))
+
+    # schedule rules need the union of service names across topologies
+    all_names = {
+        s.name for g in graphs.values() for s in g.services
+    }
+    duration = float(config.duration_s)
+    for i, ev in enumerate(config.chaos):
+        if graphs and ev.service not in all_names:
+            findings.append(Finding(
+                "VET-C003", SEV_ERROR,
+                f"chaos targets unknown service {ev.service!r}",
+                path=f"chaos[{i}]",
+            ))
+        elif ev.start_s >= duration:
+            findings.append(Finding(
+                "VET-C004", SEV_WARN,
+                f"chaos window [{ev.start_s:g}, {ev.end_s:g})s starts "
+                f"after the {duration:g}s run ends",
+                path=f"chaos[{i}]",
+            ))
+    for i, ts in enumerate(config.churn):
+        if graphs and ts.service not in all_names:
+            findings.append(Finding(
+                "VET-C003", SEV_ERROR,
+                f"churn targets unknown service {ts.service!r}",
+                path=f"churn[{i}]",
+            ))
+        elif ts.period_s >= duration and len(ts.weights) > 1:
+            findings.append(Finding(
+                "VET-C004", SEV_WARN,
+                f"churn period {ts.period_s:g}s never completes a "
+                f"weight rotation within the {duration:g}s run",
+                path=f"churn[{i}]",
+            ))
+    if config.mtls is not None and (
+        config.mtls.period_s >= duration and len(config.mtls.taxes_s) > 1
+    ):
+        findings.append(Finding(
+            "VET-C004", SEV_WARN,
+            f"mtls period {config.mtls.period_s:g}s never alternates "
+            f"within the {duration:g}s run",
+            path="mtls",
+        ))
+
+    # VET-C005: open-loop offered rate vs static capacity
+    if config.load_kind == "open":
+        params = config.sim_params()
+        for p, g in graphs.items():
+            try:
+                from isotope_tpu.compiler import compile_graph
+
+                compiled = compile_graph(g, entry=config.entry)
+            except ValueError:
+                continue  # compile defects are the graph passes' job
+            cap = _capacity_qps(compiled, params)
+            stem = pathlib.Path(p).stem
+            for q in config.qps:
+                if q is not None and q >= cap:
+                    findings.append(Finding(
+                        "VET-C005", SEV_WARN,
+                        f"open-loop qps {q:g} >= static capacity "
+                        f"{cap:.1f} of {stem}: queues are unstable "
+                        "(waits grow without bound over the run)",
+                    ))
+    return findings, graphs

@@ -17,9 +17,11 @@ def register_all(sub) -> None:
         simulate_cmd,
         suite_cmd,
         telemetry_cmd,
+        vet_cmd,
     )
 
     simulate_cmd.register(sub)
     suite_cmd.register(sub)
     fidelity_cmd.register(sub)
     telemetry_cmd.register(sub)
+    vet_cmd.register(sub)

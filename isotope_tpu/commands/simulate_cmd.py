@@ -42,6 +42,19 @@ def _policy(args):
     )
 
 
+def _add_vet_arg(parser) -> None:
+    """The static pre-flight gate (analysis/), shared by every
+    run-executing subcommand."""
+    parser.add_argument(
+        "--vet", nargs="?", const="on", choices=("on", "strict"),
+        default=None,
+        help="pre-flight static analysis before each case (also env "
+             "ISOTOPE_VET=1|strict): lint the topology/config, audit "
+             "the traced jaxpr, and let the pre-flight memory verdict "
+             "pick the resilience ladder's starting rung.  Blocking "
+             "findings fail the case; 'strict' promotes warnings")
+
+
 def register(sub) -> None:
     s = sub.add_parser(
         "simulate", help="simulate one topology under one load"
@@ -103,6 +116,7 @@ def register(sub) -> None:
                    default="telemetry.jsonl",
                    help="where --telemetry appends its JSONL record")
     _add_resilience_args(s)
+    _add_vet_arg(s)
     s.set_defaults(func=run_simulate)
 
     k = sub.add_parser(
@@ -152,6 +166,7 @@ def register(sub) -> None:
                         "plus <out>/telemetry.jsonl ('detail' adds "
                         "segment fences — diagnosis, not benchmarking)")
     _add_resilience_args(w)
+    _add_vet_arg(w)
     w.set_defaults(func=run_sweep)
 
     p = sub.add_parser(
@@ -233,7 +248,8 @@ def run_simulate(args) -> int:
         entry=args.entry,
         **extra,
     )
-    (result,) = run_experiment(config, policy=_policy(args))
+    (result,) = run_experiment(config, policy=_policy(args),
+                               vet=args.vet)
     if result.failed:
         print(f"error: run failed: {result.error}", file=sys.stderr)
         return 1
@@ -378,6 +394,7 @@ def run_sweep(args) -> int:
         profile_dir=args.profile,
         export=args.export,
         policy=_policy(args),
+        vet=args.vet,
     )
     discarded = [r.label for r in results if r.window.discarded]
     failed = [r.label for r in results if r.failed]

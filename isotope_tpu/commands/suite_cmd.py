@@ -40,9 +40,13 @@ def register(sub) -> None:
                         "plus a telemetry.jsonl per config ('detail' "
                         "adds segment fences — diagnosis, not "
                         "benchmarking)")
-    from isotope_tpu.commands.simulate_cmd import _add_resilience_args
+    from isotope_tpu.commands.simulate_cmd import (
+        _add_resilience_args,
+        _add_vet_arg,
+    )
 
     _add_resilience_args(s)
+    _add_vet_arg(s)
     s.set_defaults(func=run_suite_cmd)
 
 
@@ -67,6 +71,7 @@ def run_suite_cmd(args) -> int:
         progress=lambda label: print(f"running {label}", file=sys.stderr),
         resume=not args.fresh,
         policy=_policy(args),
+        vet=args.vet,
     )
     m = result.manifest
     print(

@@ -1,0 +1,101 @@
+"""``isotope-tpu vet`` — static program & config analysis.
+
+Lints topology YAMLs / sweep TOMLs, audits the jaxpr the engine would
+jit (trace-only; nothing executes on a device), and runs the
+pre-flight cost model.  Exit status: 0 clean, 1 when any error-severity
+finding survives suppression (``--strict`` promotes warnings), 2 on
+usage errors — the shape of ``go vet``.
+"""
+from __future__ import annotations
+
+import sys
+
+from isotope_tpu.utils import duration as dur
+
+
+def register(sub) -> None:
+    s = sub.add_parser(
+        "vet",
+        help="static analysis: lint topologies/configs, audit the "
+             "traced program, model pre-flight cost",
+    )
+    s.add_argument("paths", nargs="+", metavar="PATH",
+                   help="topology YAMLs and/or experiment TOMLs "
+                        "(.toml runs the config linter over the whole "
+                        "sweep grid first)")
+    s.add_argument("--strict", action="store_true",
+                   help="promote warnings to blocking (exit 1)")
+    s.add_argument("--suppress", default=None, metavar="RULES",
+                   help="comma-separated rule ids/globs to suppress, "
+                        "e.g. VET-J003,VET-T00* (also "
+                        "$ISOTOPE_VET_SUPPRESS)")
+    s.add_argument("--json", action="store_true",
+                   help="machine-readable report on stdout")
+    s.add_argument("--no-trace", action="store_true",
+                   help="skip the jaxpr audit / traced cost model "
+                        "(lint + plan-table estimates only)")
+    s.add_argument("--entry", default=None,
+                   help="entrypoint override for multi-entry "
+                        "topologies")
+    s.add_argument("--qps", default="1000",
+                   help='planned load for the audit/cost model, or '
+                        '"max"')
+    s.add_argument("--connections", "-c", type=int, default=64)
+    s.add_argument("--load-kind", choices=["open", "closed"],
+                   default="open")
+    s.add_argument("--duration", "-t", default="240s")
+    s.add_argument("--device-bytes", type=float, default=None,
+                   metavar="N",
+                   help="device memory capacity for the OOM verdict "
+                        "(default: $ISOTOPE_VET_DEVICE_BYTES, then the "
+                        "backend's memory_stats; unknown on CPU)")
+    s.set_defaults(func=run_vet)
+
+
+def run_vet(args) -> int:
+    from isotope_tpu.analysis import (
+        Report,
+        default_suppressions,
+        suppression_patterns,
+        vet_config_path,
+        vet_topology_path,
+    )
+    from isotope_tpu.sim.config import LoadModel
+
+    suppress = default_suppressions()
+    if args.suppress:
+        suppress += suppression_patterns(args.suppress)
+    load = LoadModel(
+        kind=args.load_kind,
+        qps=None if args.qps == "max" else float(args.qps),
+        connections=args.connections,
+        duration_s=dur.parse_duration_seconds(args.duration),
+    )
+
+    merged = Report(suppress=())
+    for path in args.paths:
+        if str(path).endswith(".toml"):
+            rep = vet_config_path(
+                path, trace=not args.no_trace,
+                device_bytes=args.device_bytes, suppress=suppress,
+            )
+        else:
+            rep = vet_topology_path(
+                path, load=load, entry=args.entry,
+                trace=not args.no_trace,
+                device_bytes=args.device_bytes, suppress=suppress,
+            )
+        merged.findings.extend(rep.findings)
+        merged.suppressed.extend(rep.suppressed)
+        if rep.meta:
+            merged.meta[str(path)] = rep.meta
+
+    if args.json:
+        print(merged.to_json())
+    else:
+        for f in merged.sorted():
+            print(f.render())
+        print(merged.summary_line(), file=sys.stderr)
+
+    blocking = merged.blocking(strict=args.strict)
+    return 1 if blocking else 0

@@ -15,7 +15,7 @@ from __future__ import annotations
 import dataclasses
 import json
 import pathlib
-from typing import List, Optional, Sequence
+from typing import List, Sequence
 
 from isotope_tpu.metrics.alarms import Query
 from isotope_tpu.metrics.query import MetricStore

@@ -14,6 +14,7 @@ from typing import List
 
 import yaml
 
+from isotope_tpu.models.errors import config_path
 from isotope_tpu.models.pct import Percentage
 from isotope_tpu.models.script import (
     ConcurrentCommand,
@@ -65,11 +66,16 @@ class ServiceGraph:
         if not isinstance(doc, dict):
             raise ValueError(f"service graph must be a mapping: {doc!r}")
         raw_defaults = doc.get("defaults") or {}
-        default_service, default_request = _effective_defaults(raw_defaults)
-        services = [
-            Service.decode(s, default_service, default_request)
-            for s in (doc.get("services") or [])
-        ]
+        with config_path("defaults"):
+            default_service, default_request = _effective_defaults(
+                raw_defaults
+            )
+        services = []
+        for i, s in enumerate(doc.get("services") or []):
+            with config_path(f"services[{i}]"):
+                services.append(
+                    Service.decode(s, default_service, default_request)
+                )
         graph = cls(services=services, defaults=dict(raw_defaults))
         graph.validate()
         return graph
@@ -102,8 +108,9 @@ class ServiceGraph:
 
     def validate(self) -> None:
         names = {s.name for s in self.services}
-        for service in self.services:
-            _validate_commands(service.script, names)
+        for i, service in enumerate(self.services):
+            with config_path(f"services[{i}].script"):
+                _validate_commands(service.script, names)
 
     # -- convenience -------------------------------------------------------
 
@@ -124,63 +131,45 @@ def _effective_defaults(raw_defaults: dict):
     if unknown:
         raise ValueError(f"unknown defaults fields: {sorted(unknown)}")
 
+    def field(key, decode, fallback):
+        if key not in raw_defaults:
+            return fallback
+        with config_path(key):
+            return decode(raw_defaults[key])
+
     # Per-call default: requestSize seeds RequestCommand.Size
     # (unmarshal.go:104-107).
     default_request = RequestCommand(
         service_name="",
-        size=(
-            ByteSize.decode(raw_defaults["requestSize"])
-            if "requestSize" in raw_defaults
-            else ByteSize(0)
-        ),
+        size=field("requestSize", ByteSize.decode, ByteSize(0)),
     )
     # Per-service defaults (unmarshal.go:66-73, 96-103): type=http,
     # numReplicas=1 unless overridden.
     default_service = Service(
         name="",
-        type=(
-            ServiceType.decode(raw_defaults["type"])
-            if "type" in raw_defaults
-            else ServiceType.HTTP
+        type=field("type", ServiceType.decode, ServiceType.HTTP),
+        num_replicas=field(
+            "numReplicas",
+            lambda v: decode_strict_int(v, "numReplicas"),
+            1,
         ),
-        num_replicas=(
-            decode_strict_int(raw_defaults["numReplicas"], "numReplicas")
-            if "numReplicas" in raw_defaults
-            else 1
-        ),
-        error_rate=(
-            Percentage.decode(raw_defaults["errorRate"])
-            if "errorRate" in raw_defaults
-            else Percentage(0.0)
-        ),
-        response_size=(
-            ByteSize.decode(raw_defaults["responseSize"])
-            if "responseSize" in raw_defaults
-            else ByteSize(0)
-        ),
+        error_rate=field("errorRate", Percentage.decode, Percentage(0.0)),
+        response_size=field("responseSize", ByteSize.decode, ByteSize(0)),
         # In the reference the defaults block is unmarshaled in the
         # metadata pass BEFORE DefaultRequestCommand is installed
         # (unmarshal.go:30-43), so calls inside the defaults script do
         # NOT inherit requestSize — they get a zero-size default.
-        script=(
-            Script.decode(
-                raw_defaults["script"], RequestCommand(service_name="")
-            )
-            if "script" in raw_defaults
-            else Script()
+        script=field(
+            "script",
+            lambda v: Script.decode(v, RequestCommand(service_name="")),
+            Script(),
         ),
-        num_rbac_policies=(
-            decode_strict_int(
-                raw_defaults["numRbacPolicies"], "numRbacPolicies"
-            )
-            if "numRbacPolicies" in raw_defaults
-            else 0
+        num_rbac_policies=field(
+            "numRbacPolicies",
+            lambda v: decode_strict_int(v, "numRbacPolicies"),
+            0,
         ),
-        cluster=(
-            decode_cluster(raw_defaults["cluster"])
-            if "cluster" in raw_defaults
-            else ""
-        ),
+        cluster=field("cluster", decode_cluster, ""),
     )
     return default_service, default_request
 

@@ -15,8 +15,9 @@ A ``Script`` is an ordered list of commands executed sequentially
 from __future__ import annotations
 
 import dataclasses
-from typing import Any, List, Union
+from typing import Any, Union
 
+from isotope_tpu.models.errors import config_path
 from isotope_tpu.models.size import ByteSize
 from isotope_tpu.utils import duration
 
@@ -173,9 +174,11 @@ Command = Union[SleepCommand, RequestCommand, ConcurrentCommand]
 
 def decode_command(value: Any, default_request: RequestCommand) -> Command:
     if isinstance(value, list):
-        return ConcurrentCommand(
-            decode_command(v, default_request) for v in value
-        )
+        out = ConcurrentCommand()
+        for i, v in enumerate(value):
+            with config_path(f"[{i}]"):
+                out.append(decode_command(v, default_request))
+        return out
     if isinstance(value, dict):
         if len(value) > 1:
             raise MultipleKeysInCommandError(value)
@@ -183,9 +186,11 @@ def decode_command(value: Any, default_request: RequestCommand) -> Command:
             raise InvalidCommandError("empty command mapping")
         (key, body), = value.items()
         if key == SLEEP_COMMAND_KEY:
-            return SleepCommand.decode(body)
+            with config_path(SLEEP_COMMAND_KEY):
+                return SleepCommand.decode(body)
         if key == REQUEST_COMMAND_KEY:
-            return RequestCommand.decode(body, default_request)
+            with config_path(REQUEST_COMMAND_KEY):
+                return RequestCommand.decode(body, default_request)
         raise UnknownCommandKeyError(key)
     raise InvalidCommandError(f"invalid command: {value!r}")
 
@@ -199,7 +204,11 @@ class Script(list):
             return cls()
         if not isinstance(value, list):
             raise InvalidCommandError(f"script must be a list: {value!r}")
-        return cls(decode_command(v, default_request) for v in value)
+        out = cls()
+        for i, v in enumerate(value):
+            with config_path(f"[{i}]"):
+                out.append(decode_command(v, default_request))
+        return out
 
     def encode(self):
         return [cmd.encode() for cmd in self]

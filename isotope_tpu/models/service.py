@@ -9,6 +9,7 @@ from __future__ import annotations
 
 import dataclasses
 
+from isotope_tpu.models.errors import config_path
 from isotope_tpu.models.pct import Percentage
 from isotope_tpu.models.script import RequestCommand, Script
 from isotope_tpu.models.size import ByteSize
@@ -67,44 +68,39 @@ class Service:
         name = value.get("name", "")
         if not name:
             raise EmptyNameError()
+
+        def field(key, decode, fallback):
+            if key not in value:
+                return fallback
+            with config_path(key):
+                return decode(value[key])
+
         return cls(
             name=name,
-            type=(
-                ServiceType.decode(value["type"])
-                if "type" in value
-                else default.type
-            ),
-            num_replicas=(
-                decode_strict_int(value["numReplicas"], "numReplicas")
-                if "numReplicas" in value
-                else default.num_replicas
+            type=field("type", ServiceType.decode, default.type),
+            num_replicas=field(
+                "numReplicas",
+                lambda v: decode_strict_int(v, "numReplicas"),
+                default.num_replicas,
             ),
             is_entrypoint=bool(value.get("isEntrypoint", default.is_entrypoint)),
-            error_rate=(
-                Percentage.decode(value["errorRate"])
-                if "errorRate" in value
-                else default.error_rate
+            error_rate=field(
+                "errorRate", Percentage.decode, default.error_rate
             ),
-            response_size=(
-                ByteSize.decode(value["responseSize"])
-                if "responseSize" in value
-                else default.response_size
+            response_size=field(
+                "responseSize", ByteSize.decode, default.response_size
             ),
-            script=(
-                Script.decode(value["script"], default_request)
-                if "script" in value
-                else Script(default.script)
+            script=field(
+                "script",
+                lambda v: Script.decode(v, default_request),
+                Script(default.script),
             ),
-            num_rbac_policies=(
-                decode_strict_int(value["numRbacPolicies"], "numRbacPolicies")
-                if "numRbacPolicies" in value
-                else default.num_rbac_policies
+            num_rbac_policies=field(
+                "numRbacPolicies",
+                lambda v: decode_strict_int(v, "numRbacPolicies"),
+                default.num_rbac_policies,
             ),
-            cluster=(
-                decode_cluster(value["cluster"])
-                if "cluster" in value
-                else default.cluster
-            ),
+            cluster=field("cluster", decode_cluster, default.cluster),
         )
 
     def encode(self, default: "Service | None" = None) -> dict:

@@ -36,7 +36,7 @@ from isotope_tpu.resilience import faults
 from isotope_tpu.compiler.program import CompiledGraph
 from isotope_tpu.metrics.prometheus import MetricsCollector, ServiceMetrics
 from isotope_tpu.parallel.mesh import SVC_AXIS
-from isotope_tpu.sim.config import CLOSED_LOOP, OPEN_LOOP, LoadModel, SimParams
+from isotope_tpu.sim.config import OPEN_LOOP, LoadModel, SimParams
 from isotope_tpu.sim.engine import Simulator
 from isotope_tpu.sim.summary import RunSummary, reduce_stacked, summarize
 

@@ -46,6 +46,20 @@ ENV_FAULT_INJECT = "ISOTOPE_FAULT_INJECT"
 
 KINDS = ("oom", "transient", "corrupt", "nan")
 
+#: every instrumented ``check(site)`` call site in the engine — the
+#: closed universe a spec may target.  A typo'd site used to parse
+#: fine and silently never fire (the chaos test then "passed" without
+#: exercising anything); now it raises at parse time with this list.
+#: ``nan`` targets the pseudo-site ``segment`` (trace-time poisoning).
+VALID_SITES = (
+    "engine.build",
+    "engine.run",
+    "sharded.args_put",
+    "sharded.compute",
+    "sharded.gather",
+    "cache.load",
+)
+
 #: fault kind -> (message template, taxonomy class).  Messages imitate
 #: the real failure text so the taxonomy classifies injected faults by
 #: the same patterns as real ones (the explicit class is a backstop).
@@ -109,6 +123,12 @@ class FaultPlan:
                 raise ValueError(
                     f"nan faults target segments (nan:segment:<idx>), "
                     f"got site {site!r}"
+                )
+            if kind != "nan" and site not in VALID_SITES:
+                raise ValueError(
+                    f"unknown fault site {site!r} — the plan would "
+                    f"never fire (valid sites: "
+                    f"{', '.join(VALID_SITES)})"
                 )
             entries.append(
                 _Entry(kind=kind, site=site, arg=arg,

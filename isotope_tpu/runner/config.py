@@ -22,12 +22,12 @@ except ModuleNotFoundError:  # Python < 3.11: the tomli backport is the
     import tomli as tomllib  # same parser under its pre-stdlib name
 from typing import Dict, List, Optional, Tuple
 
+from isotope_tpu.models.errors import config_path
 from isotope_tpu.sim.config import (
     ChaosEvent,
     MtlsSchedule,
     bounce_schedule,
     LoadModel,
-    NetworkModel,
     SimParams,
     TrafficSplit,
 )
@@ -229,80 +229,90 @@ def load_toml(path) -> ExperimentConfig:
             )
 
     client = doc.get("client", {})
-    qps_raw = client.get("qps", "max")
-    qps_list = (
-        [_parse_qps(q) for q in qps_raw]
-        if isinstance(qps_raw, list)
-        else [_parse_qps(qps_raw)]
-    )
-    conns_raw = client.get("num_concurrent_connections", 64)
-    conns = (
-        [int(c) for c in conns_raw]
-        if isinstance(conns_raw, list)
-        else [int(conns_raw)]
-    )
+    with config_path("client.qps"):
+        qps_raw = client.get("qps", "max")
+        qps_list = (
+            [_parse_qps(q) for q in qps_raw]
+            if isinstance(qps_raw, list)
+            else [_parse_qps(qps_raw)]
+        )
+    with config_path("client.num_concurrent_connections"):
+        conns_raw = client.get("num_concurrent_connections", 64)
+        conns = (
+            [int(c) for c in conns_raw]
+            if isinstance(conns_raw, list)
+            else [int(conns_raw)]
+        )
 
     chaos: List[ChaosEvent] = []
-    for ev in doc.get("chaos", []):
-        down = ev.get("replicas_down", "all")
-        down_n = None if down == "all" else int(down)
-        drain = bool(ev.get("drain", True))
-        start = dur.parse_duration_seconds(ev["start"])
-        end = dur.parse_duration_seconds(ev["end"])
-        if "period" in ev or "repeat" in ev:
-            # rolling-restart shorthand (gateway-bouncer): repeat the
-            # [start, end) window every `period` for `repeat` cycles
-            if "period" not in ev:
-                raise ValueError(
-                    f"[[chaos]] block for {ev['service']!r} sets "
-                    "'repeat' without 'period'"
+    for i, ev in enumerate(doc.get("chaos", [])):
+        with config_path(f"chaos[{i}]"):
+            down = ev.get("replicas_down", "all")
+            down_n = None if down == "all" else int(down)
+            drain = bool(ev.get("drain", True))
+            with config_path("start"):
+                start = dur.parse_duration_seconds(ev["start"])
+            with config_path("end"):
+                end = dur.parse_duration_seconds(ev["end"])
+            if "period" in ev or "repeat" in ev:
+                # rolling-restart shorthand (gateway-bouncer): repeat
+                # the [start, end) window every `period` for `repeat`
+                # cycles
+                if "period" not in ev:
+                    raise ValueError(
+                        f"[[chaos]] block for {ev['service']!r} sets "
+                        "'repeat' without 'period'"
+                    )
+                chaos.extend(
+                    bounce_schedule(
+                        service=ev["service"],
+                        period_s=dur.parse_duration_seconds(
+                            ev["period"]
+                        ),
+                        down_s=end - start,
+                        count=int(ev.get("repeat", 1)),
+                        start_s=start,
+                        replicas_down=down_n,
+                        drain=drain,
+                    )
                 )
-            chaos.extend(
-                bounce_schedule(
-                    service=ev["service"],
-                    period_s=dur.parse_duration_seconds(ev["period"]),
-                    down_s=end - start,
-                    count=int(ev.get("repeat", 1)),
-                    start_s=start,
-                    replicas_down=down_n,
-                    drain=drain,
+            else:
+                chaos.append(
+                    ChaosEvent(
+                        service=ev["service"],
+                        start_s=start,
+                        end_s=end,
+                        replicas_down=down_n,
+                        drain=drain,
+                    )
                 )
-            )
-        else:
-            chaos.append(
-                ChaosEvent(
-                    service=ev["service"],
-                    start_s=start,
-                    end_s=end,
-                    replicas_down=down_n,
-                    drain=drain,
-                )
-            )
 
     # [[churn]]: the config-churner analogue (rotating traffic weights)
     churn: List[TrafficSplit] = []
-    for ts in doc.get("churn", []):
-        churn.append(
-            TrafficSplit(
-                service=ts["service"],
-                period_s=dur.parse_duration_seconds(ts["period"]),
-                weights=tuple(float(w) for w in ts["weights"]),
+    for i, ts in enumerate(doc.get("churn", [])):
+        with config_path(f"churn[{i}]"):
+            churn.append(
+                TrafficSplit(
+                    service=ts["service"],
+                    period_s=dur.parse_duration_seconds(ts["period"]),
+                    weights=tuple(float(w) for w in ts["weights"]),
+                )
             )
-        )
 
     # [mtls]: the auto-mTLS switching analogue — a schedule of per-edge
     # one-way taxes cycled every `period` (perf/load/auto-mtls/scale.py)
     mtls = None
     if "mtls" in doc:
         m = doc["mtls"]
-        mtls = MtlsSchedule(
-            period_s=dur.parse_duration_seconds(m["period"]),
-            taxes_s=tuple(
-                dur.parse_duration_seconds(x) if isinstance(x, str)
-                else float(x)
-                for x in m["taxes"]
-            ),
-        )
+        with config_path("mtls"):
+            mtls = MtlsSchedule(
+                period_s=dur.parse_duration_seconds(m["period"]),
+                taxes_s=tuple(
+                    dur.parse_duration_seconds(x) if isinstance(x, str)
+                    else float(x)
+                    for x in m["taxes"]
+                ),
+            )
 
     # loadgen axis: fortio is closed-loop by default, nighthawk is the
     # open-loop generator (runner.py:270-316 builds a distinct

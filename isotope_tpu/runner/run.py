@@ -107,12 +107,14 @@ class _LazyTopology:
         self._compiled = None
         self._collector = None
         self._entry_resp = 0.0
+        self._graph = None
         self._sims = {}
 
     @property
     def compiled(self):
         if self._compiled is None:
             graph = ServiceGraph.from_yaml_file(self.path)
+            self._graph = graph
             self._compiled = compile_graph(graph, entry=self.config.entry)
             self._entry_resp = float(
                 self._compiled.services.response_size[
@@ -121,6 +123,11 @@ class _LazyTopology:
             )
             self._collector = MetricsCollector(self._compiled)
         return self._compiled
+
+    @property
+    def graph(self):
+        self.compiled
+        return self._graph
 
     @property
     def collector(self):
@@ -153,6 +160,48 @@ class _LazyTopology:
             )
             self._sims[env.name] = (sim, sharded)
         return self._sims[env.name]
+
+
+def _vet_gate(mode: str, sim, topo, config, load, block, rungs,
+              policy) -> int:
+    """The ``--vet`` pre-flight: lint + audit + cost model for one case.
+
+    Returns the ladder rung index the case should START on (the memory
+    verdict's recommendation, 0 when everything fits).  Blocking
+    findings raise :class:`~isotope_tpu.analysis.VetError` — a
+    deterministic failure the sweep records like any other.  The
+    VET-M* memory rules never block while the degradation ladder is
+    armed: for them the rung pre-selection IS the recovery.
+    """
+    from isotope_tpu.analysis import (
+        MEMORY_RULES,
+        VetError,
+        default_suppressions,
+        vet_simulator,
+    )
+
+    report = vet_simulator(
+        sim, load, block_requests=block,
+        graph=topo.graph, entry=config.entry,
+        suppress=default_suppressions(),
+        rung_names=tuple(name for name, _ in rungs),
+    )
+    for f in report.sorted():
+        print(f"vet: {f.render()}", file=sys.stderr)
+    nonblocking = MEMORY_RULES if policy.degrade else ()
+    if report.blocking(strict=(mode == "strict"),
+                       nonblocking_rules=nonblocking):
+        raise VetError(report, mode == "strict", nonblocking)
+    start = int(report.meta.get("start_rung", 0))
+    if start:
+        telemetry.counter_inc("vet_rung_preselections")
+        telemetry.set_meta("vet_start_rung", rungs[start][0])
+        print(
+            f"vet: memory verdict pre-selects ladder rung "
+            f"{rungs[start][0]!r}",
+            file=sys.stderr,
+        )
+    return start
 
 
 def _config_fingerprint(config: ExperimentConfig) -> str:
@@ -235,6 +284,7 @@ def run_experiment(
     profile_dir: Optional[str] = None,
     export: Sequence[str] = (),
     policy: Optional[ResiliencePolicy] = None,
+    vet: Optional[str] = None,
 ) -> List[RunResult]:
     """``profile_dir`` captures a ``jax.profiler`` trace per executed run
     into ``<profile_dir>/<label>/`` — the analogue of the reference's
@@ -248,7 +298,19 @@ def run_experiment(
     ``ISOTOPE_NO_DEGRADE``): transients retry with backoff, OOM walks
     the degradation ladder, and an unrecoverable case is recorded as
     FAILED in the checkpoint while the sweep continues — resume retries
-    failed cases and never re-runs completed ones."""
+    failed cases and never re-runs completed ones.
+
+    ``vet`` arms the static pre-flight gate (``"on"`` / ``"strict"``;
+    ``None`` reads ``$ISOTOPE_VET``): before each case executes, the
+    topology is linted, the traced program audited, and the pre-flight
+    cost model compared against device capacity.  Blocking findings
+    fail the case (recorded like any deterministic failure); a memory
+    verdict instead pre-selects the degradation-ladder rung the case
+    STARTS on — when the ladder is armed, a predictable OOM is a rung
+    choice, not a crash.  With ``vet`` off, none of this code runs."""
+    from isotope_tpu.analysis.vet import vet_mode
+
+    vet = vet_mode(vet)
     # resolve exporter specs up front: a typo'd --export must fail
     # before hours of simulation, not after
     exporters = []
@@ -375,9 +437,25 @@ def run_experiment(
                                 run_key, block,
                                 collector=topo.collector, trim=True,
                             )
+                            start_rung = 0
+                            if vet is not None:
+                                start_rung = _vet_gate(
+                                    vet, sim, topo, config, load,
+                                    block, rungs, policy,
+                                )
                             summary, degraded_to = run_ladder(
-                                rungs, policy, site_prefix="engine"
+                                rungs[start_rung:], policy,
+                                site_prefix="engine",
                             )
+                            if start_rung and degraded_to is None:
+                                # the pre-selected rung IS a
+                                # degradation: record it exactly as a
+                                # ladder descent would have (bench
+                                # gates key on degraded_to presence)
+                                degraded_to = rungs[start_rung][0]
+                                telemetry.set_meta(
+                                    "degraded_to", degraded_to
+                                )
                     except Exception as e:
                         # unrecoverable for THIS case (deterministic
                         # error, retries/ladder exhausted): record it,

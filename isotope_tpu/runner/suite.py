@@ -57,6 +57,7 @@ def run_suite(
     progress=None,
     resume: bool = True,
     policy=None,
+    vet=None,
 ) -> SuiteResult:
     """Run every config, publish one artifact tree, monitor every run."""
     cfgs = [(p, load_toml(p)) for p in config_paths]
@@ -81,7 +82,7 @@ def run_suite(
         out_dir = publish / stem
         results = run_experiment(
             cfg, out_dir=str(out_dir), progress=progress, resume=resume,
-            policy=policy,
+            policy=policy, vet=vet,
         )
         queries = standard_queries(
             stem, cpu_lim=cpu_limit_mcores, mem_lim=mem_limit_mib

@@ -1541,6 +1541,31 @@ class Simulator:
                 self._windows_arg(offered, sat),
             )
 
+    def trace_entry_args(self, n: int, kind: str, connections: int = 0):
+        """``(fn, abstract_args)`` for trace-only analysis.
+
+        The static-analysis subsystem (analysis/jaxpr_audit.py) runs
+        ``jax.make_jaxpr(fn)(*abstract_args)`` to obtain the exact
+        program a run of ``n`` requests would jit — every argument is a
+        ``jax.ShapeDtypeStruct``, so nothing touches a device and no
+        XLA compile happens.  ``sat`` is always False: the saturated
+        ``-qps max`` tables are built by host-side pilot *executions*
+        (``_closed_tables``), which a trace-only caller must not
+        trigger; the plain closed-loop program shares the same sweep
+        body and segment structure.
+        """
+        sds = jax.ShapeDtypeStruct
+        f32 = jnp.float32
+        P = int(self._phase_starts.shape[0]) * self._num_combos
+        args = (
+            sds((2,), jnp.uint32),       # PRNG key
+            sds((), f32), sds((), f32),  # offered_qps, pace_gap
+            sds((), f32), sds((), f32),  # arrival_qps, nominal_gap
+            sds((P, self.compiled.num_services), f32),  # visits_pc
+            sds((2, self._num_windows), f32),           # phase_windows
+        )
+        return partial(self._simulate, n, kind, connections, False), args
+
     def default_block_size(self, budget_elems: int = 33_554_432) -> int:
         """A block size keeping each (block, H) event tensor near
         ``budget_elems`` elements (~128 MiB at f32) — the HBM knob of

@@ -406,6 +406,13 @@ def summary_block() -> Dict[str, Any]:
     # keys its degraded-on-a-previously-clean-case gate on presence
     if _STATE.meta.get("degraded_to"):
         blk["degraded_to"] = _STATE.meta["degraded_to"]
+    # vet keys PRESENT only when a vet pass actually ran this record:
+    # bench_regress's opt-in new-vet-errors gate skips captures (and
+    # baselines) that never vetted instead of reading absence as zero
+    if c.get("vet_runs_total"):
+        blk["vet_runs"] = int(c["vet_runs_total"])
+        blk["vet_errors"] = int(c.get("vet_errors_total", 0.0))
+        blk["vet_warnings"] = int(c.get("vet_warnings_total", 0.0))
     return blk
 
 

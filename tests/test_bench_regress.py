@@ -11,7 +11,6 @@ import json
 import pathlib
 import sys
 
-import pytest
 
 TOOL = pathlib.Path(__file__).parent.parent / "tools" / "bench_regress.py"
 spec = importlib.util.spec_from_file_location("bench_regress", TOOL)

@@ -9,7 +9,6 @@ import os
 
 import jax
 import numpy as np
-import pytest
 
 from isotope_tpu.compiler import compile_graph
 from isotope_tpu.compiler.cache import (

@@ -8,7 +8,6 @@ the reference's executor implements (or promises) in
 isotope/service/pkg/srv/executable.go.
 """
 import jax
-import jax.numpy as jnp
 import numpy as np
 import pytest
 

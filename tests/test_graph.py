@@ -10,7 +10,6 @@ from isotope_tpu.models.graph import (
     RequestToUndefinedServiceError,
     ServiceGraph,
 )
-from isotope_tpu.models.pct import Percentage
 from isotope_tpu.models.script import (
     ConcurrentCommand,
     RequestCommand,

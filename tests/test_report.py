@@ -1,7 +1,6 @@
 """Dashboard-lite report tests: renders from a sweep's results.jsonl,
 regression deltas, chart/table structure."""
 import json
-import pathlib
 import re
 
 import pytest

@@ -100,16 +100,16 @@ def test_classify(exc, want):
 
 
 def test_injected_faults_classify_like_their_shape():
-    faults.install("oom:site.a:1,transient:site.b:1")
+    faults.install("oom:sharded.compute:1,transient:cache.load:1")
     with pytest.raises(InjectedFault) as oom:
-        faults.check("site.a")
+        faults.check("sharded.compute")
     with pytest.raises(InjectedFault) as tr:
-        faults.check("site.b")
+        faults.check("cache.load")
     assert classify(oom.value) == RESOURCE_EXHAUSTED
     assert classify(tr.value) == TRANSIENT
     # budgets are consumed: the sites pass afterwards
-    faults.check("site.a")
-    faults.check("site.b")
+    faults.check("sharded.compute")
+    faults.check("cache.load")
     assert telemetry.counter_get("faults_injected") == 2.0
 
 
@@ -120,6 +120,13 @@ def test_fault_spec_validation():
         faults.install("nan:engine.run:1")
     with pytest.raises(ValueError, match="bad fault spec"):
         faults.install("oom")
+    # a typo'd site must raise AT PARSE TIME with the valid-site list,
+    # not parse fine and silently never fire
+    with pytest.raises(ValueError, match="sharded.gather"):
+        faults.install("oom:sharded.gater:1")
+    for site in faults.VALID_SITES:
+        faults.install(f"oom:{site}:1")  # every documented site parses
+    faults.clear()
 
 
 # -- retry / backoff --------------------------------------------------------

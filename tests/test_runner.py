@@ -6,7 +6,6 @@ import pytest
 
 from isotope_tpu import cli
 from isotope_tpu.runner import load_toml, run_experiment
-from isotope_tpu.runner.config import DEFAULT_ENVIRONMENTS
 
 TOPO = pathlib.Path(__file__).parent.parent / "examples/topologies/canonical.yaml"
 

@@ -14,7 +14,6 @@ import dataclasses
 
 import jax
 import numpy as np
-import pytest
 
 from isotope_tpu.compiler import compile_graph
 from isotope_tpu.models.graph import ServiceGraph

@@ -26,7 +26,11 @@ threshold (unset = not gated), compared per case over the
 - ``BENCH_REGRESS_MEM_THRESHOLD``: relative increase allowed on
   ``peak_device_bytes``;
 - ``BENCH_REGRESS_WASTE_THRESHOLD``: ABSOLUTE increase allowed on
-  ``padding_waste_fraction`` (it is already a ratio).
+  ``padding_waste_fraction`` (it is already a ratio);
+- ``BENCH_REGRESS_VET_GATE=1``: fail a capture whose static-analysis
+  pass (``vet_errors`` in the telemetry block — bench runs the
+  no-trace vet per case) reports MORE errors than the previous
+  capture's; captures without vet data on either side are skipped.
 
 Always armed (no env var): a case whose telemetry block carries
 ``degraded_to`` — the resilience supervisor served it from a
@@ -163,6 +167,44 @@ def telemetry_failures(prev_doc: dict, new_doc: dict) -> list:
     return failures
 
 
+def vet_failures(prev_doc: dict, new_doc: dict) -> list:
+    """Opt-in gate (``BENCH_REGRESS_VET_GATE=1``): a case whose vet run
+    reports MORE errors than the previous capture's vet run regressed.
+
+    Both captures must carry vet data (``vet_errors`` in the
+    ``<case>_telemetry`` block — present only when the capture actually
+    vetted, telemetry/core.py summary_block): a baseline from before
+    vet existed is skipped, never read as "zero errors".
+    """
+    if os.environ.get("BENCH_REGRESS_VET_GATE", "") not in (
+        "1", "true", "on", "yes",
+    ):
+        return []
+    prev_extra = prev_doc.get("extra", {})
+    new_extra = new_doc.get("extra", {})
+    failures = []
+    for k, blk in sorted(new_extra.items()):
+        if not k.endswith("_telemetry") or not isinstance(blk, dict):
+            continue
+        new_errs = blk.get("vet_errors")
+        prev_blk = prev_extra.get(k)
+        old_errs = (
+            prev_blk.get("vet_errors")
+            if isinstance(prev_blk, dict)
+            else None
+        )
+        if new_errs is None or old_errs is None:
+            continue  # one side never vetted: nothing comparable
+        case = k[: -len("_telemetry")]
+        bad = int(new_errs) > int(old_errs)
+        verdict = "REGRESSION" if bad else "OK"
+        print(f"bench_regress: {case}.vet_errors: {int(old_errs)} -> "
+              f"{int(new_errs)} {verdict}")
+        if bad:
+            failures.append(f"{case}.vet_errors")
+    return failures
+
+
 def degradation_failures(prev_doc: dict, new_doc: dict) -> list:
     """Always-armed gate: a case that DEGRADED in the new capture but
     ran clean in the previous round is a regression.
@@ -258,6 +300,7 @@ def main() -> int:
               f"{new[case]:.4g} ({(ratio - 1) * 100:+.1f}%) {verdict}")
     failures.extend(telemetry_failures(prev_doc, new_doc))
     failures.extend(degradation_failures(prev_doc, new_doc))
+    failures.extend(vet_failures(prev_doc, new_doc))
     if failures:
         print(f"bench_regress: FAIL vs {prev_path}: "
               f"{', '.join(failures)} regressed >"
