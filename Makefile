@@ -7,8 +7,8 @@ QPS ?= 1000
 DURATION ?= 120s
 
 .PHONY: test lint vet-smoke bench telemetry-smoke resilience-smoke \
-	examples canonical tree star multitier auxiliary-services \
-	star-auxiliary latency cpu_mem dot clean
+	attribution-smoke examples canonical tree star multitier \
+	auxiliary-services star-auxiliary latency cpu_mem dot clean
 
 test:
 	$(PY) -m pytest tests/ -x -q
@@ -83,6 +83,39 @@ resilience-smoke:
 		print('resilience-smoke: degraded to', rec.meta['degraded_to'], \
 		      '| retries', int(rec.counters['retries_total']), \
 		      '| output intact (ActualQPS', doc['ActualQPS'], ')')"
+
+# attribution end-to-end check: an example topology runs with
+# --attribution=tail, then the artifacts are validated — blame shares
+# present and summing to ~1, residual at f32 noise level, the
+# flamegraph parsing as collapsed stacks, and the exemplar trace
+# matching the jaeger_trace shape with tail_rank tags.
+attribution-smoke:
+	rm -f /tmp/isotope_attr_blame.json /tmp/isotope_attr_flame.txt \
+		/tmp/isotope_attr_exemplars.json
+	$(PY) -m isotope_tpu simulate examples/topologies/tree-13-services.yaml \
+		--qps 50 --duration 4s --load-kind open --max-requests 512 \
+		--attribution=tail --blame-out /tmp/isotope_attr_blame.json \
+		--flamegraph /tmp/isotope_attr_flame.txt \
+		--exemplar-trace /tmp/isotope_attr_exemplars.json --flat \
+		> /dev/null
+	$(PY) -c "import json; \
+		doc = json.load(open('/tmp/isotope_attr_blame.json')); \
+		shares = sum(r['share'] for r in doc['services']); \
+		assert abs(shares - 1.0) < 1e-6, shares; \
+		assert doc['residual_abs_s_per_request'] < 1e-6, doc; \
+		assert doc['tail_cut_s'] and doc['tail_services'], doc; \
+		lines = open('/tmp/isotope_attr_flame.txt').read().splitlines(); \
+		assert lines and all(len(ln.rsplit(' ', 1)) == 2 and \
+			ln.rsplit(' ', 1)[1].isdigit() and \
+			ln.rsplit(' ', 1)[0].startswith('client;') \
+			for ln in lines), lines[:3]; \
+		ex = json.load(open('/tmp/isotope_attr_exemplars.json')); \
+		tr = ex['data'][0]; \
+		assert tr['spans'] and tr['processes'], tr; \
+		tags = {t['key'] for t in tr['spans'][0]['tags']}; \
+		assert {'tail_rank', 'tail_cut_s'} <= tags, tags; \
+		print('attribution-smoke: blame sums to 1, flamegraph parses,', \
+		      len(ex['data']), 'exemplar trace(s) validate')"
 
 examples:
 	$(PY) tools/gen_examples.py

@@ -169,6 +169,40 @@ def _rate(sim, load, num_requests, block_size, *, warm=3, iters=3,
     return med, spread, max(rates), first_s
 
 
+def _case_blame(sim, load, n: int = 2_048, top: int = 8) -> dict:
+    """Per-service blame shares from a small attributed run.
+
+    Rebuilds the case's Simulator with ``attribution=True`` (chaos /
+    churn schedules are run-time state and stay off — the probe gates
+    structural blame drift, not chaos behavior).
+    """
+    import dataclasses
+
+    import jax
+
+    from isotope_tpu.metrics import attribution as attr_mod
+    from isotope_tpu.sim.engine import Simulator
+
+    asim = Simulator(
+        sim.compiled,
+        dataclasses.replace(sim.params, attribution=True),
+    )
+    block = min(1_024, max(256, asim.default_block_size()))
+    _, attr = asim.run_attributed(
+        load, n, jax.random.PRNGKey(7), block_size=block
+    )
+    rows = attr_mod.service_blame(sim.compiled, attr)[:top]
+    count = max(float(attr.count), 1.0)
+    return {
+        "services": {
+            r["service"]: round(r["share"], 4) for r in rows
+        },
+        "residual_abs_us_per_req": round(
+            float(attr.residual_abs) / count * 1e6, 4
+        ),
+    }
+
+
 def run_case(name: str) -> dict:
     """Build and measure ONE case; returns {"median", "spread", ...}.
 
@@ -208,12 +242,20 @@ def run_case(name: str) -> dict:
     open_load = LoadModel(kind="open", qps=100_000.0)
     out: dict = {}
 
+    # remember what each case measured so the post-measurement blame
+    # probe (metrics/attribution.py) runs the same sim + load shape
+    case_ctx: dict = {}
+
+    def measure(sim, load, *args, **kw):
+        case_ctx["sim"], case_ctx["load"] = sim, load
+        return _rate(sim, load, *args, **kw)
+
     if name == "tree121":
         sim = Simulator(_flagship())
-        med, spread, best, first_s = _rate(sim, open_load, blk * blocks, blk)
+        med, spread, best, first_s = measure(sim, open_load, blk * blocks, blk)
     elif name == "closed64":
         sim = Simulator(_flagship())
-        med, spread, best, first_s = _rate(
+        med, spread, best, first_s = measure(
             sim, LoadModel(kind="closed", qps=None, connections=64),
             blk * blocks, blk,
         )
@@ -225,7 +267,7 @@ def run_case(name: str) -> dict:
         # windows 2x noisier (r2-code-vs-r5-code probes under one
         # harness agree within noise, so the r2->r4 "slide" was this
         # measurement, not the engine)
-        med, spread, best, first_s = _rate(
+        med, spread, best, first_s = measure(
             sim, LoadModel(kind="open", qps=10_000.0), 262_144, 32_768
         )
     elif name == "realistic50":
@@ -237,7 +279,7 @@ def run_case(name: str) -> dict:
             )
         )
         b = sim.default_block_size()
-        med, spread, best, first_s = _rate(sim, open_load, b * 4, b)
+        med, spread, best, first_s = measure(sim, open_load, b * 4, b)
     elif name == "svc10k":
         sim = Simulator(
             compile_graph(
@@ -248,7 +290,7 @@ def run_case(name: str) -> dict:
             )
         )
         b = sim.default_block_size()
-        med, spread, best, first_s = _rate(
+        med, spread, best, first_s = measure(
             sim, LoadModel(kind="open", qps=1000.0), b * 4, b
         )
     elif name == "star10k":
@@ -262,7 +304,7 @@ def run_case(name: str) -> dict:
             )
         )
         b = sim.default_block_size()
-        med, spread, best, first_s = _rate(
+        med, spread, best, first_s = measure(
             sim, LoadModel(kind="open", qps=1000.0), b * 4, b
         )
     elif name == "svc100k_chaos":
@@ -281,7 +323,7 @@ def run_case(name: str) -> dict:
                         replicas_down=None),),
         )
         b = sim.default_block_size()
-        med, spread, best, first_s = _rate(
+        med, spread, best, first_s = measure(
             sim, LoadModel(kind="open", qps=100.0), b * 2, b
         )
     elif name == "svc10k_cfg3_10M":
@@ -323,7 +365,7 @@ def run_case(name: str) -> dict:
         load3 = LoadModel(kind="open", qps=1_780_000.0)
         # fewer windows: the ~200s compile dominates this case's
         # budget and its measured spread is small
-        med, spread, best, first_s = _rate(sim, load3, b * 4, b, warm=2,
+        med, spread, best, first_s = measure(sim, load3, b * 4, b, warm=2,
                                   iters=2, trials=5)
         s = sim.run_summary(
             load3, b * 4, jax.random.PRNGKey(42), block_size=b
@@ -332,6 +374,19 @@ def run_case(name: str) -> dict:
         out["svc10k_cfg3_inflight"] = load3.qps * s.mean_latency_s
     else:
         raise ValueError(f"unknown case {name!r}")
+
+    # critical-path blame probe (metrics/attribution.py): a SMALL
+    # attributed run on the same sim/load shape embeds per-service
+    # blame shares so tools/bench_regress.py can gate on blame drift
+    # (opt-in BENCH_REGRESS_BLAME_THRESHOLD).  Best-effort and cheap
+    # (one extra block); BENCH_BLAME=0 disables.
+    if os.environ.get("BENCH_BLAME", "1") not in ("0", "off"):
+        try:
+            out["blame"] = _case_blame(
+                case_ctx["sim"], case_ctx["load"]
+            )
+        except Exception:  # pragma: no cover - capture survival
+            pass
 
     out["median"] = med
     out["spread"] = spread
@@ -409,9 +464,11 @@ def main() -> None:
         extra[f"{name}_compile_s"] = round(res.get("compile_s", 0.0), 2)
         if res.get("telemetry"):
             extra[f"{name}_telemetry"] = res["telemetry"]
+        if res.get("blame"):
+            extra[f"{name}_blame"] = res["blame"]
         for k, v in res.items():
             if k not in ("median", "spread", "best", "compile_s",
-                         "telemetry"):
+                         "telemetry", "blame"):
                 extra[k] = v
         print(f"bench: {name}: {res['median'] / 1e9:.3f}B "
               f"(spread {res['spread']:.0%}, first-call "

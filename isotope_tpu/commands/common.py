@@ -1,0 +1,51 @@
+"""Shared flag plumbing for run-executing subcommands.
+
+Detail-mode (per-segment fence) arming used to be duplicated across
+``simulate``/``sweep`` (``--telemetry=detail``) and ``telemetry``
+(``--detail``), so two callers in one process could CONFLICT — the
+second ``enable(detail=False)`` silently stripped fences the first had
+armed.  :func:`arm_telemetry` is the single composition point: detail
+requests OR together (a later caller can add detail, never remove it),
+which is what lets ``--telemetry=detail`` and the attribution pass (or
+the ``telemetry`` probe's ``--detail``) compose.
+"""
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+
+def arm_telemetry(mode: Optional[str] = None,
+                  detail: bool = False) -> bool:
+    """Arm engine telemetry emission/detail from command flags.
+
+    ``mode`` is a ``--telemetry`` value (``None`` / ``"on"`` /
+    ``"detail"``); ``detail`` is an independent detail request (the
+    ``telemetry`` subcommand's ``--detail``).  Returns whether detail
+    fencing is armed after this call.
+    """
+    from isotope_tpu import telemetry
+
+    want_detail = bool(detail) or mode == "detail"
+    if mode or want_detail:
+        # compose, never strip: an earlier caller's detail request
+        # survives a later plain --telemetry
+        telemetry.enable(
+            detail=want_detail or telemetry.detail_enabled()
+        )
+    return telemetry.detail_enabled()
+
+
+def default_compile_cache(compile_cache: Optional[str],
+                          mode: Optional[str]) -> Optional[str]:
+    """The telemetry-run compile-cache default (bench's ``.xla-cache``
+    convention): plain ``--telemetry`` runs measure cache
+    effectiveness, so they default the persistent cache ON unless the
+    user or environment said otherwise.  Detail mode is excluded —
+    eager execution would fill the cache with per-primitive noise."""
+    from isotope_tpu.compiler.cache import ENV_CACHE_DIR
+
+    if (mode == "on" and compile_cache is None
+            and ENV_CACHE_DIR not in os.environ):
+        return ".xla-cache"
+    return compile_cache

@@ -42,6 +42,20 @@ def _policy(args):
     )
 
 
+def _add_attribution_args(parser) -> None:
+    """The tail-latency attribution knobs (metrics/attribution.py),
+    shared by simulate and sweep."""
+    parser.add_argument(
+        "--attribution", nargs="?", const="on", choices=("on", "tail"),
+        default=None,
+        help="critical-path blame attribution: after the main run, an "
+             "attributed pass (identical request streams) reduces "
+             "per-service/per-edge blame on device and prints the "
+             "blame table.  'tail' also accumulates conditional-tail "
+             "blame past an estimated p99 cut and mines top-K slow "
+             "exemplars")
+
+
 def _add_vet_arg(parser) -> None:
     """The static pre-flight gate (analysis/), shared by every
     run-executing subcommand."""
@@ -115,6 +129,24 @@ def register(sub) -> None:
     s.add_argument("--telemetry-out", metavar="FILE",
                    default="telemetry.jsonl",
                    help="where --telemetry appends its JSONL record")
+    _add_attribution_args(s)
+    s.add_argument("--blame-out", metavar="FILE", default=None,
+                   help="write the blame tables as JSON "
+                        "(isotope-blame/v1) instead of only printing "
+                        "the table to stderr")
+    s.add_argument("--flamegraph", metavar="FILE", default=None,
+                   help="write the critical-path blame as a "
+                        "collapsed-stack flamegraph file "
+                        "(flamegraph.pl / speedscope input)")
+    s.add_argument("--perfetto-blame", metavar="FILE", default=None,
+                   help="write per-service blame-distribution counter "
+                        "tracks as Perfetto/Chrome trace JSON")
+    s.add_argument("--exemplar-trace", metavar="FILE", default=None,
+                   help="write the mined top-K slowest requests as a "
+                        "distributed trace (tail_rank/tail_cut "
+                        "annotated spans; no dense re-run)")
+    s.add_argument("--exemplar-format", choices=["chrome", "jaeger"],
+                   default="jaeger")
     _add_resilience_args(s)
     _add_vet_arg(s)
     s.set_defaults(func=run_simulate)
@@ -165,6 +197,7 @@ def register(sub) -> None:
                         "isotope_engine_* series in each .prom artifact "
                         "plus <out>/telemetry.jsonl ('detail' adds "
                         "segment fences — diagnosis, not benchmarking)")
+    _add_attribution_args(w)
     _add_resilience_args(w)
     _add_vet_arg(w)
     w.set_defaults(func=run_sweep)
@@ -197,23 +230,19 @@ def _require_jax() -> None:
 def run_simulate(args) -> int:
     # jax-dependent imports stay inside the handler so `--help` is instant
     _require_jax()
-    import os
-
     from isotope_tpu import telemetry
-    from isotope_tpu.compiler.cache import ENV_CACHE_DIR, enable_persistent_cache
+    from isotope_tpu.commands.common import (
+        arm_telemetry,
+        default_compile_cache,
+    )
+    from isotope_tpu.compiler.cache import enable_persistent_cache
 
-    if args.telemetry:
-        telemetry.enable(detail=args.telemetry == "detail")
-        if (args.telemetry == "on" and args.compile_cache is None
-                and ENV_CACHE_DIR not in os.environ):
-            # any explicit env setting — including the disable values
-            # "", "0", "off", "none" — wins over this default
-            # telemetry runs measure cache effectiveness: default the
-            # persistent cache on (bench.py's .xla-cache convention) so
-            # a second identical run shows persistent_cache_hits > 0.
-            # Detail mode is excluded: eager execution compiles op-by-op
-            # and would fill the cache with per-primitive noise.
-            args.compile_cache = ".xla-cache"
+    arm_telemetry(args.telemetry)
+    # any explicit env setting — including the disable values "", "0",
+    # "off", "none" — wins over the telemetry-run cache default
+    args.compile_cache = default_compile_cache(
+        args.compile_cache, args.telemetry
+    )
     enable_persistent_cache(args.compile_cache)
     from isotope_tpu.runner.config import (
         DEFAULT_ENVIRONMENTS,
@@ -246,13 +275,30 @@ def run_simulate(args) -> int:
         labels=args.labels,
         service_time=args.service_time,
         entry=args.entry,
+        attribution=args.attribution is not None,
         **extra,
     )
     (result,) = run_experiment(config, policy=_policy(args),
-                               vet=args.vet)
+                               vet=args.vet,
+                               attribution=args.attribution)
     if result.failed:
         print(f"error: run failed: {result.error}", file=sys.stderr)
         return 1
+    if args.attribution and result.blame is not None:
+        from isotope_tpu.metrics import attribution as attr_mod
+
+        print(attr_mod.format_table(result.blame), file=sys.stderr)
+        if args.blame_out:
+            with open(args.blame_out, "w") as f:
+                json.dump(result.blame, f, indent=2)
+            print(f"blame tables -> {args.blame_out}", file=sys.stderr)
+        if result.attribution is not None:
+            _write_attribution_artifacts(args, result)
+    elif args.attribution:
+        print(
+            "warning: attribution pass produced no blame document",
+            file=sys.stderr,
+        )
     doc = result.flat if args.flat else result.fortio_json
     json.dump(doc, sys.stdout, indent=None if args.flat else 2)
     sys.stdout.write("\n")
@@ -301,6 +347,51 @@ def run_simulate(args) -> int:
             file=sys.stderr,
         )
     return 0
+
+
+def _write_attribution_artifacts(args, result) -> None:
+    """The attributed run's visual artifacts (simulate-only flags)."""
+    from isotope_tpu.metrics.export import (
+        write_flamegraph,
+        write_perfetto_counters,
+    )
+
+    attr = result.attribution
+    if not (args.flamegraph or args.perfetto_blame
+            or args.exemplar_trace):
+        return
+    # the runner carries the exact CompiledGraph the blame vectors are
+    # indexed by; recompile only as a fallback
+    compiled = result.compiled
+    if compiled is None:
+        from isotope_tpu.compiler import compile_graph
+        from isotope_tpu.models.graph import ServiceGraph
+
+        compiled = compile_graph(
+            ServiceGraph.from_yaml_file(args.topology),
+            entry=args.entry,
+        )
+    if args.flamegraph:
+        lines = write_flamegraph(args.flamegraph, compiled, attr)
+        print(f"flamegraph ({lines} stacks) -> {args.flamegraph}",
+              file=sys.stderr)
+    if args.perfetto_blame:
+        n = write_perfetto_counters(args.perfetto_blame, compiled, attr)
+        print(f"perfetto counters ({n} events) -> "
+              f"{args.perfetto_blame}", file=sys.stderr)
+    if args.exemplar_trace:
+        if attr.exemplars is None:
+            print("warning: no exemplars mined "
+                  "(attribution_top_k == 0)", file=sys.stderr)
+            return
+        from isotope_tpu.metrics.trace import write_trace
+
+        traced = write_trace(
+            args.exemplar_trace, compiled,
+            fmt=args.exemplar_format, exemplars=attr,
+        )
+        print(f"traced {traced} tail exemplars -> "
+              f"{args.exemplar_trace}", file=sys.stderr)
 
 
 def run_check(args) -> int:
@@ -375,17 +466,19 @@ def run_plot(args) -> int:
 
 def run_sweep(args) -> int:
     _require_jax()
+    import dataclasses
+
+    from isotope_tpu.commands.common import arm_telemetry
     from isotope_tpu.compiler.cache import enable_persistent_cache
 
-    if args.telemetry:
-        from isotope_tpu import telemetry
-
-        telemetry.enable(detail=args.telemetry == "detail")
+    arm_telemetry(args.telemetry)
     enable_persistent_cache(args.compile_cache)
     from isotope_tpu.runner.config import load_toml
     from isotope_tpu.runner.run import run_experiment
 
     config = load_toml(args.config)
+    if args.attribution and not config.attribution:
+        config = dataclasses.replace(config, attribution=True)
     results = run_experiment(
         config,
         out_dir=args.out,
@@ -395,6 +488,7 @@ def run_sweep(args) -> int:
         export=args.export,
         policy=_policy(args),
         vet=args.vet,
+        attribution=args.attribution,
     )
     discarded = [r.label for r in results if r.window.discarded]
     failed = [r.label for r in results if r.failed]

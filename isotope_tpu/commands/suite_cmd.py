@@ -51,12 +51,10 @@ def register(sub) -> None:
 
 
 def run_suite_cmd(args) -> int:
+    from isotope_tpu.commands.common import arm_telemetry
     from isotope_tpu.compiler.cache import enable_persistent_cache
 
-    if args.telemetry:
-        from isotope_tpu import telemetry
-
-        telemetry.enable(detail=args.telemetry == "detail")
+    arm_telemetry(args.telemetry)
     enable_persistent_cache(args.compile_cache)
     from isotope_tpu.commands.simulate_cmd import _policy
     from isotope_tpu.runner.suite import run_suite

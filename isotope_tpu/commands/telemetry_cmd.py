@@ -54,11 +54,14 @@ def run_telemetry(args) -> int:
         ) from e
 
     from isotope_tpu import telemetry
+    from isotope_tpu.commands.common import arm_telemetry
     from isotope_tpu.compiler.cache import enable_persistent_cache
     from isotope_tpu.sim.config import LoadModel
     from isotope_tpu.telemetry import profile
 
-    telemetry.enable(detail=args.detail)
+    # shared detail plumbing (commands/common.py): --detail composes
+    # with any --telemetry=detail armed earlier in this process
+    arm_telemetry("on", detail=args.detail)
     enable_persistent_cache(args.compile_cache)
 
     sim = profile.build_simulator(args.topology)

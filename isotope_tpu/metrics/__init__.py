@@ -12,7 +12,11 @@ existing analysis keeps working (SURVEY.md §5.5):
   :mod:`isotope_tpu.metrics.fortio`;
 - a PromQL-subset query layer over the text exposition
   (perf/benchmark/runner/prom.py:92-126,216-232) — see
-  :mod:`isotope_tpu.metrics.query`.
+  :mod:`isotope_tpu.metrics.query`;
+- on-device critical-path blame attribution (per-service wait/self/
+  wire/timeout decomposition, conditional tail histograms, top-K
+  exemplar mining) — see :mod:`isotope_tpu.metrics.attribution`
+  (imported lazily; attribution-off paths never touch it).
 """
 from isotope_tpu.metrics.prometheus import (
     DURATION_BUCKETS,

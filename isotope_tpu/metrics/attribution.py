@@ -1,0 +1,721 @@
+"""On-device tail-latency attribution: critical-path blame.
+
+The reference answers "the mesh got slower" with Fortio histograms;
+answering "*which service* made p99 worse" requires stitching Jaeger
+traces by hand.  The simulator holds every hop of every request on
+device — this module decomposes each request's client latency along the
+critical path of its unrolled call tree *inside* the existing
+``lax.scan`` block reduction (and the sharded ``psum`` merge), so the
+per-request tensors are reduced to O(H) blame vectors + O(S * buckets)
+blame histograms before they ever leave the device.  Nothing O(N * H)
+reaches the host.
+
+Decomposition (exact, telescoping):
+
+- the client edge contributes its wire round trip (a refused connection
+  under chaos contributes exactly the refused-connect cost);
+- a hop on the critical path contributes its queueing **wait** and its
+  **self** time (CPU draw + sleeps + any step time the concurrent calls
+  did not cover);
+- at each executed call-bearing step, the *winning* call (the per-step
+  ``max`` the engine's WaitGroup join takes) passes the path to its
+  attempts: every attempt that actually ran is serially on the path —
+  an uncapped attempt charges its request+response **wire** time to the
+  caller->callee edge and recurses into the callee, a timeout-capped
+  attempt charges the full **timeout** to the edge and stops (the
+  subtree past the timeout is off the caller's clock).
+
+Summing every charge reproduces the client latency exactly (up to f32
+accumulation order); the per-request difference is accumulated as
+``residual`` — nonzero only for ungraceful-kill resets, whose
+client-observed latency is a connection reset, not the tree walk.
+
+Tail attribution re-weights every accumulator by ``latency >= cut``
+(the streaming-threshold mode: the cut is a p99/p99.9 estimate from a
+pilot histogram), so the report can show p99 blame shares next to mean
+shares.  Exemplar mining keeps the top-K slowest requests' per-hop
+vectors (O(K * H)) in the scan carry; they feed the Chrome/Jaeger trace
+exporters (metrics/trace.py) so the worst requests come back as
+inspectable spans.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import List, NamedTuple, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from isotope_tpu.compiler.program import CompiledGraph, hop_wire_times
+
+# Coarse log-spaced blame buckets: per-service blame histograms are
+# (S, NUM_BLAME_BUCKETS), so svc100k stays ~25 MB where the fine
+# 2048-bucket layout of metrics/histogram.py would be ~800 MB.
+NUM_BLAME_BUCKETS = 64
+_BLO, _BHI = 1e-6, 10.0  # seconds
+_B_LOG_LO = float(np.log(_BLO))
+_B_INV_LOG_R = float((NUM_BLAME_BUCKETS - 2) / np.log(_BHI / _BLO))
+
+BLAME_EDGES = np.concatenate(
+    [[0.0], np.geomspace(_BLO, _BHI, NUM_BLAME_BUCKETS - 1), [np.inf]]
+)
+
+
+def blame_bucket_index(v: jax.Array) -> jax.Array:
+    """Bucket index per blame value (same arithmetic-index trick as
+    metrics/histogram.bucket_index, at the coarse width)."""
+    t = (jnp.log(v) - _B_LOG_LO) * _B_INV_LOG_R
+    t = jnp.clip(t, -1.0, NUM_BLAME_BUCKETS - 2)
+    idx = jnp.floor(t).astype(jnp.int32) + 1
+    return jnp.where(jnp.isnan(t), NUM_BLAME_BUCKETS - 1, idx)
+
+
+def blame_bucket_centers() -> np.ndarray:
+    centers = np.empty(NUM_BLAME_BUCKETS)
+    centers[0] = BLAME_EDGES[1] / 2
+    centers[1:-1] = np.sqrt(BLAME_EDGES[1:-2] * BLAME_EDGES[2:-1])
+    centers[-1] = BLAME_EDGES[-2]
+    return centers
+
+
+class ExemplarBatch(NamedTuple):
+    """Top-K slowest requests' per-hop vectors — O(K * H), the only
+    per-request data attribution ever materializes.  Rows are sorted
+    slowest-first (``tail_rank`` = row index)."""
+
+    latency: jax.Array     # (K,)
+    start: jax.Array       # (K,)
+    error: jax.Array       # (K,) bool
+    hop_sent: jax.Array    # (K, H) bool
+    hop_error: jax.Array   # (K, H) bool
+    hop_latency: jax.Array  # (K, H)
+    hop_start: jax.Array   # (K, H)
+
+
+class AttributionSummary(NamedTuple):
+    """Device-reduced critical-path blame for one run.
+
+    Every array is O(H), O(S * blame buckets), or O(K * H); block
+    summaries sum under ``lax.scan`` and shards merge with ``psum``
+    exactly like :class:`~isotope_tpu.sim.summary.RunSummary`.
+
+    Blame vectors are indexed by HOP (BFS order); per-service and
+    per-edge tables are host-side groupbys over the static hop->service
+    map (:func:`service_blame` / :func:`edge_blame`).  ``*_tail``
+    fields restrict to requests with client latency >= ``tail_cut``
+    (identically zero when the run had no tail cut).
+    """
+
+    count: jax.Array          # scalar — requests attributed
+    tail_count: jax.Array     # scalar — requests past the tail cut
+    tail_cut: jax.Array       # scalar — the cut used (+inf = mean only)
+    residual: jax.Array       # scalar — sum(client latency - attributed)
+    residual_abs: jax.Array   # scalar — sum |client latency - attributed|
+    crit_count: jax.Array     # (H,) times the hop was on the crit path
+    wait_blame: jax.Array     # (H,) queueing wait on the crit path
+    self_blame: jax.Array     # (H,) CPU + sleeps + uncovered step time
+    net_blame: jax.Array      # (H,) wire time of the edge INTO the hop
+    timeout_blame: jax.Array  # (H,) timeout charges on the edge into it
+    error_count: jax.Array    # (H,) executed hops that returned 500
+    tail_crit_count: jax.Array
+    tail_wait_blame: jax.Array
+    tail_self_blame: jax.Array
+    tail_net_blame: jax.Array
+    tail_timeout_blame: jax.Array
+    hist: jax.Array           # (S, NUM_BLAME_BUCKETS) per-service blame
+    tail_hist: jax.Array      # (S, NUM_BLAME_BUCKETS)
+    exemplars: Optional[ExemplarBatch]
+
+    @property
+    def total_blame_s(self) -> float:
+        return float(
+            np.asarray(self.wait_blame).sum()
+            + np.asarray(self.self_blame).sum()
+            + np.asarray(self.net_blame).sum()
+            + np.asarray(self.timeout_blame).sum()
+        )
+
+    @property
+    def tail_total_blame_s(self) -> float:
+        return float(
+            np.asarray(self.tail_wait_blame).sum()
+            + np.asarray(self.tail_self_blame).sum()
+            + np.asarray(self.tail_net_blame).sum()
+            + np.asarray(self.tail_timeout_blame).sum()
+        )
+
+
+# -- static tables ----------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class _LevelTables:
+    """Static index tables for one depth level's blame sweep."""
+
+    offset: int                 # hop slice of this level in BFS order
+    size: int
+    child_offset: int           # hop slice of the children (level d+1)
+    child_size: int
+    parent_local: Optional[jax.Array]   # (C,) i32
+    call_of_child: Optional[jax.Array]  # (C,) i32 in [0, K)
+    slot_of_call: Optional[jax.Array]   # (K,) i32 in [0, n_slots)
+    n_slots: int
+    num_calls: int
+    slot_base: Optional[jax.Array]      # (n_slots,) sleep floor per step
+    child_rtt: Optional[jax.Array]      # (C,) request+response wire time
+    child_timeout: Optional[jax.Array]  # (C,) +inf when none
+    has_timeout: bool
+    svc: np.ndarray             # (L,) static service id per hop
+
+
+@dataclasses.dataclass(frozen=True)
+class AttrTables:
+    """Everything :func:`attribute_block` needs, built once per
+    Simulator from the compiled graph + network model (host-side)."""
+
+    levels: Tuple[_LevelTables, ...]
+    num_hops: int
+    num_services: int
+    root_net: float        # client->entry wire round trip
+    refused_net: float     # refused-connect cost (down entry)
+    svc_flat: Tuple[np.ndarray, ...]  # per-level (L,) service ids
+
+
+def build_tables(compiled: CompiledGraph, net) -> AttrTables:
+    """Lower the compiled graph's call structure into blame-sweep index
+    tables.  Only uses the assembled program's *static* shape — the
+    sweep itself reads nothing but the engine's (N, H) outputs, so it
+    is oblivious to which executor (unrolled / scan-bucketed / sparse)
+    produced them."""
+    net_out, net_back = hop_wire_times(compiled, net)
+    rtt = net_out + net_back
+    levels: List[_LevelTables] = []
+    for d, lvl in enumerate(compiled.levels):
+        svc = np.asarray(lvl.service, np.int32)
+        if lvl.num_children == 0:
+            levels.append(
+                _LevelTables(
+                    offset=int(lvl.hop_ids[0]), size=lvl.num_hops,
+                    child_offset=0, child_size=0,
+                    parent_local=None, call_of_child=None,
+                    slot_of_call=None, n_slots=0, num_calls=0,
+                    slot_base=None, child_rtt=None, child_timeout=None,
+                    has_timeout=False, svc=svc,
+                )
+            )
+            continue
+        C = lvl.num_children
+        K = lvl.num_calls
+        parent_local = (lvl.child_seg // compiled.max_steps).astype(
+            np.int32
+        )
+        # child -> owning call site (every child is exactly one call's
+        # attempt; attempt order within a call is serial)
+        call_of_child = np.zeros(C, np.int32)
+        for a in range(lvl.max_attempts):
+            valid = lvl.att_valid[a]
+            call_of_child[lvl.att_child[a][valid]] = np.arange(
+                K, dtype=np.int32
+            )[valid]
+        # call-bearing steps only — the sparse-level fix applied
+        # globally: no (L x Pmax) dense step grid is ever materialized
+        slot_segs = np.unique(lvl.call_seg)
+        slot_of_call = np.searchsorted(slot_segs, lvl.call_seg).astype(
+            np.int32
+        )
+        slot_base = lvl.step_base[
+            slot_segs // compiled.max_steps,
+            slot_segs % compiled.max_steps,
+        ].astype(np.float32)
+        timeout = lvl.call_timeout[call_of_child].astype(np.float32)
+        nxt = compiled.levels[d + 1]
+        levels.append(
+            _LevelTables(
+                offset=int(lvl.hop_ids[0]), size=lvl.num_hops,
+                child_offset=int(nxt.hop_ids[0]), child_size=C,
+                parent_local=jnp.asarray(parent_local),
+                call_of_child=jnp.asarray(call_of_child),
+                slot_of_call=jnp.asarray(slot_of_call),
+                n_slots=len(slot_segs), num_calls=K,
+                slot_base=jnp.asarray(slot_base),
+                child_rtt=jnp.asarray(rtt[lvl.child_ids], jnp.float32),
+                child_timeout=jnp.asarray(timeout),
+                has_timeout=bool(np.isfinite(timeout).any()),
+                svc=svc,
+            )
+        )
+    return AttrTables(
+        levels=tuple(levels),
+        num_hops=compiled.num_hops,
+        num_services=compiled.num_services,
+        root_net=float(rtt[0]),
+        refused_net=float(2.0 * net.entry_one_way(0.0)),
+        svc_flat=tuple(lvl.svc for lvl in levels),
+    )
+
+
+# -- the on-device blame sweep ----------------------------------------------
+
+
+def _winner_charges(lvl: _LevelTables, w, sent_c, lat_c):
+    """Per-child critical-path charges at one level.
+
+    ``w`` is the level's (N, L) crit weights; returns
+    ``(D, on_crit, att_dur, capped)``: the per-parent charged duration
+    and the per-child path weights/durations.
+    """
+    n = sent_c.shape[0]
+    K, S = lvl.num_calls, lvl.n_slots
+    # attempt duration exactly as the engine's call outcome: capped by
+    # the call's timeout; an unsent / refused attempt costs 0
+    raw = lvl.child_rtt + lat_c
+    att_dur = sent_c * (
+        jnp.minimum(raw, lvl.child_timeout) if lvl.has_timeout else raw
+    )
+    # serial attempts of one call sum; concurrent calls at one step join
+    # via max — the winner is the engine's WaitGroup argmax (first max)
+    dur_call = (
+        jnp.zeros((n, K)).at[:, lvl.call_of_child].add(att_dur)
+    )
+    slot_max = (
+        jnp.zeros((n, S)).at[:, lvl.slot_of_call].max(dur_call)
+    )
+    beats_sleep = slot_max >= lvl.slot_base          # (N, S)
+    win_idx = (
+        jnp.full((n, S), K, jnp.int32)
+        .at[:, lvl.slot_of_call]
+        .min(
+            jnp.where(
+                dur_call == slot_max[:, lvl.slot_of_call],
+                jnp.arange(K, dtype=jnp.int32),
+                K,
+            )
+        )
+    )
+    is_win = (
+        jnp.arange(K, dtype=jnp.int32) == win_idx[:, lvl.slot_of_call]
+    ) & beats_sleep[:, lvl.slot_of_call]             # (N, K)
+    on_crit = (
+        w[:, lvl.parent_local]
+        * is_win[:, lvl.call_of_child]
+        * sent_c
+    )                                                # (N, C) f32
+    capped = (raw > lvl.child_timeout) if lvl.has_timeout else None
+    D = (
+        jnp.zeros((n, lvl.size))
+        .at[:, lvl.parent_local]
+        .add(on_crit * att_dur)
+    )
+    return D, on_crit, att_dur, capped
+
+
+def attribute_block(
+    res,
+    tables: AttrTables,
+    *,
+    tail_cut: Optional[jax.Array] = None,
+    top_k: int = 0,
+    ex_state: Optional[ExemplarBatch] = None,
+) -> Tuple[AttributionSummary, Optional[ExemplarBatch]]:
+    """Reduce one block's SimResults to an AttributionSummary
+    (jit-friendly; called inside the engine's block scan).
+
+    ``tail_cut`` arms the conditional-tail accumulators; ``top_k`` > 0
+    maintains the exemplar state across blocks via ``ex_state`` (ride
+    the scan carry — the stacked per-block summaries carry
+    ``exemplars=None``).
+    """
+    lat_all = res.hop_latency
+    wait_all = res.hop_wait
+    if wait_all is None:
+        raise ValueError(
+            "attribution needs SimResults.hop_wait (produced by "
+            "Simulator runs; synthetic SimResults must fill it)"
+        )
+    n = lat_all.shape[0]
+    sent_f = res.hop_sent.astype(jnp.float32)
+    tail_w = (
+        (res.client_latency >= tail_cut).astype(jnp.float32)
+        if tail_cut is not None
+        else None
+    )
+
+    root_sent = sent_f[:, 0]
+    net0 = jnp.where(
+        res.hop_sent[:, 0], tables.root_net, tables.refused_net
+    )
+    per_req = net0
+    w = root_sent[:, None]  # (N, 1) — level 0 crit weights
+
+    crit_l: List[jax.Array] = []
+    wait_l: List[jax.Array] = []
+    self_l: List[jax.Array] = []
+    net_l: List[jax.Array] = [net0.sum()[None]]
+    tmo_l: List[jax.Array] = [jnp.zeros(1)]
+    t_crit_l: List[jax.Array] = []
+    t_wait_l: List[jax.Array] = []
+    t_self_l: List[jax.Array] = []
+    t_net_l: List[jax.Array] = [
+        (net0 * tail_w).sum()[None] if tail_w is not None
+        else jnp.zeros(1)
+    ]
+    t_tmo_l: List[jax.Array] = [jnp.zeros(1)]
+    hist = jnp.zeros(tables.num_services * NUM_BLAME_BUCKETS)
+    t_hist = jnp.zeros(tables.num_services * NUM_BLAME_BUCKETS)
+
+    for li, lvl in enumerate(tables.levels):
+        sl = slice(lvl.offset, lvl.offset + lvl.size)
+        lat = lat_all[:, sl]
+        wait = wait_all[:, sl]
+        if lvl.child_size:
+            csl = slice(
+                lvl.child_offset, lvl.child_offset + lvl.child_size
+            )
+            D, on_crit, att_dur, capped = _winner_charges(
+                lvl, w, sent_f[:, csl], lat_all[:, csl]
+            )
+            if capped is not None:
+                w_next = on_crit * ~capped
+                net_c = w_next * lvl.child_rtt
+                tmo_c = on_crit * capped * att_dur
+            else:
+                w_next = on_crit
+                net_c = on_crit * lvl.child_rtt
+                tmo_c = None
+            net_l.append(net_c.sum(0))
+            tmo_l.append(
+                tmo_c.sum(0) if tmo_c is not None
+                else jnp.zeros(lvl.child_size)
+            )
+            per_req = per_req + net_c.sum(1)
+            if tmo_c is not None:
+                per_req = per_req + tmo_c.sum(1)
+            if tail_w is not None:
+                t_net_l.append((net_c * tail_w[:, None]).sum(0))
+                t_tmo_l.append(
+                    (tmo_c * tail_w[:, None]).sum(0)
+                    if tmo_c is not None
+                    else jnp.zeros(lvl.child_size)
+                )
+            else:
+                t_net_l.append(jnp.zeros(lvl.child_size))
+                t_tmo_l.append(jnp.zeros(lvl.child_size))
+        else:
+            D = 0.0
+            w_next = None
+
+        hop_wait = w * wait
+        hop_self = w * (lat - wait) - D
+        contrib = hop_wait + hop_self  # == w * lat - D
+        per_req = per_req + contrib.sum(1)
+        crit_l.append(w.sum(0))
+        wait_l.append(hop_wait.sum(0))
+        self_l.append(hop_self.sum(0))
+        # clamp before bucketing: f32 accumulation can leave an
+        # off-path hop's contribution a hair below zero, and log(<0)
+        # would scatter its weight into the overflow bucket
+        flat_idx = (
+            jnp.asarray(lvl.svc)[None, :] * NUM_BLAME_BUCKETS
+            + blame_bucket_index(jnp.maximum(contrib, 0.0))
+        )
+        hist = hist.at[flat_idx].add(w)
+        if tail_w is not None:
+            wt = w * tail_w[:, None]
+            t_crit_l.append(wt.sum(0))
+            t_wait_l.append((hop_wait * tail_w[:, None]).sum(0))
+            t_self_l.append((hop_self * tail_w[:, None]).sum(0))
+            t_hist = t_hist.at[flat_idx].add(wt)
+        else:
+            t_crit_l.append(jnp.zeros(lvl.size))
+            t_wait_l.append(jnp.zeros(lvl.size))
+            t_self_l.append(jnp.zeros(lvl.size))
+        w = w_next
+
+    resid = res.client_latency - per_req
+    err_count = (res.hop_sent & res.hop_error).sum(0).astype(jnp.float32)
+
+    if top_k > 0:
+        ex_state = _update_exemplars(res, ex_state, top_k)
+
+    summary = AttributionSummary(
+        count=jnp.float32(n),
+        tail_count=(
+            tail_w.sum() if tail_w is not None else jnp.float32(0.0)
+        ),
+        tail_cut=(
+            jnp.asarray(tail_cut, jnp.float32)
+            if tail_cut is not None
+            else jnp.float32(np.inf)
+        ),
+        residual=resid.sum(),
+        residual_abs=jnp.abs(resid).sum(),
+        crit_count=jnp.concatenate(crit_l),
+        wait_blame=jnp.concatenate(wait_l),
+        self_blame=jnp.concatenate(self_l),
+        net_blame=jnp.concatenate(net_l),
+        timeout_blame=jnp.concatenate(tmo_l),
+        error_count=err_count,
+        tail_crit_count=jnp.concatenate(t_crit_l),
+        tail_wait_blame=jnp.concatenate(t_wait_l),
+        tail_self_blame=jnp.concatenate(t_self_l),
+        tail_net_blame=jnp.concatenate(t_net_l),
+        tail_timeout_blame=jnp.concatenate(t_tmo_l),
+        hist=hist.reshape(tables.num_services, NUM_BLAME_BUCKETS),
+        tail_hist=t_hist.reshape(
+            tables.num_services, NUM_BLAME_BUCKETS
+        ),
+        exemplars=None,
+    )
+    return summary, ex_state
+
+
+def _update_exemplars(
+    res, ex: Optional[ExemplarBatch], k: int
+) -> ExemplarBatch:
+    """Merge this block's top-K slowest requests into the carry."""
+    k = min(k, res.client_latency.shape[0])
+    _, idx = jax.lax.top_k(res.client_latency, k)
+    batch = ExemplarBatch(
+        latency=res.client_latency[idx],
+        start=res.client_start[idx],
+        error=res.client_error[idx],
+        hop_sent=res.hop_sent[idx],
+        hop_error=res.hop_error[idx],
+        hop_latency=res.hop_latency[idx],
+        hop_start=res.hop_start[idx],
+    )
+    if ex is None:
+        return batch
+    merged = jax.tree.map(
+        lambda a, b: jnp.concatenate([a, b]), ex, batch
+    )
+    _, keep = jax.lax.top_k(merged.latency, k)
+    return jax.tree.map(lambda a: a[keep], merged)
+
+
+def merge_exemplars_host(
+    batches: Sequence[ExemplarBatch], k: Optional[int] = None
+) -> ExemplarBatch:
+    """Top-K merge of per-shard exemplar batches on host (the
+    single-device emulation's replay of the mesh ``all_gather``)."""
+    cat = jax.tree.map(
+        lambda *xs: np.concatenate([np.asarray(x) for x in xs]),
+        *batches,
+    )
+    k = k if k is not None else len(np.asarray(batches[0].latency))
+    order = np.argsort(-np.asarray(cat.latency), kind="stable")[:k]
+    return jax.tree.map(lambda a: a[order], cat)
+
+
+def reduce_stacked(
+    parts: AttributionSummary,
+    exemplars: Optional[ExemplarBatch] = None,
+) -> AttributionSummary:
+    """Reduce block-stacked summaries (the scan's ys) to one summary;
+    ``exemplars`` is the scan carry's final top-K state."""
+    out = jax.tree.map(lambda x: x.sum(0), parts._replace(
+        tail_cut=jnp.zeros_like(parts.tail_cut), exemplars=None,
+    ))
+    return out._replace(
+        tail_cut=parts.tail_cut.max(0), exemplars=exemplars
+    )
+
+
+def merge_host(shards: Sequence[AttributionSummary]) -> AttributionSummary:
+    """Host replay of the mesh collectives over per-shard summaries
+    (sequential shard-order sums — the degraded single-device path)."""
+    acc = jax.tree.map(
+        np.asarray, shards[0]._replace(exemplars=None)
+    )
+    for s in shards[1:]:
+        nxt = jax.tree.map(np.asarray, s._replace(exemplars=None))
+        acc = jax.tree.map(lambda a, b: a + b, acc, nxt)
+    acc = acc._replace(tail_cut=np.asarray(shards[0].tail_cut))
+    ex = [s.exemplars for s in shards if s.exemplars is not None]
+    if ex:
+        acc = acc._replace(exemplars=merge_exemplars_host(ex))
+    return acc
+
+
+# -- host-side tables -------------------------------------------------------
+
+
+def service_blame(compiled: CompiledGraph, attr: AttributionSummary,
+                  tail: bool = False) -> List[dict]:
+    """Per-service blame rows (seconds + share of total blame), sorted
+    by descending share."""
+    hs = compiled.hop_service
+    S = compiled.num_services
+
+    def by_svc(v):
+        return np.bincount(hs, weights=np.asarray(v, np.float64),
+                           minlength=S)
+
+    wait = by_svc(attr.tail_wait_blame if tail else attr.wait_blame)
+    self_ = by_svc(attr.tail_self_blame if tail else attr.self_blame)
+    net = by_svc(attr.tail_net_blame if tail else attr.net_blame)
+    tmo = by_svc(
+        attr.tail_timeout_blame if tail else attr.timeout_blame
+    )
+    crit = by_svc(attr.tail_crit_count if tail else attr.crit_count)
+    errs = by_svc(attr.error_count)
+    total = float(wait.sum() + self_.sum() + net.sum() + tmo.sum())
+    count = float(attr.tail_count if tail else attr.count)
+    rows = []
+    for s in range(S):
+        blame = wait[s] + self_[s] + net[s] + tmo[s]
+        if blame <= 0 and crit[s] <= 0 and errs[s] <= 0:
+            continue
+        rows.append(
+            {
+                "service": compiled.services.names[s],
+                "share": blame / total if total > 0 else 0.0,
+                "blame_s": blame,
+                "wait_s": float(wait[s]),
+                "self_s": float(self_[s]),
+                "net_s": float(net[s]),
+                "timeout_s": float(tmo[s]),
+                "crit_per_request": (
+                    float(crit[s]) / count if count else 0.0
+                ),
+                "errors": float(errs[s]),
+            }
+        )
+    rows.sort(key=lambda r: -r["share"])
+    return rows
+
+
+def edge_blame(compiled: CompiledGraph, attr: AttributionSummary,
+               tail: bool = False) -> List[dict]:
+    """Per caller->callee edge wire/timeout blame (the client edge is
+    ``client -> <entry>``), sorted by descending blame."""
+    names = compiled.services.names
+    hs = compiled.hop_service
+    parent = compiled.hop_parent
+    net = np.asarray(
+        attr.tail_net_blame if tail else attr.net_blame, np.float64
+    )
+    tmo = np.asarray(
+        attr.tail_timeout_blame if tail else attr.timeout_blame,
+        np.float64,
+    )
+    crit = np.asarray(
+        attr.tail_crit_count if tail else attr.crit_count, np.float64
+    )
+    errs = np.asarray(attr.error_count, np.float64)
+    agg: dict = {}
+    for h in range(compiled.num_hops):
+        caller = "client" if parent[h] < 0 else names[hs[parent[h]]]
+        key = (caller, names[hs[h]])
+        row = agg.setdefault(
+            key, {"net_s": 0.0, "timeout_s": 0.0, "crit": 0.0,
+                  "errors": 0.0}
+        )
+        row["net_s"] += net[h]
+        row["timeout_s"] += tmo[h]
+        row["crit"] += crit[h]
+        row["errors"] += errs[h]
+    out = [
+        {"caller": c, "callee": e, **v}
+        for (c, e), v in agg.items()
+        if v["net_s"] or v["timeout_s"] or v["crit"] or v["errors"]
+    ]
+    out.sort(key=lambda r: -(r["net_s"] + r["timeout_s"]))
+    return out
+
+
+def to_doc(compiled: CompiledGraph, attr: AttributionSummary,
+           top: int = 0) -> dict:
+    """The ``<label>.blame.json`` artifact: mean + tail service/edge
+    tables plus the invariant evidence (residual, counts)."""
+    count = max(float(attr.count), 1.0)
+    tail_on = bool(np.isfinite(float(attr.tail_cut)))
+    doc = {
+        "schema": "isotope-blame/v1",
+        "count": float(attr.count),
+        "tail_cut_s": (
+            float(attr.tail_cut) if tail_on else None
+        ),
+        "tail_count": float(attr.tail_count),
+        "mean_attributed_s": attr.total_blame_s / count,
+        "residual_s_per_request": float(attr.residual) / count,
+        "residual_abs_s_per_request": float(attr.residual_abs) / count,
+        "services": service_blame(compiled, attr)[: top or None],
+        "edges": edge_blame(compiled, attr)[: top or None],
+    }
+    if tail_on:
+        doc["tail_services"] = service_blame(
+            compiled, attr, tail=True
+        )[: top or None]
+        doc["tail_edges"] = edge_blame(compiled, attr, tail=True)[
+            : top or None
+        ]
+    return doc
+
+
+def format_table(doc: dict, top: int = 12) -> str:
+    """Human-readable blame table (the ``report``/``simulate`` CLI)."""
+    tail_rows = {
+        r["service"]: r for r in doc.get("tail_services") or []
+    }
+    lines = [
+        f"critical-path blame over {doc['count']:.0f} requests "
+        f"(mean attributed {doc['mean_attributed_s'] * 1e3:.3f} ms, "
+        f"residual {doc['residual_abs_s_per_request'] * 1e6:.3f} us/req)"
+    ]
+    if doc.get("tail_cut_s") is not None:
+        lines.append(
+            f"tail cut: {doc['tail_cut_s'] * 1e3:.3f} ms "
+            f"({doc['tail_count']:.0f} requests past it)"
+        )
+    hdr = (
+        f"{'service':<24} {'share':>7} {'wait':>9} {'self':>9} "
+        f"{'net':>9} {'timeout':>9}"
+    )
+    if tail_rows:
+        hdr += f" {'tail share':>10}"
+    lines.append(hdr)
+    for r in doc["services"][:top]:
+        line = (
+            f"{r['service']:<24} {r['share'] * 100:>6.1f}% "
+            f"{r['wait_s']:>9.4f} {r['self_s']:>9.4f} "
+            f"{r['net_s']:>9.4f} {r['timeout_s']:>9.4f}"
+        )
+        t = tail_rows.get(r["service"])
+        if tail_rows:
+            line += (
+                f" {t['share'] * 100:>9.1f}%" if t else f" {'-':>10}"
+            )
+        lines.append(line)
+    return "\n".join(lines)
+
+
+def exemplar_results(attr: AttributionSummary):
+    """Rebuild a :class:`~isotope_tpu.sim.engine.SimResults`-shaped view
+    of the mined exemplars so the trace exporters accept them without a
+    dense re-run (rows stay slowest-first; utilization fields are
+    zeroed — they are run-level, not per-request)."""
+    from isotope_tpu.sim.engine import SimResults
+
+    ex = attr.exemplars
+    if ex is None:
+        raise ValueError(
+            "attribution summary carries no exemplars (run with "
+            "attribution_top_k > 0)"
+        )
+    k = np.asarray(ex.latency).shape[0]
+    h = np.asarray(ex.hop_latency).shape[1]
+    return SimResults(
+        client_start=np.asarray(ex.start),
+        client_latency=np.asarray(ex.latency),
+        client_error=np.asarray(ex.error),
+        hop_sent=np.asarray(ex.hop_sent),
+        hop_error=np.asarray(ex.hop_error),
+        hop_latency=np.asarray(ex.hop_latency),
+        hop_start=np.asarray(ex.hop_start),
+        utilization=np.zeros(1, np.float32),
+        unstable=np.zeros(1, bool),
+        offered_qps=np.float32(0.0),
+        hop_wait=np.zeros((k, h), np.float32),
+    )

@@ -44,11 +44,15 @@ def chrome_trace(
     compiled: CompiledGraph,
     res: SimResults,
     max_requests: Optional[int] = None,
+    annotations: Optional[List[dict]] = None,
 ) -> dict:
     """Render sampled requests as Chrome trace-event JSON.
 
     Layout: pid = request index, tid = call depth, one complete ("X")
     event per executed hop; timestamps in microseconds.
+    ``annotations`` (one dict per request, e.g. the tail-exemplar
+    ``tail_rank``/``tail_cut_s`` of metrics/attribution.py) merge into
+    every event's ``args``.
     """
     sent, start, lat, err = _as_host(res)
     names = compiled.services.names
@@ -59,6 +63,7 @@ def chrome_trace(
     )
     events: List[dict] = []
     for r in range(n):
+        extra = annotations[r] if annotations else {}
         for h in np.nonzero(sent[r])[0]:
             events.append(
                 {
@@ -73,6 +78,7 @@ def chrome_trace(
                         "hop": int(h),
                         "parent_hop": int(parent[h]),
                         "status": 500 if err[r, h] else 200,
+                        **extra,
                     },
                 }
             )
@@ -83,14 +89,26 @@ def chrome_trace(
     }
 
 
+def _jaeger_tag(key: str, value):
+    if isinstance(value, bool):
+        return {"key": key, "type": "bool", "value": value}
+    if isinstance(value, int):
+        return {"key": key, "type": "int64", "value": value}
+    if isinstance(value, float):
+        return {"key": key, "type": "float64", "value": value}
+    return {"key": key, "type": "string", "value": str(value)}
+
+
 def jaeger_trace(
     compiled: CompiledGraph,
     res: SimResults,
     max_requests: Optional[int] = None,
+    annotations: Optional[List[dict]] = None,
 ) -> dict:
     """Render sampled requests in Jaeger's JSON shape (one trace per
     request; spans reference their caller hop with CHILD_OF, the
-    simulated B3 propagation of srv/header.go:21-48)."""
+    simulated B3 propagation of srv/header.go:21-48).  ``annotations``
+    (one dict per request) become extra tags on every span."""
     sent, start, lat, err = _as_host(res)
     names = compiled.services.names
     parent = compiled.hop_parent
@@ -103,6 +121,10 @@ def jaeger_trace(
         trace_id = f"{r + 1:032x}"
         spans = []
         procs: Dict[str, dict] = {}
+        extra_tags = [
+            _jaeger_tag(k, v)
+            for k, v in (annotations[r] if annotations else {}).items()
+        ]
         for h in np.nonzero(sent[r])[0]:
             svc = names[compiled.hop_service[h]]
             pkey = f"p{compiled.hop_service[h]}"
@@ -122,7 +144,7 @@ def jaeger_trace(
                         "value": 500 if err[r, h] else 200,
                     },
                     {"key": "hop", "type": "int64", "value": int(h)},
-                ],
+                ] + extra_tags,
             }
             if parent[h] >= 0 and sent[r, parent[h]]:
                 span["references"].append(
@@ -139,19 +161,55 @@ def jaeger_trace(
     return {"data": data}
 
 
+def exemplar_annotations(attr) -> List[dict]:
+    """Per-request tail annotations for an exemplar batch: the rank
+    among the mined slowest requests (0 = slowest) plus the tail cut
+    the run used, carried in Chrome ``args`` / Jaeger ``tags``."""
+    ex = attr.exemplars
+    if ex is None:
+        raise ValueError(
+            "attribution summary carries no exemplars (run with "
+            "attribution_top_k > 0)"
+        )
+    cut = float(np.asarray(attr.tail_cut))
+    k = int(np.asarray(ex.latency).shape[0])
+    out = []
+    for r in range(k):
+        ann = {"tail_rank": r}
+        if np.isfinite(cut):
+            ann["tail_cut_s"] = cut
+        out.append(ann)
+    return out
+
+
 def write_trace(
     path: str,
     compiled: CompiledGraph,
-    res: SimResults,
+    res: Optional[SimResults] = None,
     fmt: str = "chrome",
     max_requests: Optional[int] = None,
+    exemplars=None,
 ) -> int:
-    """Write a trace file; returns the number of requests traced."""
+    """Write a trace file; returns the number of requests traced.
+
+    ``exemplars`` accepts an
+    :class:`~isotope_tpu.metrics.attribution.AttributionSummary` whose
+    mined top-K batch is traced directly — no dense re-run — with
+    ``tail_rank`` / ``tail_cut_s`` annotations on every span.
+    """
+    annotations = None
+    if exemplars is not None:
+        from isotope_tpu.metrics import attribution
+
+        res = attribution.exemplar_results(exemplars)
+        annotations = exemplar_annotations(exemplars)
+    if res is None:
+        raise ValueError("write_trace needs res or exemplars")
     if fmt == "chrome":
-        doc = chrome_trace(compiled, res, max_requests)
+        doc = chrome_trace(compiled, res, max_requests, annotations)
         count = len({e["pid"] for e in doc["traceEvents"]})
     elif fmt == "jaeger":
-        doc = jaeger_trace(compiled, res, max_requests)
+        doc = jaeger_trace(compiled, res, max_requests, annotations)
         count = len(doc["data"])
     else:
         raise ValueError(f"unknown trace format: {fmt!r}")

@@ -263,34 +263,7 @@ class ShardedSimulator:
                 body,
                 mesh=self.mesh,
                 in_specs=tuple(P() for _ in range(8)),
-                out_specs=RunSummary(
-                    count=P(),
-                    error_count=P(),
-                    hop_events=P(),
-                    latency_sum=P(),
-                    latency_m2=P(),
-                    latency_min=P(),
-                    latency_max=P(),
-                    latency_hist=P(),
-                    end_max=P(),
-                    win_lo=P(),
-                    win_hi=P(),
-                    win_count=P(),
-                    win_error_count=P(),
-                    win_latency_hist=P(),
-                    metrics=ServiceMetrics(
-                        incoming_total=P(),
-                        outgoing_total=P(),
-                        outgoing_size_hist=P(),
-                        outgoing_size_sum=P(),
-                        duration_hist=P(SVC_AXIS),
-                        duration_sum=P(),
-                        response_size_hist=P(SVC_AXIS),
-                        response_size_sum=P(),
-                    ),
-                    utilization=P(),
-                    unstable=P(),
-                ),
+                out_specs=self._summary_out_specs(),
             )
             mesh_sig = (
                 tuple(self.mesh.axis_names),
@@ -302,6 +275,39 @@ class ShardedSimulator:
                 lambda: jax.jit(mapped),
             )
         return self._fns[cache_key]
+
+    def _summary_out_specs(self) -> RunSummary:
+        """Partition specs of the collective-merged RunSummary: scalars
+        and the fine histogram replicate; the per-service duration /
+        response-size histograms stay sharded over the svc axis."""
+        return RunSummary(
+            count=P(),
+            error_count=P(),
+            hop_events=P(),
+            latency_sum=P(),
+            latency_m2=P(),
+            latency_min=P(),
+            latency_max=P(),
+            latency_hist=P(),
+            end_max=P(),
+            win_lo=P(),
+            win_hi=P(),
+            win_count=P(),
+            win_error_count=P(),
+            win_latency_hist=P(),
+            metrics=ServiceMetrics(
+                incoming_total=P(),
+                outgoing_total=P(),
+                outgoing_size_hist=P(),
+                outgoing_size_sum=P(),
+                duration_hist=P(SVC_AXIS),
+                duration_sum=P(),
+                response_size_hist=P(SVC_AXIS),
+                response_size_sum=P(),
+            ),
+            utilization=P(),
+            unstable=P(),
+        )
 
     def _local_scan(
         self,
@@ -393,7 +399,12 @@ class ShardedSimulator:
             shard, key, offered_qps, pace_gap, nominal_gap,
             win_lo, win_hi, visits_pc, phase_windows,
         )
+        return self._merge_summary_collective(local, both)
 
+    def _merge_summary_collective(self, local: RunSummary,
+                                  both) -> RunSummary:
+        """The mesh metric reduction over one shard's RunSummary
+        (shared by the plain and the attributed bodies)."""
         def allsum(x):
             return jax.lax.psum(x, both)
 
@@ -446,6 +457,286 @@ class ShardedSimulator:
             metrics=metrics,
             utilization=local.utilization,
             unstable=local.unstable,
+        )
+
+    # -- attributed runs (metrics/attribution.py) -----------------------
+
+    def run_attributed(
+        self,
+        load: LoadModel,
+        num_requests: int,
+        key: jax.Array,
+        offered_qps=None,
+        block_size: int = 65_536,
+        trim: bool = False,
+        tail: bool = False,
+        tail_cut=None,
+    ):
+        """Sharded twin of :meth:`Simulator.run_attributed`: every
+        shard reduces its block scan to (RunSummary, AttributionSummary)
+        and the attribution leaves merge with the same collectives the
+        summary takes — ``psum`` for the O(H)/O(S * buckets) blame
+        accumulators, ``all_gather`` + ``top_k`` for the O(K * H)
+        exemplar batch (so every shard returns the same global top-K).
+        Returns ``(RunSummary, AttributionSummary)``."""
+        if not self.sim.params.attribution:
+            raise ValueError(
+                "attributed runs need SimParams(attribution=True)"
+            )
+        if tail and tail_cut is None:
+            tail_cut = self.sim.estimate_tail_cut(
+                load, num_requests, key, block_size=block_size
+            )
+        plan = self._plan_run(load, num_requests, key, offered_qps,
+                              block_size, trim)
+        telemetry.counter_inc("sharded_attributed_runs")
+        # build the blame tables EAGERLY: constants created inside the
+        # shard_map trace would be cached as tracers and leak
+        self.sim._attribution_tables()
+        fn = self._get_attr(plan, tail)
+        vis, windows = self._args_put(plan)
+        faults.check("sharded.compute")
+        out = fn(
+            key, jnp.float32(plan.offered), jnp.float32(plan.gap),
+            jnp.float32(plan.nominal_gap),
+            jnp.float32(plan.window[0]), jnp.float32(plan.window[1]),
+            jnp.float32(tail_cut if tail else np.inf),
+            vis, windows,
+        )
+        faults.check("sharded.gather")
+        return out
+
+    def run_attributed_emulated(
+        self,
+        load: LoadModel,
+        num_requests: int,
+        key: jax.Array,
+        offered_qps=None,
+        block_size: int = 65_536,
+        trim: bool = False,
+        tail: bool = False,
+        tail_cut=None,
+    ):
+        """The attributed mesh program replayed shard-by-shard on one
+        device with the collectives merged on host (sequential psum
+        order, host top-K exemplar merge) — the degradation rung /
+        equivalence reference for :meth:`run_attributed`."""
+        if not self.sim.params.attribution:
+            raise ValueError(
+                "attributed runs need SimParams(attribution=True)"
+            )
+        if tail and tail_cut is None:
+            tail_cut = self.sim.estimate_tail_cut(
+                load, num_requests, key, block_size=block_size
+            )
+        from isotope_tpu.metrics import attribution
+
+        plan = self._plan_run(load, num_requests, key, offered_qps,
+                              block_size, trim)
+        self.sim._attribution_tables()  # eager — see run_attributed
+        fn = self._get_local_attr_fn(plan, tail)
+        vis, windows = self._args_put(plan)
+        shards = []
+        with telemetry.phase("sharded.emulated"):
+            for s in range(self.n_shards):
+                out = fn(
+                    jnp.int32(s), key,
+                    jnp.float32(plan.offered), jnp.float32(plan.gap),
+                    jnp.float32(plan.nominal_gap),
+                    jnp.float32(plan.window[0]),
+                    jnp.float32(plan.window[1]),
+                    jnp.float32(tail_cut if tail else np.inf),
+                    vis, windows,
+                )
+                jax.block_until_ready(out[0].count)
+                shards.append(out)
+        summary = self._merge_shard_summaries([s for s, _ in shards])
+        return summary, attribution.merge_host([a for _, a in shards])
+
+    def _local_scan_attr(
+        self,
+        block: int,
+        num_blocks: int,
+        kind: str,
+        conns_local: int,
+        trim: bool,
+        sat_conns: int,
+        tail: bool,
+        shard: jax.Array,
+        key: jax.Array,
+        offered_qps: jax.Array,
+        pace_gap: jax.Array,
+        nominal_gap: jax.Array,
+        win_lo: jax.Array,
+        win_hi: jax.Array,
+        tail_cut: jax.Array,
+        visits_pc: jax.Array,
+        phase_windows: jax.Array,
+    ) -> Tuple[RunSummary, attribution.AttributionSummary]:
+        """One shard's pre-collective attributed block scan (the
+        ``_local_scan`` twin; identical RNG stream layout, so the
+        RunSummary half matches the unattributed path bit-for-bit)."""
+        # lazy: attribution-off paths never import the blame module
+        from isotope_tpu.metrics import attribution
+
+        tables = self.sim._attribution_tables()
+        top_k = self.sim.params.attribution_top_k
+        local_key = jax.random.fold_in(key, 500_000 + shard)
+        c = max(conns_local, 1)
+        per = block // c
+
+        def block_body(carry, b):
+            (t0, conn_t0, req_off), ex = carry
+            kb = jax.random.fold_in(local_key, 1_000_000 + b)
+            res, t_end, conn_end = self.sim._simulate_core(
+                block, kind, conns_local, kb, offered_qps, pace_gap,
+                offered_qps / self.n_shards, nominal_gap, t0, conn_t0,
+                req_off,
+                sat_conns=sat_conns,
+                visits_pc=visits_pc,
+                phase_windows=phase_windows,
+            )
+            s = summarize(
+                res, self.collector,
+                window=(win_lo, win_hi) if trim else None,
+            )
+            a, ex = attribution.attribute_block(
+                res, tables,
+                tail_cut=tail_cut if tail else None,
+                top_k=top_k, ex_state=ex,
+            )
+            return ((t_end, conn_end, req_off + per), ex), (s, a)
+
+        k0 = min(top_k, block) if top_k > 0 else 0
+        H = self.compiled.num_hops
+        ex0 = (
+            attribution.ExemplarBatch(
+                latency=jnp.full((k0,), -jnp.inf),
+                start=jnp.zeros((k0,)),
+                error=jnp.zeros((k0,), bool),
+                hop_sent=jnp.zeros((k0, H), bool),
+                hop_error=jnp.zeros((k0, H), bool),
+                hop_latency=jnp.zeros((k0, H)),
+                hop_start=jnp.zeros((k0, H)),
+            )
+            if k0 > 0
+            else None
+        )
+        carry0 = (
+            (
+                jnp.float32(0.0),
+                jnp.zeros((c,), jnp.float32),
+                jnp.float32(0.0),
+            ),
+            ex0,
+        )
+        (_, ex_final), (parts, aparts) = jax.lax.scan(
+            block_body, carry0, jnp.arange(num_blocks)
+        )
+        return (
+            reduce_stacked(parts),
+            attribution.reduce_stacked(aparts, ex_final),
+        )
+
+    def _attr_body(
+        self,
+        block: int,
+        num_blocks: int,
+        kind: str,
+        conns_local: int,
+        trim: bool,
+        sat_conns: int,
+        tail: bool,
+        key: jax.Array,
+        offered_qps: jax.Array,
+        pace_gap: jax.Array,
+        nominal_gap: jax.Array,
+        win_lo: jax.Array,
+        win_hi: jax.Array,
+        tail_cut: jax.Array,
+        visits_pc: jax.Array,
+        phase_windows: jax.Array,
+    ):
+        both = tuple(self.mesh.axis_names)
+        shard = jnp.int32(0)
+        for a in self.mesh.axis_names:
+            shard = shard * self.mesh.shape[a] + jax.lax.axis_index(a)
+        summary, attr = self._local_scan_attr(
+            block, num_blocks, kind, conns_local, trim, sat_conns,
+            tail, shard, key, offered_qps, pace_gap, nominal_gap,
+            win_lo, win_hi, tail_cut, visits_pc, phase_windows,
+        )
+        merged_summary = self._merge_summary_collective(summary, both)
+        ex = attr.exemplars
+        psummed = jax.tree.map(
+            lambda x: jax.lax.psum(x, both),
+            attr._replace(tail_cut=jnp.float32(0.0), exemplars=None),
+        )
+        merged_attr = psummed._replace(tail_cut=attr.tail_cut)
+        if ex is not None:
+            k = ex.latency.shape[0]
+
+            def gather(x):
+                # one new leading axis of size mesh.size; fold it into
+                # the K axis so top_k sees every shard's candidates
+                y = jax.lax.all_gather(x, both)
+                return y.reshape((-1,) + x.shape[1:])
+
+            cat = jax.tree.map(gather, ex)
+            _, keep = jax.lax.top_k(cat.latency, k)
+            merged_attr = merged_attr._replace(
+                exemplars=jax.tree.map(lambda a: a[keep], cat)
+            )
+        return merged_summary, merged_attr
+
+    def _get_attr(self, plan: _RunPlan, tail: bool):
+        cache_key = (plan.block, plan.num_blocks, plan.kind,
+                     plan.conns_local, plan.trim, plan.sat_conns, tail)
+        key = ("sharded-attr",) + cache_key
+        if key not in self._fns:
+            from isotope_tpu.metrics import attribution
+
+            body = partial(self._attr_body, *cache_key)
+            ex_spec = (
+                attribution.ExemplarBatch(*([P()] * 7))
+                if self.sim.params.attribution_top_k > 0
+                else None
+            )
+            attr_spec = attribution.AttributionSummary(
+                *([P()] * 18), exemplars=ex_spec
+            )
+            mapped = _shard_map(
+                body,
+                mesh=self.mesh,
+                in_specs=tuple(P() for _ in range(9)),
+                out_specs=(self._summary_out_specs(), attr_spec),
+            )
+            mesh_sig = (
+                tuple(self.mesh.axis_names),
+                tuple(int(self.mesh.shape[a])
+                      for a in self.mesh.axis_names),
+                tuple(d.id for d in self.mesh.devices.flat),
+            )
+            self._fns[key] = executable_cache.get_or_build(
+                ("sharded-attr", self.sim.signature, mesh_sig)
+                + cache_key,
+                lambda: telemetry.time_first_call(
+                    jax.jit(mapped), "compile.jit_first_call"
+                ),
+            )
+        return self._fns[key]
+
+    def _get_local_attr_fn(self, plan: _RunPlan, tail: bool):
+        cache_key = (plan.block, plan.num_blocks, plan.kind,
+                     plan.conns_local, plan.trim, plan.sat_conns, tail)
+        full_key = ("sharded-attr-local", self.sim.signature,
+                    self.n_shards) + cache_key
+        return executable_cache.get_or_build(
+            full_key,
+            lambda: telemetry.time_first_call(
+                jax.jit(partial(self._local_scan_attr, *cache_key)),
+                "compile.jit_first_call",
+            ),
         )
 
     # -- single-device degradation rung --------------------------------

@@ -366,10 +366,64 @@ def _regression_table(rows: List[dict]) -> str:
     )
 
 
+def load_blame(results_dir) -> Dict[str, dict]:
+    """Per-run blame documents (``<label>.blame.json``) written by an
+    attributed sweep (``--attribution``); {} when none exist."""
+    out: Dict[str, dict] = {}
+    for p in sorted(pathlib.Path(results_dir).glob("*.blame.json")):
+        try:
+            out[p.name[: -len(".blame.json")]] = json.loads(
+                p.read_text()
+            )
+        except (OSError, json.JSONDecodeError):
+            continue
+    return out
+
+
+def _blame_table(blame: Dict[str, dict], top: int = 8) -> str:
+    """Per-run critical-path blame rows: top services with mean (and,
+    when the run was attributed in tail mode, p99-cut) blame shares."""
+    any_tail = any(d.get("tail_services") for d in blame.values())
+    head = (
+        "<th>run</th><th>service</th><th>mean share</th>"
+        "<th>wait (s)</th><th>self (s)</th><th>net (s)</th>"
+        "<th>timeout (s)</th>"
+    )
+    if any_tail:
+        head += "<th>tail share</th>"
+    body = []
+    for label, doc in blame.items():
+        tail_rows = {
+            r["service"]: r for r in doc.get("tail_services") or []
+        }
+        for i, r in enumerate(doc.get("services", [])[:top]):
+            tds = [
+                f"<td>{html.escape(label) if i == 0 else ''}</td>",
+                f"<td>{html.escape(r['service'])}</td>",
+                f"<td>{r['share'] * 100:.1f}%</td>",
+                f"<td>{r['wait_s']:.4f}</td>",
+                f"<td>{r['self_s']:.4f}</td>",
+                f"<td>{r['net_s']:.4f}</td>",
+                f"<td>{r['timeout_s']:.4f}</td>",
+            ]
+            if any_tail:
+                t = tail_rows.get(r["service"])
+                tds.append(
+                    f"<td>{t['share'] * 100:.1f}%</td>" if t
+                    else "<td>-</td>"
+                )
+            body.append(f"<tr>{''.join(tds)}</tr>")
+    return (
+        f"<table><thead><tr>{head}</tr></thead>"
+        f"<tbody>{''.join(body)}</tbody></table>"
+    )
+
+
 def build_report(
     rows: Sequence[dict],
     baseline_rows: Optional[Sequence[dict]] = None,
     title: str = "isotope-tpu benchmark report",
+    blame: Optional[Dict[str, dict]] = None,
 ) -> str:
     x_col, x_label = _pick_x(rows)
     discarded = sum(1 for r in rows if r.get("windowDiscarded"))
@@ -448,6 +502,15 @@ def build_report(
         else:
             doc.append("<p>No runs with matching labels.</p>")
 
+    if blame:
+        doc.append("<h2>Critical-path blame</h2>")
+        doc.append(
+            "<p>Per-service blame shares of the attributed runs "
+            "(metrics/attribution.py): which service's wait / self / "
+            "wire / timeout time the client latency decomposes into "
+            "along the critical path.</p>"
+        )
+        doc.append(_blame_table(blame))
     doc.append("<h2>All runs</h2>")
     doc.append(_results_table(rows))
     doc.append("</body></html>")
@@ -461,13 +524,15 @@ def write_report(
     title: Optional[str] = None,
 ) -> int:
     """Render ``results_dir``'s sweep into one HTML file; returns the
-    number of runs included."""
+    number of runs included.  Blame artifacts (``*.blame.json`` from an
+    attributed sweep) render as a critical-path blame table."""
     rows = load_results(results_dir)
     baseline = load_results(baseline_dir) if baseline_dir else None
     doc = build_report(
         rows,
         baseline,
         title or f"isotope-tpu report — {pathlib.Path(results_dir).name}",
+        blame=load_blame(results_dir),
     )
     pathlib.Path(out_path).write_text(doc)
     return len(rows)

@@ -139,12 +139,17 @@ class ExperimentConfig:
     # entrypoint override: pick one instance of a multi-entry topology
     # (replicate_topology); None = the graph's first entrypoint
     entry: Optional[str] = None
+    # critical-path blame attribution (metrics/attribution.py): arms
+    # SimParams.attribution so the runner's attributed pass can reduce
+    # per-service blame on device (--attribution[=tail])
+    attribution: bool = False
 
     def sim_params(self) -> SimParams:
         return SimParams(
             cpu_time_s=self.cpu_time_s,
             service_time=self.service_time,
             service_time_param=self.service_time_param,
+            attribution=self.attribution,
         )
 
     def load_models(self):

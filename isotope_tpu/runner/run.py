@@ -70,6 +70,13 @@ class RunResult:
     # unrecoverable failure: the case is recorded, the sweep continued
     failed: bool = False
     error: Optional[str] = None
+    # critical-path blame (metrics/attribution.py): the blame.json doc,
+    # the raw AttributionSummary, and the CompiledGraph its hop vectors
+    # are indexed by (exporters reuse it instead of recompiling); all
+    # None when the attribution pass was off or failed
+    blame: Optional[dict] = None
+    attribution: Optional[object] = None
+    compiled: Optional[object] = None
 
 
 def _failed_window(reason: str) -> WindowSummary:
@@ -192,6 +199,11 @@ def _vet_gate(mode: str, sim, topo, config, load, block, rungs,
     if report.blocking(strict=(mode == "strict"),
                        nonblocking_rules=nonblocking):
         raise VetError(report, mode == "strict", nonblocking)
+    est = report.meta.get("cost", {}).get("peak_bytes_at_block")
+    if est:
+        # published so the post-run measured/estimate ratio gauge can
+        # calibrate CAPACITY_FILL from real runs (ROADMAP follow-up)
+        telemetry.gauge_set("vet_peak_bytes_estimate", float(est))
     start = int(report.meta.get("start_rung", 0))
     if start:
         telemetry.counter_inc("vet_rung_preselections")
@@ -276,6 +288,47 @@ def _restore_result(rec: dict, out: pathlib.Path) -> RunResult:
     )
 
 
+def _attribution_pass(sim, sharded, use_sharded, topo, load, n, key,
+                      block, tail: bool):
+    """The post-ladder attributed pass for one case: identical request
+    streams to the main scan run (same executor, key, and blocking —
+    the sharded twin when the mesh served the case), reduced to blame
+    on device.  Blame covers EVERY simulated request; the collector's
+    trim window applies to the reported percentiles only (``trim`` is
+    passed for stream parity, it does not restrict the blame
+    accumulators).  Best-effort — a blame failure must never fail a
+    case whose metrics already landed."""
+    from isotope_tpu.metrics import attribution as attr_mod
+
+    runner = sharded if (use_sharded and sharded is not None) else sim
+    try:
+        with telemetry.phase("attribution.pass"):
+            _, attr = runner.run_attributed(
+                load, n, key, block_size=block, tail=tail, trim=True,
+            )
+            jax.block_until_ready(attr.count)
+        doc = attr_mod.to_doc(topo.compiled, attr)
+        telemetry.counter_inc("attribution_passes")
+        return doc, attr
+    except Exception as e:  # pragma: no cover - best-effort surface
+        telemetry.counter_inc("attribution_pass_failures")
+        print(f"warning: attribution pass failed: {e}",
+              file=sys.stderr)
+        return None, None
+
+
+def _record_vet_memory_ratio() -> None:
+    """Measured/estimated device-peak-bytes ratio gauge: pairs the
+    VET-M cost-model estimate with the run's real high-water so
+    ``CAPACITY_FILL`` can be calibrated from production telemetry."""
+    est = telemetry.gauge_get("vet_peak_bytes_estimate")
+    measured = telemetry.gauge_get("device_memory_peak_bytes_max")
+    if est and measured:
+        telemetry.gauge_set(
+            "vet_peak_bytes_measured_ratio", measured / est
+        )
+
+
 def run_experiment(
     config: ExperimentConfig,
     out_dir: Optional[str] = None,
@@ -285,6 +338,7 @@ def run_experiment(
     export: Sequence[str] = (),
     policy: Optional[ResiliencePolicy] = None,
     vet: Optional[str] = None,
+    attribution: Optional[str] = None,
 ) -> List[RunResult]:
     """``profile_dir`` captures a ``jax.profiler`` trace per executed run
     into ``<profile_dir>/<label>/`` — the analogue of the reference's
@@ -307,7 +361,13 @@ def run_experiment(
     fail the case (recorded like any deterministic failure); a memory
     verdict instead pre-selects the degradation-ladder rung the case
     STARTS on — when the ladder is armed, a predictable OOM is a rung
-    choice, not a crash.  With ``vet`` off, none of this code runs."""
+    choice, not a crash.  With ``vet`` off, none of this code runs.
+
+    ``attribution`` (``"on"`` / ``"tail"``; requires
+    ``config.attribution``) runs a critical-path blame pass per case
+    after its metrics land: the blame tables ride ``RunResult.blame``
+    and, with an output directory, ``<label>.blame.json`` +
+    ``<label>.flame.txt`` artifacts the ``report`` command renders."""
     from isotope_tpu.analysis.vet import vet_mode
 
     vet = vet_mode(vet)
@@ -499,6 +559,17 @@ def run_experiment(
                             ckpt_file.flush()
                         run_index += 1
                         continue
+                    blame_doc = attr_summary = None
+                    if attribution is not None:
+                        # identical executor/key/blocking to the main
+                        # run, so the attributed pass replays the same
+                        # request streams the reported metrics came
+                        # from
+                        blame_doc, attr_summary = _attribution_pass(
+                            sim, sharded, use_sharded, topo, load, n,
+                            run_key, block,
+                            tail=attribution == "tail",
+                        )
                     doc = fortio_result_from_summary(
                         summary, load, labels=label,
                         response_size_bytes=topo.entry_response_size,
@@ -530,6 +601,7 @@ def run_experiment(
                         # one scrape sees workload AND engine: append
                         # the isotope_engine_* series to the exposition
                         telemetry.record_device_memory()
+                        _record_vet_memory_ratio()
                         run_telem = telemetry.snapshot(label=label)
                         prom_text += run_telem.prometheus_text()
                     result = RunResult(
@@ -544,6 +616,13 @@ def run_experiment(
                             run_telem.to_dict() if run_telem else None
                         ),
                         degraded_to=degraded_to,
+                        blame=blame_doc,
+                        attribution=attr_summary,
+                        compiled=(
+                            topo.compiled
+                            if attr_summary is not None
+                            else None
+                        ),
                     )
                     results.append(result)
                     if out is not None:
@@ -552,6 +631,20 @@ def run_experiment(
                         with open(out / f"{label}.json", "w") as f:
                             json.dump(doc, f, indent=2)
                         (out / f"{label}.prom").write_text(prom_text)
+                        if blame_doc is not None:
+                            with open(
+                                out / f"{label}.blame.json", "w"
+                            ) as f:
+                                json.dump(blame_doc, f, indent=2)
+                        if attr_summary is not None:
+                            from isotope_tpu.metrics.export import (
+                                write_flamegraph,
+                            )
+
+                            write_flamegraph(
+                                out / f"{label}.flame.txt",
+                                topo.compiled, attr_summary,
+                            )
                         if run_telem is not None:
                             run_telem.append_jsonl(out / "telemetry.jsonl")
                         rec_out = {

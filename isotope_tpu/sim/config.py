@@ -128,6 +128,18 @@ class SimParams:
     # the fully unrolled trace.  Results are bit-identical either way.
     bucketed_scan: bool = True
     level_bucket_waste: float = 1.6
+    # Critical-path blame attribution (metrics/attribution.py): when
+    # True, ``Simulator.run_attributed`` accumulates per-hop blame
+    # vectors + per-service blame histograms inside the block scan (and
+    # the sharded psum merge).  Off (default) leaves every summary path
+    # byte-identical — pinned by tests/test_attribution.py.
+    attribution: bool = False
+    # top-K slowest requests whose per-hop vectors are mined on device
+    # (O(K * H)) and fed to the trace exporters as tail exemplars
+    attribution_top_k: int = 8
+    # the conditional-tail cut quantile estimated by the pilot pass in
+    # ``--attribution=tail`` mode (p99 by default)
+    attribution_tail_quantile: float = 0.99
 
     def __post_init__(self):
         if self.service_time not in (
@@ -154,6 +166,12 @@ class SimParams:
             raise ValueError("retry_copula_r must be in [0, 1)")
         if self.level_bucket_waste < 1.0:
             raise ValueError("level_bucket_waste must be >= 1")
+        if self.attribution_top_k < 0:
+            raise ValueError("attribution_top_k must be >= 0")
+        if not 0.0 < self.attribution_tail_quantile < 1.0:
+            raise ValueError(
+                "attribution_tail_quantile must lie in (0, 1)"
+            )
         # (sibling_copula_r + retry_copula_r < 1 is required only for
         # hops inside a multi-attempt call; the Simulator enforces it
         # when such calls exist)

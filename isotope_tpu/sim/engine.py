@@ -88,6 +88,11 @@ class SimResults(NamedTuple):
     utilization: jax.Array     # (S,) rho per service at the offered load
     unstable: jax.Array        # (S,) bool — offered load >= capacity
     offered_qps: jax.Array     # scalar f32 — the rate the queues saw
+    # queueing-wait component of hop_latency — the attribution layer's
+    # wait-vs-service split (metrics/attribution.py).  Trailing optional
+    # field: consumers that ignore it (summarize) leave the traced
+    # program untouched, XLA dead-code-eliminates the alias.
+    hop_wait: Optional[jax.Array] = None  # (N, H) f32
 
     @property
     def client_end(self) -> jax.Array:
@@ -220,6 +225,17 @@ class Simulator:
         self._mtls = mtls
         if mtls is not None:
             self._mtls_taxes = jnp.asarray(mtls.taxes_s, jnp.float32)
+        if params.attribution and mtls is not None:
+            # the phased mTLS tax is indexed by each request's NOMINAL
+            # arrival, which the assembled SimResults does not carry —
+            # the blame sweep could not reproduce the per-edge tax
+            # exactly, silently shifting wire blame into self blame
+            raise ValueError(
+                "SimParams.attribution does not support MtlsSchedule "
+                "runs yet (the per-request tax is not recoverable from "
+                "the assembled results)"
+            )
+        self._attr_tables = None  # built lazily on first attributed run
         t = compiled.services
         net = params.network
 
@@ -1541,6 +1557,122 @@ class Simulator:
                 self._windows_arg(offered, sat),
             )
 
+    def _attribution_tables(self):
+        """Blame-sweep index tables (metrics/attribution.py), built
+        lazily — a Simulator that never runs attributed pays nothing."""
+        if self._attr_tables is None:
+            from isotope_tpu.metrics import attribution
+
+            self._attr_tables = attribution.build_tables(
+                self.compiled, self.params.network
+            )
+        return self._attr_tables
+
+    def estimate_tail_cut(
+        self,
+        load: LoadModel,
+        num_requests: int,
+        key: jax.Array,
+        *,
+        block_size: int = 65_536,
+        quantile: Optional[float] = None,
+    ) -> float:
+        """Streaming-threshold tail cut: a small pilot run's latency
+        histogram recovers the requested quantile (p99 by default) so
+        the conditional-tail accumulators of an attributed run can be
+        filled in ONE pass instead of two full passes."""
+        from isotope_tpu.metrics.histogram import quantile_from_histogram
+
+        q = (
+            quantile
+            if quantile is not None
+            else self.params.attribution_tail_quantile
+        )
+        pilot_n = max(1, min(num_requests, 8_192))
+        pilot = self.run_summary(
+            load, pilot_n, jax.random.fold_in(key, 777_000),
+            block_size=min(block_size, pilot_n)
+            if load.kind == OPEN_LOOP
+            else block_size,
+        )
+        return float(
+            quantile_from_histogram(
+                np.asarray(pilot.latency_hist), [q]
+            )[0]
+        )
+
+    def run_attributed(
+        self,
+        load: LoadModel,
+        num_requests: int,
+        key: jax.Array,
+        *,
+        block_size: int = 65_536,
+        collector=None,
+        fixed_point_iters: int = 3,
+        trim: bool = False,
+        tail: bool = False,
+        tail_cut: Optional[float] = None,
+    ):
+        """Like :meth:`run_summary`, but the block scan ALSO reduces an
+        :class:`~isotope_tpu.metrics.attribution.AttributionSummary` —
+        per-hop critical-path blame, wait-vs-service split, blame
+        histograms, and top-K tail exemplars, all on device.
+
+        Identical keys/blocking to :meth:`run_summary`, so the returned
+        ``RunSummary`` matches an unattributed run of the same
+        arguments.  ``tail=True`` arms the conditional-tail
+        accumulators at ``tail_cut`` (estimated from a pilot histogram
+        when not given).  Returns ``(RunSummary, AttributionSummary)``.
+        """
+        if not self.params.attribution:
+            raise ValueError(
+                "attributed runs need SimParams(attribution=True)"
+            )
+        if tail and tail_cut is None:
+            tail_cut = self.estimate_tail_cut(
+                load, num_requests, key, block_size=block_size
+            )
+        if load.kind == OPEN_LOOP:
+            offered = float(load.qps)
+            pace = 0.0
+            nominal = 0.0
+            conns = 0
+            block = max(1, min(block_size, num_requests))
+        else:
+            conns = load.connections
+            offered = self.solve_closed_rate(load, num_requests, key,
+                                             fixed_point_iters)
+            pace = conns / load.qps if load.qps is not None else 0.0
+            nominal = conns / offered
+            per = max(1, min(block_size, num_requests) // conns)
+            block = per * conns
+        num_blocks = max(1, -(-num_requests // block))
+        if trim:
+            from isotope_tpu.metrics.fortio import trim_window_bounds
+
+            window = trim_window_bounds(num_blocks * block, offered)
+        else:
+            window = (0.0, np.inf)
+        sat = self._saturated(load)
+        fn = self._get_summary(
+            block, num_blocks, load.kind, conns, collector, trim,
+            sat=sat, attr="tail" if tail else "mean",
+        )
+        faults.check("engine.run")
+        telemetry.gauge_set("engine_block_requests", block)
+        telemetry.gauge_set("engine_num_blocks", num_blocks)
+        telemetry.counter_inc("attributed_runs")
+        with self._detail_ctx():
+            return fn(
+                key, jnp.float32(offered), jnp.float32(pace),
+                jnp.float32(offered), jnp.float32(nominal),
+                jnp.float32(window[0]), jnp.float32(window[1]),
+                jnp.float32(tail_cut if tail else np.inf),
+                self._vis_arg(offered),
+                self._windows_arg(offered, sat),
+            )
+
     def trace_entry_args(self, n: int, kind: str, connections: int = 0):
         """``(fn, abstract_args)`` for trace-only analysis.
 
@@ -1611,53 +1743,136 @@ class Simulator:
 
     def _get_summary(self, block: int, num_blocks: int, kind: str,
                      connections: int, collector, trim: bool = False,
-                     sat: bool = False):
-        """Jitted scan-over-blocks program producing a RunSummary."""
+                     sat: bool = False, attr: Optional[str] = None):
+        """Jitted scan-over-blocks program producing a RunSummary (and,
+        with ``attr`` set, an AttributionSummary alongside it).
+
+        ``attr=None`` keeps the historical scan program — the traced
+        signature and body are untouched, so attribution-off runs stay
+        byte-identical.  ``attr in ("mean", "tail")`` threads the blame
+        reduction through the same block scan: per-block blame vectors
+        stack and sum, the top-K exemplar state rides the carry, and
+        ``"tail"`` additionally weights a second accumulator set by
+        ``client_latency >= tail_cut`` (a traced scalar argument)."""
         from isotope_tpu.sim import summary as summary_mod
 
         cache_key = (block, num_blocks, kind, connections,
-                     collector is not None, trim, sat)
+                     collector is not None, trim, sat, attr)
         if cache_key not in self._summary_fns:
             c = max(connections, 1)
             per = block // c
+            if attr is not None:
+                from isotope_tpu.metrics import attribution
 
-            def scanfn(key, offered_qps, pace_gap, arrival_qps,
-                       nominal_gap, win_lo, win_hi, visits_pc,
-                       phase_windows):
-                telemetry.record_trace(
-                    ("summary", self.signature[3]) + cache_key,
-                    tracing=isinstance(key, jax.core.Tracer),
-                    requests=block, hops=self.compiled.num_hops,
-                )
+                tables = self._attribution_tables()
+                top_k = self.params.attribution_top_k
 
-                def body(carry, b):
-                    t0, conn_t0, req_off = carry
-                    # disjoint fold domain: the closed-loop rate solver's
-                    # pilots already consumed fold_in(key, 0..iters)
-                    kb = jax.random.fold_in(key, 1_000_000 + b)
-                    res, t_end, conn_end = self._simulate_core(
-                        block, kind, connections, kb, offered_qps,
-                        pace_gap, arrival_qps, nominal_gap, t0, conn_t0,
-                        req_off,
-                        sat_conns=connections if sat else 0,
-                        visits_pc=visits_pc,
-                        phase_windows=phase_windows,
+            if attr is None:
+                def scanfn(key, offered_qps, pace_gap, arrival_qps,
+                           nominal_gap, win_lo, win_hi, visits_pc,
+                           phase_windows):
+                    telemetry.record_trace(
+                        ("summary", self.signature[3]) + cache_key,
+                        tracing=isinstance(key, jax.core.Tracer),
+                        requests=block, hops=self.compiled.num_hops,
                     )
-                    s = summary_mod.summarize(
-                        res, collector,
-                        window=(win_lo, win_hi) if trim else None,
-                    )
-                    return (t_end, conn_end, req_off + per), s
 
-                carry0 = (
-                    jnp.float32(0.0),
-                    jnp.zeros((c,), jnp.float32),
-                    jnp.float32(0.0),
-                )
-                _, parts = jax.lax.scan(
-                    body, carry0, jnp.arange(num_blocks)
-                )
-                return summary_mod.reduce_stacked(parts)
+                    def body(carry, b):
+                        t0, conn_t0, req_off = carry
+                        kb = jax.random.fold_in(key, 1_000_000 + b)
+                        res, t_end, conn_end = self._simulate_core(
+                            block, kind, connections, kb, offered_qps,
+                            pace_gap, arrival_qps, nominal_gap, t0,
+                            conn_t0, req_off,
+                            sat_conns=connections if sat else 0,
+                            visits_pc=visits_pc,
+                            phase_windows=phase_windows,
+                        )
+                        s = summary_mod.summarize(
+                            res, collector,
+                            window=(win_lo, win_hi) if trim else None,
+                        )
+                        return (t_end, conn_end, req_off + per), s
+
+                    carry0 = (
+                        jnp.float32(0.0),
+                        jnp.zeros((c,), jnp.float32),
+                        jnp.float32(0.0),
+                    )
+                    _, parts = jax.lax.scan(
+                        body, carry0, jnp.arange(num_blocks)
+                    )
+                    return summary_mod.reduce_stacked(parts)
+            else:
+                def scanfn(key, offered_qps, pace_gap, arrival_qps,
+                           nominal_gap, win_lo, win_hi, tail_cut,
+                           visits_pc, phase_windows):
+                    telemetry.record_trace(
+                        ("summary", self.signature[3]) + cache_key,
+                        tracing=isinstance(key, jax.core.Tracer),
+                        requests=block, hops=self.compiled.num_hops,
+                    )
+
+                    def body(carry, b):
+                        (t0, conn_t0, req_off), ex = carry
+                        kb = jax.random.fold_in(key, 1_000_000 + b)
+                        res, t_end, conn_end = self._simulate_core(
+                            block, kind, connections, kb, offered_qps,
+                            pace_gap, arrival_qps, nominal_gap, t0,
+                            conn_t0, req_off,
+                            sat_conns=connections if sat else 0,
+                            visits_pc=visits_pc,
+                            phase_windows=phase_windows,
+                        )
+                        s = summary_mod.summarize(
+                            res, collector,
+                            window=(win_lo, win_hi) if trim else None,
+                        )
+                        a, ex = attribution.attribute_block(
+                            res, tables,
+                            tail_cut=(
+                                tail_cut if attr == "tail" else None
+                            ),
+                            top_k=top_k, ex_state=ex,
+                        )
+                        carry_out = (
+                            (t_end, conn_end, req_off + per), ex
+                        )
+                        return carry_out, (s, a)
+
+                    # the exemplar carry needs concrete leaves before
+                    # the scan starts: seed it from a zero-latency
+                    # dummy block shaped like the real ones
+                    k0 = min(top_k, block) if top_k > 0 else 0
+                    H = self.compiled.num_hops
+                    ex0 = (
+                        attribution.ExemplarBatch(
+                            latency=jnp.full((k0,), -jnp.inf),
+                            start=jnp.zeros((k0,)),
+                            error=jnp.zeros((k0,), bool),
+                            hop_sent=jnp.zeros((k0, H), bool),
+                            hop_error=jnp.zeros((k0, H), bool),
+                            hop_latency=jnp.zeros((k0, H)),
+                            hop_start=jnp.zeros((k0, H)),
+                        )
+                        if k0 > 0
+                        else None
+                    )
+                    carry0 = (
+                        (
+                            jnp.float32(0.0),
+                            jnp.zeros((c,), jnp.float32),
+                            jnp.float32(0.0),
+                        ),
+                        ex0,
+                    )
+                    (_, ex_final), (parts, aparts) = jax.lax.scan(
+                        body, carry0, jnp.arange(num_blocks)
+                    )
+                    return (
+                        summary_mod.reduce_stacked(parts),
+                        attribution.reduce_stacked(aparts, ex_final),
+                    )
 
             self._summary_fns[cache_key] = executable_cache.get_or_build(
                 ("summary", self.signature) + cache_key,
@@ -2639,6 +2854,10 @@ class Simulator:
             utilization=util_phase.max(axis=0),
             unstable=unstable_phase.any(axis=0),
             offered_qps=offered_qps,
+            # only materialized for attributed simulators: the dense
+            # run() path would otherwise pay a fifth (N, H) output
+            # buffer nothing reads
+            hop_wait=wait if self.params.attribution else None,
         )
         t_end = conn_end.max() if kind == CLOSED_LOOP else arrivals[-1]
         return res, t_end, conn_end

@@ -225,3 +225,60 @@ def test_degraded_both_rounds_passes(tmp_path, monkeypatch, capsys):
     })
     assert run_gate(tmp_path, monkeypatch, new, base) == 0
     assert "OK" in capsys.readouterr().out
+
+
+def test_blame_gate_off_by_default(tmp_path, monkeypatch):
+    base = capture(2.0e9, {
+        "svc1000": 2.0e9, "svc1000_best": 2.1e9,
+        "svc1000_blame": {"services": {"a": 0.8, "b": 0.2}},
+    })
+    new = capture(2.0e9, {
+        "svc1000": 2.0e9, "svc1000_best": 2.1e9,
+        "svc1000_blame": {"services": {"a": 0.2, "b": 0.8}},
+    })
+    monkeypatch.delenv("BENCH_REGRESS_BLAME_THRESHOLD", raising=False)
+    assert run_gate(tmp_path, monkeypatch, new, base) == 0
+
+
+def test_blame_gate_fails_on_share_drift(tmp_path, monkeypatch, capsys):
+    base = capture(2.0e9, {
+        "svc1000": 2.0e9, "svc1000_best": 2.1e9,
+        "svc1000_blame": {"services": {"a": 0.8, "b": 0.2}},
+    })
+    bad = capture(2.0e9, {
+        "svc1000": 2.0e9, "svc1000_best": 2.1e9,
+        "svc1000_blame": {"services": {"a": 0.55, "b": 0.45}},
+    })
+    monkeypatch.setenv("BENCH_REGRESS_BLAME_THRESHOLD", "0.1")
+    assert run_gate(tmp_path, monkeypatch, bad, base) == 1
+    out = capsys.readouterr().out
+    assert "svc1000.blame" in out and "REGRESSION" in out
+
+
+def test_blame_gate_within_threshold_and_new_service(tmp_path,
+                                                     monkeypatch, capsys):
+    # a service present on only one side compares against a 0.0 share
+    base = capture(2.0e9, {
+        "svc1000": 2.0e9, "svc1000_best": 2.1e9,
+        "svc1000_blame": {"services": {"a": 0.85, "b": 0.15}},
+    })
+    ok = capture(2.0e9, {
+        "svc1000": 2.0e9, "svc1000_best": 2.1e9,
+        "svc1000_blame": {"services": {"a": 0.82, "b": 0.13,
+                                       "c": 0.05}},
+    })
+    monkeypatch.setenv("BENCH_REGRESS_BLAME_THRESHOLD", "0.1")
+    assert run_gate(tmp_path, monkeypatch, ok, base) == 0
+    assert "OK" in capsys.readouterr().out
+
+
+def test_blame_gate_skips_pre_attribution_baseline(tmp_path,
+                                                   monkeypatch):
+    # the baseline predates blame blocks: nothing comparable, no gate
+    base = capture(2.0e9, {"svc1000": 2.0e9, "svc1000_best": 2.1e9})
+    new = capture(2.0e9, {
+        "svc1000": 2.0e9, "svc1000_best": 2.1e9,
+        "svc1000_blame": {"services": {"a": 1.0}},
+    })
+    monkeypatch.setenv("BENCH_REGRESS_BLAME_THRESHOLD", "0.01")
+    assert run_gate(tmp_path, monkeypatch, new, base) == 0

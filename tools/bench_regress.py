@@ -31,6 +31,11 @@ threshold (unset = not gated), compared per case over the
   pass (``vet_errors`` in the telemetry block — bench runs the
   no-trace vet per case) reports MORE errors than the previous
   capture's; captures without vet data on either side are skipped.
+- ``BENCH_REGRESS_BLAME_THRESHOLD``: ABSOLUTE per-service drift
+  allowed on the critical-path blame shares (``<case>_blame`` blocks
+  from bench's attributed probe), e.g. ``0.1`` = 10 share points; a
+  case's throughput can hold while its critical path migrates, which
+  only this gate sees.
 
 Always armed (no env var): a case whose telemetry block carries
 ``degraded_to`` — the resilience supervisor served it from a
@@ -205,6 +210,55 @@ def vet_failures(prev_doc: dict, new_doc: dict) -> list:
     return failures
 
 
+def blame_failures(prev_doc: dict, new_doc: dict) -> list:
+    """Opt-in gate (``BENCH_REGRESS_BLAME_THRESHOLD=<abs drift>``): a
+    case whose per-service critical-path blame SHARE moved by more
+    than the threshold (absolute, shares are in [0, 1]) vs the
+    previous capture regressed.
+
+    Blame shares localize *where* latency comes from — a case can hold
+    its throughput while its critical path silently migrates (e.g. a
+    queueing change moving p99 blame from a leaf to the entry), which
+    the rate gates cannot see.  Both captures must carry the case's
+    ``<case>_blame`` block (bench embeds it via a small attributed
+    run); baselines from before attribution existed are skipped.
+    """
+    raw = os.environ.get("BENCH_REGRESS_BLAME_THRESHOLD")
+    if raw is None or raw == "":
+        return []
+    thr = float(raw)
+    prev_extra = prev_doc.get("extra", {})
+    new_extra = new_doc.get("extra", {})
+    failures = []
+    for k, blk in sorted(new_extra.items()):
+        if not k.endswith("_blame") or not isinstance(blk, dict):
+            continue
+        prev_blk = prev_extra.get(k)
+        if not isinstance(prev_blk, dict):
+            continue  # baseline never carried blame: nothing comparable
+        case = k[: -len("_blame")]
+        new_sv = blk.get("services") or {}
+        old_sv = prev_blk.get("services") or {}
+        worst_svc, worst = None, 0.0
+        for svc in set(new_sv) | set(old_sv):
+            drift = abs(
+                float(new_sv.get(svc, 0.0)) - float(old_sv.get(svc, 0.0))
+            )
+            if drift > worst:
+                worst_svc, worst = svc, drift
+        bad = worst > thr
+        verdict = "REGRESSION" if bad else "OK"
+        print(
+            f"bench_regress: {case}.blame: max share drift "
+            f"{worst:+.4f}"
+            + (f" ({worst_svc})" if worst_svc else "")
+            + f" {verdict}"
+        )
+        if bad:
+            failures.append(f"{case}.blame")
+    return failures
+
+
 def degradation_failures(prev_doc: dict, new_doc: dict) -> list:
     """Always-armed gate: a case that DEGRADED in the new capture but
     ran clean in the previous round is a regression.
@@ -301,6 +355,7 @@ def main() -> int:
     failures.extend(telemetry_failures(prev_doc, new_doc))
     failures.extend(degradation_failures(prev_doc, new_doc))
     failures.extend(vet_failures(prev_doc, new_doc))
+    failures.extend(blame_failures(prev_doc, new_doc))
     if failures:
         print(f"bench_regress: FAIL vs {prev_path}: "
               f"{', '.join(failures)} regressed >"
