@@ -7,8 +7,9 @@ QPS ?= 1000
 DURATION ?= 120s
 
 .PHONY: test lint vet-smoke bench telemetry-smoke resilience-smoke \
-	attribution-smoke examples canonical tree star multitier \
-	auxiliary-services star-auxiliary latency cpu_mem dot clean
+	attribution-smoke sparse-smoke examples canonical tree star \
+	multitier auxiliary-services star-auxiliary latency cpu_mem dot \
+	clean
 
 test:
 	$(PY) -m pytest tests/ -x -q
@@ -116,6 +117,13 @@ attribution-smoke:
 		assert {'tail_rank', 'tail_cut_s'} <= tags, tags; \
 		print('attribution-smoke: blame sums to 1, flamegraph parses,', \
 		      len(ex['data']), 'exemplar trace(s) validate')"
+
+# sparse-executor end-to-end check: force the non-dense encodings
+# (sparse_level_elems lowered) on a small star graph, run the dense /
+# tiled / sparse / tiled+pallas executors, and diff their summaries —
+# counts must be equal, latency sums within f32 reduction noise.
+sparse-smoke:
+	$(PY) tools/sparse_smoke.py
 
 examples:
 	$(PY) tools/gen_examples.py

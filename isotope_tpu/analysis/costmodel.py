@@ -210,20 +210,43 @@ def segment_table(sim, block_requests: int) -> List[dict]:
             })
         elif isinstance(seg, buckets.UnrolledLevelPlan):
             lvl = sim._levels[seg.d]
+            if lvl.tiled is not None:
+                # dense-blocked tiles + sparse residual: the step
+                # footprint is the tiles' padded grids plus residual
+                # slots — the whole point of the encoding
+                kind = "tiled"
+                step_elems = lvl.tiled.elems
+            elif lvl.sparse is not None:
+                kind = "sparse"
+                step_elems = lvl.sparse.n_slots
+            elif lvl.leaf_busy is not None:
+                kind = "leaf"
+                step_elems = lvl.size
+            else:
+                kind = "unrolled"
+                step_elems = lvl.size * lvl.pmax
             elems = n * (
-                lvl.size * (lvl.pmax + 3)
+                step_elems + 3 * lvl.size
                 + 2 * lvl.num_calls * lvl.max_attempts
             )
             rows.append({
                 "segment": i,
-                "kind": "sparse" if lvl.sparse is not None else (
-                    "leaf" if lvl.leaf_busy is not None else "unrolled"
-                ),
+                "kind": kind,
                 "levels": 1,
                 "elems": elems,
                 "bytes_f32": 4.0 * elems,
             })
     return rows
+
+
+def schedule_rows(sim) -> List[dict]:
+    """The engine's chosen bucket schedule, ranked by each segment's
+    critical-path cost (compiler/buckets.schedule_table over the plan
+    the Simulator actually lowered) — the ``bucket_schedule`` block of
+    ``vet --json``."""
+    from isotope_tpu.compiler import buckets
+
+    return buckets.schedule_table(sim._plan_shapes, sim._plan)
 
 
 def device_capacity_bytes(override: Optional[float] = None

@@ -48,6 +48,9 @@ RULES: Dict[str, str] = {
                 "(it never fires)",
     "VET-C005": "open-loop qps meets or exceeds the static capacity "
                 "(unstable queues)",
+    "VET-C006": "level falls back to the residual sparse call-slot "
+                "path (script wider than the tile cap) — un-tiled "
+                "slots run the serial gather/cumsum sweep",
     # -- jaxpr auditor ------------------------------------------------------
     "VET-J001": "host callback / device-to-host sync primitive in the "
                 "hot path",

@@ -15,6 +15,7 @@ from typing import Dict, List, Optional, Tuple
 
 from isotope_tpu.analysis.findings import (
     SEV_ERROR,
+    SEV_INFO,
     SEV_WARN,
     Finding,
 )
@@ -209,27 +210,84 @@ def lint_compiled(compiled, params=None) -> List[Finding]:
         ))
 
     # VET-T008: plan the buckets exactly as the engine will and check
-    # the realized padding against the configured budget
+    # the realized padding against the configured budget.  The step
+    # encoding decision (dense / tiled / sparse) is the engine's own
+    # (compiler/buckets.level_encoding), so VET-C006 reports the
+    # executor's real fallbacks, not a reimplementation's.
     shapes = []
     offset = 0
-    for lvl in compiled.levels:
+    for d, lvl in enumerate(compiled.levels):
         pmax = max(int(lvl.step_is_real.sum(1).max(initial=0)), 1)
-        slots = lvl.num_hops * pmax
         import numpy as np
 
         sparse = False
+        tiles = None
+        residual_slots = 0
         if lvl.num_calls:
             n_slots = len(np.unique(lvl.call_seg))
-            sparse = slots > max(4 * n_slots, params.sparse_level_elems)
+            widths = lvl.step_is_real[:, :pmax].sum(1)
+            enc, tile_plan = buckets.level_encoding(
+                lvl.num_hops, pmax, n_slots, widths,
+                sparse_level_elems=params.sparse_level_elems,
+                tiling=params.sparse_tiling,
+                tile_pmax=params.sparse_tile_pmax,
+            )
+            sparse = enc != "dense"
+            if enc == "tiled":
+                tiles = tile_plan.shapes()
+                res_widths = widths[tile_plan.residual]
+                # EXACT residual slot count (call-bearing steps of the
+                # residual hops) — the engine's tiled.residual.n_slots,
+                # not the script-width approximation, so the vet
+                # surface agrees with costmodel.schedule_rows(sim)
+                call_parent = lvl.call_seg // compiled.max_steps
+                res_mask = np.isin(call_parent, tile_plan.residual)
+                residual_slots = len(np.unique(lvl.call_seg[res_mask]))
+                if len(tile_plan.residual):
+                    grid = lvl.num_hops * pmax
+                    # pure padding of the avoided dense grid: slots the
+                    # grid holds beyond EVERY hop's real steps (tiled
+                    # hops' real work is not padding)
+                    pad = grid - int(widths.sum())
+                    findings.append(Finding(
+                        "VET-C006", SEV_INFO,
+                        f"{len(tile_plan.residual)} of {lvl.num_hops} "
+                        f"hop(s) at depth {d} exceed the "
+                        f"sparse_tile_pmax={params.sparse_tile_pmax} "
+                        f"tile cap (widest script "
+                        f"{int(res_widths.max(initial=0))} steps) and "
+                        "stay on the residual sparse path "
+                        f"({residual_slots} slot(s)); the dense grid "
+                        f"they avoid is {grid} element-slots "
+                        f"({pad} pure padding, "
+                        f"{pad / max(grid, 1):.1%} waste)",
+                        path=f"levels[{d}]",
+                    ))
+            elif enc == "sparse":
+                grid = lvl.num_hops * pmax
+                residual_slots = n_slots
+                pad = grid - int(widths.sum())
+                findings.append(Finding(
+                    "VET-C006", SEV_INFO,
+                    f"level at depth {d} ({lvl.num_hops} hop(s), "
+                    f"widest script {pmax} steps) does not tile — the "
+                    f"whole level runs the sparse call-slot path over "
+                    f"{n_slots} slot(s); its dense grid would be "
+                    f"{grid} element-slots "
+                    f"({pad / max(grid, 1):.1%} pure padding)",
+                    path=f"levels[{d}]",
+                ))
         shapes.append(buckets.LevelShape(
             size=lvl.num_hops, pmax=pmax, children=lvl.num_children,
             calls=lvl.num_calls, attempts=lvl.max_attempts,
-            sparse=sparse, offset=offset,
+            sparse=sparse, offset=offset, tiles=tiles,
+            residual_slots=residual_slots,
         ))
         offset += lvl.num_hops
     plan = buckets.plan_segments(
         shapes, waste=params.level_bucket_waste,
         enabled=params.bucketed_scan,
+        schedule=params.bucket_schedule,
     )
     stats = buckets.plan_stats(shapes, plan)
     waste_budget = params.level_bucket_waste - 1.0

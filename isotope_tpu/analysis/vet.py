@@ -140,6 +140,9 @@ def vet_simulator(
             "capacity_bytes": est.capacity_bytes,
             "num_segments": len(est.segments),
         }
+        # the engine's chosen bucket schedule, ranked by per-segment
+        # critical-path cost (``vet --json`` surfaces it verbatim)
+        report.meta["bucket_schedule"] = costmodel.schedule_rows(sim)
         # a suppressed memory finding must also suppress the verdict
         report.meta["start_rung"] = (
             start_rung if mem_findings and any(

@@ -22,7 +22,9 @@ level) naturally stay unrolled.
 from __future__ import annotations
 
 import dataclasses
-from typing import List, Sequence, Tuple, Union
+from typing import List, Optional, Sequence, Tuple, Union
+
+import numpy as np
 
 from isotope_tpu import telemetry
 
@@ -31,6 +33,27 @@ DEFAULT_WASTE = 1.6
 
 #: a bucket shorter than this runs unrolled (no padding, no scan overhead)
 MIN_SCAN_LEVELS = 2
+
+#: per-segment dispatch/trace overhead in element units for the
+#: critical-path schedule (see plan_segments).  The executor's segments
+#: run strictly sequentially (level d+1 feeds level d), so the schedule's
+#: critical path is the SUM over segments of (dispatch overhead +
+#: element work); the overhead constant is set high enough that merging
+#: consecutive levels is preferred whenever the waste budget allows it —
+#: the cost model's "one dispatch saved beats moderate padding" regime
+#: (analysis/costmodel.py consumes the same cost via segment_cp_cost).
+#: Calibrating it against a real-TPU capture is a ROADMAP follow-up.
+SEGMENT_OVERHEAD_ELEMS = 1 << 24
+
+#: default bound on a dense tile's step width (plan_tiles): hops whose
+#: script is wider stay on the residual sparse encoding.  32 * 8-row
+#: fan-out bins keep tiles VPU-shaped; retune on a real capture.
+DEFAULT_TILE_PMAX = 64
+
+#: critical-path DP lookback cap: buckets longer than this are not
+#: considered (keeps planning O(levels * cap); a >64-level scan body
+#: already amortizes its dispatch overhead to nothing)
+MAX_BUCKET_LEVELS = 64
 
 
 @dataclasses.dataclass(frozen=True)
@@ -42,8 +65,13 @@ class LevelShape:
     children: int   # hops at the next level spawned here
     calls: int      # call sites (retry fans share one site)
     attempts: int   # max retry attempts of any call
-    sparse: bool    # the engine would use the sparse call-slot encoding
+    sparse: bool    # the engine would use a non-dense (sparse/tiled)
     offset: int     # start of the level's slice in BFS hop order
+    # dense-blocked tiling of a sparse level: ((size, width), ...) per
+    # tile plus the residual sparse slot count — reporting/cost only
+    # (tiled levels execute as one unrolled segment)
+    tiles: Optional[Tuple[Tuple[int, int], ...]] = None
+    residual_slots: int = 0
 
     @property
     def leaf(self) -> bool:
@@ -111,18 +139,155 @@ def _bounds(levels: Sequence[LevelShape], child_size: int
     )
 
 
+def segment_cp_cost(shapes: Sequence[LevelShape], seg: Segment) -> int:
+    """Critical-path cost (element units) of one schedule segment.
+
+    Segments execute strictly sequentially — level d+1's outputs feed
+    level d's sweep — so the schedule's critical path is the SUM of
+    per-segment costs: a fixed dispatch/trace overhead plus the padded
+    element work the segment touches.  This is the cost function BOTH
+    the planner's critical-path schedule (plan_segments) and the vet
+    cost model's schedule report (analysis/costmodel.py) use.
+    """
+    if isinstance(seg, ScanBucketPlan):
+        members = shapes[seg.d0:seg.d1 + 1]
+        bounds = (seg.bound_hops, seg.bound_steps, seg.bound_calls,
+                  seg.bound_attempts)
+        return SEGMENT_OVERHEAD_ELEMS + _bucket_cost(members, bounds)
+    s = shapes[seg.d]
+    if s.tiles is not None:
+        elems = sum(t_size * t_w for t_size, t_w in s.tiles)
+        elems += s.residual_slots + 3 * s.children + 2 * s.calls * s.attempts
+        return SEGMENT_OVERHEAD_ELEMS + elems
+    return SEGMENT_OVERHEAD_ELEMS + _real_cost([s])
+
+
+def plan_cp_cost(shapes: Sequence[LevelShape],
+                 segs: Sequence[Segment]) -> int:
+    """Total critical-path cost of one plan (element units)."""
+    return sum(segment_cp_cost(shapes, s) for s in segs)
+
+
+def _partition_run(
+    shapes: Sequence[LevelShape],
+    i: int,
+    j: int,
+    waste: float,
+    schedule: str,
+) -> List[Segment]:
+    """Partition one maximal scan-eligible run ``[i..j]`` into segments.
+
+    ``critical-path`` solves the optimal partition by DP over the run,
+    minimizing the summed per-segment critical-path cost
+    (:func:`segment_cp_cost`); the waste budget stays a HARD constraint
+    on every bucket, so the knob keeps its meaning.  ``greedy`` is the
+    historical left-to-right maximal extension (kept for comparison /
+    fallback).
+    """
+    n = len(shapes)
+
+    def bucket_of(a: int, b: int) -> Optional[ScanBucketPlan]:
+        run = shapes[a:b + 1]
+        child_size = shapes[b + 1].size if b + 1 < n else 0
+        bounds = _bounds(run, child_size)
+        if _bucket_cost(run, bounds) > waste * _real_cost(run):
+            return None
+        bb, p, k, a_ = bounds
+        return ScanBucketPlan(a, b, bb, p, k, a_)
+
+    if schedule == "greedy":
+        segs: List[Segment] = []
+        a = i
+        while a <= j:
+            b = a
+            while b + 1 <= j and bucket_of(a, b + 1) is not None:
+                b += 1
+            if b - a + 1 >= MIN_SCAN_LEVELS:
+                segs.append(bucket_of(a, b))
+                a = b + 1
+            else:
+                segs.append(UnrolledLevelPlan(a))
+                a += 1
+        return segs
+
+    # critical-path DP: best[e] = (cost, segments) covering run[i..e].
+    # Bucket bounds are maintained INCREMENTALLY while the candidate
+    # start walks left (they are running maxima), so each (a, e) pair
+    # costs O(1); the lookback is capped at MAX_BUCKET_LEVELS.
+    INF = float("inf")
+    best_cost = [INF] * (j - i + 2)
+    best_prev: List[Optional[Tuple[int, Segment]]] = [None] * (j - i + 2)
+    best_cost[0] = 0.0
+    for e in range(i, j + 1):
+        idx = e - i + 1
+        # unrolled single level
+        seg: Segment = UnrolledLevelPlan(e)
+        c = best_cost[idx - 1] + segment_cp_cost(shapes, seg)
+        if c < best_cost[idx]:
+            best_cost[idx] = c
+            best_prev[idx] = (idx - 1, seg)
+        # buckets ending at e (length >= MIN_SCAN_LEVELS)
+        child_size = shapes[e + 1].size if e + 1 < n else 0
+        bb, bp, bk, ba = child_size, 1, 0, 1
+        real = 0
+        for a in range(e, max(i, e - MAX_BUCKET_LEVELS + 1) - 1, -1):
+            s = shapes[a]
+            bb = max(bb, s.size)
+            bp = max(bp, s.pmax)
+            bk = max(bk, s.calls)
+            ba = max(ba, s.attempts)
+            real += (
+                s.size * s.pmax + 3 * s.children
+                + 2 * s.calls * s.attempts
+            )
+            length = e - a + 1
+            if length < MIN_SCAN_LEVELS:
+                continue
+            padded = length * (bb * bp + 3 * bb + 2 * bk * ba)
+            if padded > waste * real:
+                # infeasible at THIS span; wider spans can re-enter
+                # feasibility (bounds are maxima), so keep walking
+                continue
+            c = best_cost[a - i] + SEGMENT_OVERHEAD_ELEMS + padded
+            if c < best_cost[idx]:
+                best_cost[idx] = c
+                best_prev[idx] = (
+                    a - i, ScanBucketPlan(a, e, bb, bp, bk, ba)
+                )
+    # walk back
+    out: List[Segment] = []
+    idx = j - i + 1
+    while idx > 0:
+        prev, seg = best_prev[idx]
+        out.append(seg)
+        idx = prev
+    out.reverse()
+    return out
+
+
 def plan_segments(
     shapes: Sequence[LevelShape],
     waste: float = DEFAULT_WASTE,
     enabled: bool = True,
+    schedule: str = "critical-path",
 ) -> List[Segment]:
     """Partition the depth levels into scan buckets and unrolled islands.
 
-    Greedy left-to-right: starting at each eligible level, the run is
-    extended while the padded cost (every member at the running bounds,
-    including the carry-width contribution of the run's deepest child
-    level) stays within ``waste`` x the real cost.  Runs shorter than
-    ``MIN_SCAN_LEVELS`` fall back to unrolled segments.
+    Levels are first split at the ineligible islands (leaves, sparse /
+    tiled levels); each maximal eligible run is then partitioned by the
+    selected ``schedule``:
+
+    - ``"critical-path"`` (default): optimal DP over the run minimizing
+      the summed per-segment critical-path cost
+      (:func:`segment_cp_cost` — dispatch overhead + padded elements),
+      the ordering/merging discipline of the static-schedule literature
+      applied to the depth axis.  The ``waste`` budget stays a hard
+      per-bucket constraint.
+    - ``"greedy"``: the historical left-to-right maximal extension.
+
+    Runs shorter than ``MIN_SCAN_LEVELS`` fall back to unrolled
+    segments either way, and results are bit-identical across plans
+    (the executor contract — only wall-clock changes).
     """
     segs: List[Segment] = []
     n = len(shapes)
@@ -134,29 +299,11 @@ def plan_segments(
             segs.append(UnrolledLevelPlan(i))
             i += 1
             continue
-        # try to grow a run [i..j]
         j = i
-        run = [s]
-        while j + 1 < n:
-            nxt = shapes[j + 1]
-            if nxt.leaf or nxt.sparse:
-                break
-            cand = run + [nxt]
-            # carry width must cover the candidate run's child level too
-            child_size = shapes[j + 2].size if j + 2 < n else 0
-            bounds = _bounds(cand, child_size)
-            if _bucket_cost(cand, bounds) > waste * _real_cost(cand):
-                break
-            run = cand
+        while j + 1 < n and not (shapes[j + 1].leaf or shapes[j + 1].sparse):
             j += 1
-        if len(run) >= MIN_SCAN_LEVELS:
-            child_size = shapes[j + 1].size if j + 1 < n else 0
-            b, p, k, a = _bounds(run, child_size)
-            segs.append(ScanBucketPlan(i, j, b, p, k, a))
-            i = j + 1
-        else:
-            segs.append(UnrolledLevelPlan(i))
-            i += 1
+        segs.extend(_partition_run(shapes, i, j, waste, schedule))
+        i = j + 1
     _record_plan(shapes, segs)
     return segs
 
@@ -164,6 +311,145 @@ def plan_segments(
 def plan_signature(segs: Sequence[Segment]) -> tuple:
     """Hashable shape signature of a plan — part of the AOT cache key."""
     return tuple(s.signature() for s in segs)
+
+
+def schedule_table(shapes: Sequence[LevelShape],
+                   segs: Sequence[Segment]) -> List[dict]:
+    """The chosen schedule as cost-ranked rows (vet ``--json`` surface).
+
+    One row per executor segment with its critical-path cost
+    (:func:`segment_cp_cost`) and share of the plan's total; rows are
+    ordered by DESCENDING cost — the segments that own the critical
+    path come first — while ``position`` records the execution order.
+    """
+    total = max(plan_cp_cost(shapes, segs), 1)
+    rows = []
+    for pos, seg in enumerate(segs):
+        if isinstance(seg, ScanBucketPlan):
+            kind = "scan"
+            d0, d1 = seg.d0, seg.d1
+        else:
+            s = shapes[seg.d]
+            if s.tiles is not None:
+                kind = "tiled"
+            elif s.sparse:
+                kind = "sparse"
+            elif s.leaf:
+                kind = "leaf"
+            else:
+                kind = "unrolled"
+            d0 = d1 = seg.d
+        cost = segment_cp_cost(shapes, seg)
+        rows.append({
+            "position": pos,
+            "kind": kind,
+            "d0": d0,
+            "d1": d1,
+            "cp_cost_elems": int(cost),
+            "cp_share": cost / total,
+        })
+    rows.sort(key=lambda r: (-r["cp_cost_elems"], r["position"]))
+    return rows
+
+
+# ---------------------------------------------------------------------------
+# dense-blocked tiling of sparse levels
+
+
+@dataclasses.dataclass(frozen=True)
+class TilePlan:
+    """Dense-blocked partition of one skewed level's hops.
+
+    ``tiles`` holds (width, hop-index-array) bins — each becomes a
+    dense (size x width) sub-grid padded to the bin's widest script —
+    and ``residual`` the hop indices that stay on the true sparse
+    call-slot encoding (scripts wider than the tile cap).
+    """
+
+    tiles: Tuple[Tuple[int, np.ndarray], ...]
+    residual: np.ndarray
+
+    @property
+    def tiled_elems(self) -> int:
+        return int(sum(w * len(idx) for w, idx in self.tiles))
+
+    def shapes(self) -> Tuple[Tuple[int, int], ...]:
+        return tuple((len(idx), w) for w, idx in self.tiles)
+
+
+def plan_tiles(
+    widths: np.ndarray,
+    cap: int = DEFAULT_TILE_PMAX,
+    waste: float = DEFAULT_WASTE,
+) -> TilePlan:
+    """Bin one level's hops into fixed-width dense tiles.
+
+    ``widths`` is the per-hop real script width (number of occupied
+    step columns).  Hops wider than ``cap`` go to the residual sparse
+    encoding.  The rest are sorted by width and greedily grouped into
+    tiles: a bin grows while padding every member to the running widest
+    script stays within ``waste`` x the real element count — the same
+    budget discipline the level-bucket planner applies on the depth
+    axis, here applied within one level's fan-out classes.
+    """
+    widths = np.asarray(widths, np.int64)
+    idx = np.arange(len(widths))
+    residual = idx[widths > cap]
+    tileable = idx[widths <= cap]
+    order = tileable[np.argsort(widths[tileable], kind="stable")]
+    tiles: List[Tuple[int, np.ndarray]] = []
+    start = 0
+    while start < len(order):
+        end = start + 1
+        real = max(int(widths[order[start]]), 1)
+        wmax = max(int(widths[order[start]]), 1)
+        while end < len(order):
+            w = max(int(widths[order[end]]), 1)
+            cand_w = max(wmax, w)
+            cand_real = real + w
+            if cand_w * (end - start + 1) > waste * cand_real:
+                break
+            wmax, real = cand_w, cand_real
+            end += 1
+        tiles.append((wmax, np.sort(order[start:end])))
+        start = end
+    return TilePlan(tiles=tuple(tiles), residual=np.sort(residual))
+
+
+def level_encoding(
+    size: int,
+    pmax: int,
+    n_slots: int,
+    widths: np.ndarray,
+    *,
+    sparse_level_elems: int,
+    tiling: bool = True,
+    tile_pmax: int = DEFAULT_TILE_PMAX,
+    waste: float = DEFAULT_WASTE,
+) -> Tuple[str, Optional[TilePlan]]:
+    """Decide one call-bearing level's step encoding.
+
+    Returns ``("dense" | "tiled" | "sparse", tile_plan)`` — the single
+    decision point shared by the engine's lowering and the vet linter,
+    so the static analysis always reports the executor's real choice.
+    A level leaves the dense grid when the grid is > 4x its real call
+    slots (or past ``sparse_level_elems``); it then tiles when the
+    dense-blocked plan halves the grid, else keeps the true sparse
+    encoding (tiny fully-skewed levels, e.g. one hub hop).
+    """
+    dense_elems = size * pmax
+    if dense_elems <= max(4 * n_slots, sparse_level_elems):
+        return "dense", None
+    if not tiling:
+        return "sparse", None
+    plan = plan_tiles(widths, cap=tile_pmax, waste=waste)
+    # residual hops keep one slot per call-bearing step; approximate
+    # with their width sum for the decision (exact slots need call
+    # tables the caller may not have at hand)
+    res_elems = int(np.asarray(widths)[plan.residual].sum())
+    if plan.tiled_elems + res_elems <= dense_elems // 2 and plan.tiles:
+        return "tiled", plan
+    return "sparse", None
 
 
 def plan_stats(shapes: Sequence[LevelShape],

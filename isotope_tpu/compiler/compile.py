@@ -139,6 +139,23 @@ def compile_graph(
     telemetry.counter_inc("graphs_compiled")
     telemetry.gauge_set("last_graph_hops", compiled.num_hops)
     telemetry.gauge_set("last_graph_levels", len(compiled.levels))
+    # step-grid skew: the widest level's dense (hops x pmax) element
+    # count and its width skew (level pmax / mean script width) — the
+    # shape signal that drives the sparse/tiled encoding decision
+    # (compiler/buckets.level_encoding); a skew near 1 means dense
+    # grids are tight, a large skew predicts tiling
+    grid_elems = 0
+    skew = 1.0
+    for lvl in compiled.levels:
+        widths = lvl.step_is_real.sum(1)
+        pmax = int(widths.max(initial=0))
+        if pmax <= 0:
+            continue
+        grid_elems = max(grid_elems, lvl.num_hops * pmax)
+        mean_w = float(widths.mean()) if lvl.num_hops else 1.0
+        skew = max(skew, pmax / max(mean_w, 1e-9))
+    telemetry.gauge_set("last_graph_max_step_grid_elems", grid_elems)
+    telemetry.gauge_set("last_graph_step_width_skew", skew)
     return compiled
 
 

@@ -317,6 +317,7 @@ def attribute_block(
     tail_cut: Optional[jax.Array] = None,
     top_k: int = 0,
     ex_state: Optional[ExemplarBatch] = None,
+    packed: bool = False,
 ) -> Tuple[AttributionSummary, Optional[ExemplarBatch]]:
     """Reduce one block's SimResults to an AttributionSummary
     (jit-friendly; called inside the engine's block scan).
@@ -325,6 +326,18 @@ def attribute_block(
     maintains the exemplar state across blocks via ``ex_state`` (ride
     the scan carry — the stacked per-block summaries carry
     ``exemplars=None``).
+
+    ``packed`` (SimParams.packed_carries) accumulates the COUNT-valued
+    carries — request/tail counts, per-hop crit/error counters, and the
+    blame-histogram censuses — as int32 instead of f32.  Crit weights
+    are exact 0/1 products, so the packing is exact (and strictly more
+    exact than f32 past 2^24 events) UP TO the int32 bound: a single
+    run's per-counter total must stay under 2^31 events or the sum
+    wraps, where f32 only lost precision — int64 would need the
+    globally-disabled x64 mode, so longer soaks should run
+    ``packed=False`` (see SimParams.packed_carries).  Every
+    seconds-valued blame accumulator stays f32 — the <= 1 ULP pin
+    forbids narrowing them.
     """
     lat_all = res.hop_latency
     wait_all = res.hop_wait
@@ -348,6 +361,7 @@ def attribute_block(
     per_req = net0
     w = root_sent[:, None]  # (N, 1) — level 0 crit weights
 
+    count_dtype = jnp.int32 if packed else jnp.float32
     crit_l: List[jax.Array] = []
     wait_l: List[jax.Array] = []
     self_l: List[jax.Array] = []
@@ -361,8 +375,12 @@ def attribute_block(
         else jnp.zeros(1)
     ]
     t_tmo_l: List[jax.Array] = [jnp.zeros(1)]
-    hist = jnp.zeros(tables.num_services * NUM_BLAME_BUCKETS)
-    t_hist = jnp.zeros(tables.num_services * NUM_BLAME_BUCKETS)
+    hist = jnp.zeros(
+        tables.num_services * NUM_BLAME_BUCKETS, count_dtype
+    )
+    t_hist = jnp.zeros(
+        tables.num_services * NUM_BLAME_BUCKETS, count_dtype
+    )
 
     for li, lvl in enumerate(tables.levels):
         sl = slice(lvl.offset, lvl.offset + lvl.size)
@@ -409,7 +427,9 @@ def attribute_block(
         hop_self = w * (lat - wait) - D
         contrib = hop_wait + hop_self  # == w * lat - D
         per_req = per_req + contrib.sum(1)
-        crit_l.append(w.sum(0))
+        crit_l.append(
+            w.astype(count_dtype).sum(0) if packed else w.sum(0)
+        )
         wait_l.append(hop_wait.sum(0))
         self_l.append(hop_self.sum(0))
         # clamp before bucketing: f32 accumulation can leave an
@@ -419,29 +439,37 @@ def attribute_block(
             jnp.asarray(lvl.svc)[None, :] * NUM_BLAME_BUCKETS
             + blame_bucket_index(jnp.maximum(contrib, 0.0))
         )
-        hist = hist.at[flat_idx].add(w)
+        hist = hist.at[flat_idx].add(w.astype(count_dtype))
         if tail_w is not None:
             wt = w * tail_w[:, None]
-            t_crit_l.append(wt.sum(0))
+            t_crit_l.append(
+                wt.astype(count_dtype).sum(0) if packed else wt.sum(0)
+            )
             t_wait_l.append((hop_wait * tail_w[:, None]).sum(0))
             t_self_l.append((hop_self * tail_w[:, None]).sum(0))
-            t_hist = t_hist.at[flat_idx].add(wt)
+            t_hist = t_hist.at[flat_idx].add(wt.astype(count_dtype))
         else:
-            t_crit_l.append(jnp.zeros(lvl.size))
+            t_crit_l.append(jnp.zeros(lvl.size, count_dtype))
             t_wait_l.append(jnp.zeros(lvl.size))
             t_self_l.append(jnp.zeros(lvl.size))
         w = w_next
 
     resid = res.client_latency - per_req
-    err_count = (res.hop_sent & res.hop_error).sum(0).astype(jnp.float32)
+    err_count = (res.hop_sent & res.hop_error).sum(0).astype(count_dtype)
 
     if top_k > 0:
         ex_state = _update_exemplars(res, ex_state, top_k)
 
     summary = AttributionSummary(
-        count=jnp.float32(n),
+        count=count_dtype(n),
         tail_count=(
-            tail_w.sum() if tail_w is not None else jnp.float32(0.0)
+            (
+                tail_w.astype(count_dtype).sum()
+                if packed
+                else tail_w.sum()
+            )
+            if tail_w is not None
+            else count_dtype(0)
         ),
         tail_cut=(
             jnp.asarray(tail_cut, jnp.float32)

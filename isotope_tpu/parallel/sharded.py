@@ -604,6 +604,7 @@ class ShardedSimulator:
                 res, tables,
                 tail_cut=tail_cut if tail else None,
                 top_k=top_k, ex_state=ex,
+                packed=self.sim.params.packed_carries,
             )
             return ((t_end, conn_end, req_off + per), ex), (s, a)
 

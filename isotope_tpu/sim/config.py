@@ -114,10 +114,46 @@ class SimParams:
     # correlation to transfer in the first place.
     hierarchical_copula_gamma: float = 0.9
     # Dense-grid element threshold above which a skewed level (grid
-    # > 4x its real call-step count) switches to the sparse call-slot
-    # step encoding (engine._SparseSteps) — the star-10k mitigation.
-    # Lower it to force the sparse path on small graphs (tests).
+    # > 4x its real call-step count) leaves the dense step grid — the
+    # star-10k mitigation.  Lower it to force the non-dense path on
+    # small graphs (tests).
     sparse_level_elems: int = 262_144
+    # Dense-blocked sparse levels (engine._TiledSteps): a level past
+    # the sparse threshold is partitioned into fixed-width dense tiles
+    # (hops binned by script-width class, padded to the bin's widest
+    # script — compiler/buckets.plan_tiles) executed with the exact
+    # dense step-grid ops restricted to each bin; only scripts wider
+    # than ``sparse_tile_pmax`` keep the true sparse call-slot
+    # encoding as a residual.  Bit-identical to the dense grid in
+    # eager, <= 1 ULP under jit (tests/test_sparse_tiles.py); off
+    # falls back to the pure sparse encoding everywhere.
+    sparse_tiling: bool = True
+    sparse_tile_pmax: int = 64
+    # Pallas census kernel (native/census_pallas.py): fuse the per-step
+    # census / WaitGroup-max join (max with the sleep floor, step mask,
+    # busy row-sum, exclusive step prefix — today a chain of XLA ops)
+    # into one hand-written kernel.  None = auto: on for TPU backends,
+    # off elsewhere (the CPU interpreter-mode kernel is for equivalence
+    # tests, not speed).  False reproduces today's op-by-op path
+    # exactly; True forces the kernel (interpreter mode off-TPU).
+    pallas_census: Optional[bool] = None
+    # Pack the census/blame carries where the <= 1 ULP pins allow:
+    # attribution hop counters / blame-histogram censuses accumulate as
+    # int32 (exact where f32 loses integers past 2^24) and the census
+    # kernel's step mask rides as bf16 (0/1 exact).  Latency/blame
+    # accumulators stay f32.  Attribution off is byte-identical either
+    # way (the packing only touches attributed programs).  BOUND: any
+    # single attributed run must keep every counter under 2^31 events
+    # (int32 wraps where f32 merely lost precision; int64 needs the
+    # globally-disabled x64 mode) — for longer soaks set
+    # ``packed_carries=False`` or split the run.
+    packed_carries: bool = True
+    # Bucket scheduling discipline (compiler/buckets.plan_segments):
+    # "critical-path" partitions each scan-eligible run by a DP
+    # minimizing the summed per-segment critical-path cost (dispatch
+    # overhead + padded elements); "greedy" is the historical
+    # left-to-right maximal extension.
+    bucket_schedule: str = "critical-path"
     # Bucketed level-scan executor (sim/levelscan.py): consecutive
     # depth levels with close shapes are padded to shared bounds and
     # swept by ONE lax.scan body per bucket, so trace/HLO size is
@@ -166,6 +202,13 @@ class SimParams:
             raise ValueError("retry_copula_r must be in [0, 1)")
         if self.level_bucket_waste < 1.0:
             raise ValueError("level_bucket_waste must be >= 1")
+        if self.sparse_tile_pmax < 1:
+            raise ValueError("sparse_tile_pmax must be >= 1")
+        if self.bucket_schedule not in ("critical-path", "greedy"):
+            raise ValueError(
+                f"unknown bucket_schedule: {self.bucket_schedule!r} "
+                "(expected 'critical-path' or 'greedy')"
+            )
         if self.attribution_top_k < 0:
             raise ValueError("attribution_top_k must be >= 0")
         if not 0.0 < self.attribution_tail_quantile < 1.0:

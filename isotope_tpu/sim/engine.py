@@ -137,6 +137,10 @@ class _Level:
     uniform_calls: Optional[int] = None
     # sparse call-slot step encoding (skewed wide levels); None = dense
     sparse: Optional["_SparseSteps"] = None
+    # dense-blocked tiling of a skewed wide level (the default sparse
+    # mitigation when the level's fan-out classes tile; see
+    # _TiledSteps); mutually exclusive with ``sparse``
+    tiled: Optional["_TiledSteps"] = None
     # call-free levels: busy time is fully static — (L,) seconds
     leaf_busy: Optional[jax.Array] = None
 
@@ -192,6 +196,347 @@ class _SparseSteps:
     slot_hop: jax.Array           # (S,) local hop index of each slot
     slot_step: jax.Array          # (S,) step index of each slot
     slot_sleep_prefix: jax.Array  # (S,) static sleep before the slot
+
+
+@dataclasses.dataclass(frozen=True)
+class _Tile:
+    """One dense sub-grid of a tiled sparse level (see _TiledSteps).
+
+    ``hops`` / ``call_sel`` / ``child_sel`` are static selections into
+    the LEVEL's local hop / call / child orders; the step tables are
+    the level's rows restricted to the tile's hops and truncated to the
+    tile width, so the per-tile census ops are the dense grid's ops on
+    exactly those rows — bit-identical in eager.
+    """
+
+    hops: np.ndarray              # (T,) level-local hop indices, sorted
+    width: int                    # W — padded step width of the bin
+    step_mask: jax.Array          # (T, W) f32
+    step_base: jax.Array          # (T, W) f32
+    call_sel: np.ndarray          # (Kt,) indices into level call order
+    call_pos: jax.Array           # (Kt,) parent position within tile
+    call_step: jax.Array          # (Kt,) step index within the parent
+    call_seg: jax.Array           # (Kt,) call_pos * W + call_step
+    child_sel: np.ndarray         # (Ct,) indices into level child order
+    child_pos: jax.Array          # (Ct,) parent position within tile
+    child_step: jax.Array         # (Ct,)
+    uniform_calls: Optional[int]  # c when call_seg == repeat(arange, c)
+
+
+@dataclasses.dataclass(frozen=True)
+class _TiledSteps:
+    """Dense-blocked encoding of a skewed wide level.
+
+    The dense (hops x Pmax) grid the sparse encoding avoids is instead
+    PARTITIONED: hops are binned by script-width class into fixed-width
+    tiles (compiler/buckets.plan_tiles) and each tile runs the exact
+    dense step-grid ops restricted to its rows; only scripts wider than
+    the tile cap keep the true sparse call-slot encoding as a
+    ``residual``.  Per-part busy/fail/off vectors are re-assembled into
+    level order by the static ``hop_inv`` / ``child_inv`` gathers.
+
+    star-10k shape: 9,999 single-step spokes collapse into one
+    (9999 x 1) tile — pure dense elementwise work — while the ~2,000-
+    step hub stays on the sparse residual, instead of one 10k-slot
+    serial gather/cumsum chain covering every hop.
+    """
+
+    tiles: Tuple[_Tile, ...]
+    residual: Optional[_SparseSteps]     # over residual hops only
+    res_hops: Optional[np.ndarray]       # (R,) level-local indices
+    res_call_sel: Optional[np.ndarray]   # (Kr,) level call order indices
+    res_child_sel: Optional[np.ndarray]  # (Cr,)
+    res_child_pos: Optional[jax.Array]   # (Cr,) parent pos among residual
+    res_child_step: Optional[jax.Array]  # (Cr,)
+    hop_inv: np.ndarray                  # (L,) concat order -> level order
+    child_inv: np.ndarray                # (C,) concat order -> level order
+    elems: int                           # tile + residual element count
+
+
+def _sparse_tables(
+    num_hops: int,
+    pmax: int,
+    sleep_real: np.ndarray,      # (L, >=pmax) f64 — step_is_real * base
+    step_base: np.ndarray,       # (L, >=pmax)
+    call_seg_p: np.ndarray,      # (K,) parent_local * pmax + step
+    parent_local: np.ndarray,    # (C,)
+    child_step: np.ndarray,      # (C,)
+) -> _SparseSteps:
+    """Build the sparse call-slot tables for one (possibly restricted)
+    hop set — shared by the pure sparse encoding and a tiled level's
+    residual part (inputs already renumbered to the restricted order)."""
+    slot_segs = np.unique(call_seg_p)  # sorted
+    n_slots = len(slot_segs)
+    n_calls = len(call_seg_p)
+    slot_hop = slot_segs // pmax
+    slot_step = slot_segs % pmax
+    call_slot_np = np.searchsorted(slot_segs, call_seg_p)
+    seg_first = np.zeros(num_hops, np.int64)
+    seg_last = np.zeros(num_hops, np.int64)
+    has = np.zeros(num_hops, bool)
+    for i, h in enumerate(slot_hop):
+        if not has[h]:
+            seg_first[h] = i
+            has[h] = True
+        seg_last[h] = i
+    has_call_step = np.zeros((num_hops, pmax), bool)
+    has_call_step[slot_hop, slot_step] = True
+    sleep_only = sleep_real[:, :pmax] * ~has_call_step
+    sleep_prefix = np.cumsum(sleep_only, 1) - sleep_only
+    child_sleep_prefix = sleep_prefix[parent_local, child_step]
+    child_slot_np = np.searchsorted(
+        slot_segs, parent_local * pmax + child_step
+    )
+    return _SparseSteps(
+        n_slots=n_slots,
+        slot_base=jnp.asarray(
+            step_base[slot_hop, slot_step], jnp.float32
+        ),
+        call_slot=(
+            None
+            if np.array_equal(
+                call_slot_np, np.arange(n_calls, dtype=np.int64)
+            )
+            else jnp.asarray(call_slot_np, jnp.int32)
+        ),
+        has_slots=jnp.asarray(has),
+        seg_first=jnp.asarray(seg_first, jnp.int32),
+        seg_last=jnp.asarray(seg_last, jnp.int32),
+        sleep_total=jnp.asarray(sleep_only.sum(1), jnp.float32),
+        child_sleep_prefix=jnp.asarray(
+            child_sleep_prefix, jnp.float32
+        ),
+        child_slot=jnp.asarray(child_slot_np, jnp.int32),
+        child_seg_first=jnp.asarray(
+            seg_first[parent_local], jnp.int32
+        ),
+        slot_hop=jnp.asarray(slot_hop, jnp.int32),
+        slot_step=jnp.asarray(slot_step, jnp.int32),
+        slot_sleep_prefix=jnp.asarray(
+            sleep_prefix[slot_hop, slot_step], jnp.float32
+        ),
+    )
+
+
+def _build_tiled_steps(
+    plan,                        # buckets.TilePlan
+    pmax: int,
+    step_is_real: np.ndarray,    # (L, >=pmax) bool
+    step_base: np.ndarray,       # (L, >=pmax)
+    sleep_real: np.ndarray,      # (L, >=pmax) f64
+    call_seg_p: np.ndarray,      # (K,)
+    parent_local: np.ndarray,    # (C,)
+    child_step: np.ndarray,      # (C,)
+) -> _TiledSteps:
+    """Lower one level's tile plan into device constants."""
+    call_parent = call_seg_p // pmax
+    call_step_all = call_seg_p % pmax
+    tiles: List[_Tile] = []
+    hop_parts: List[np.ndarray] = []
+    child_parts: List[np.ndarray] = []
+    elems = 0
+    # one-pass hop -> part map: selecting each part's calls/children is
+    # then a vectorized compare instead of repeated np.isin (the
+    # lowering is host-side but svc100k-sized levels feel O(T * K log))
+    num_hops_total = (
+        max(int(call_parent.max(initial=-1)),
+            int(parent_local.max(initial=-1)),
+            max((int(idx.max(initial=-1)) for _, idx in plan.tiles),
+                default=-1),
+            int(plan.residual.max(initial=-1)))
+        + 1
+    )
+    part_of_hop = np.full(num_hops_total, -1, np.int64)
+    for ti, (_, hop_idx) in enumerate(plan.tiles):
+        part_of_hop[hop_idx] = ti
+    if len(plan.residual):
+        part_of_hop[plan.residual] = len(plan.tiles)
+    part_of_call = part_of_hop[call_parent]
+    part_of_child = part_of_hop[parent_local]
+    for ti, (w, hop_idx) in enumerate(plan.tiles):
+        w = int(w)
+        call_sel = np.nonzero(part_of_call == ti)[0]
+        call_pos = np.searchsorted(hop_idx, call_parent[call_sel])
+        cstep = call_step_all[call_sel]
+        call_seg_t = call_pos * w + cstep
+        child_sel = np.nonzero(part_of_child == ti)[0]
+        child_pos = np.searchsorted(hop_idx, parent_local[child_sel])
+        slots_t = len(hop_idx) * w
+        uniform: Optional[int] = None
+        if len(call_sel) > 0 and len(call_sel) % slots_t == 0:
+            c = len(call_sel) // slots_t
+            if np.array_equal(
+                call_seg_t, np.repeat(np.arange(slots_t), c)
+            ):
+                uniform = c
+        tiles.append(_Tile(
+            hops=hop_idx,
+            width=w,
+            step_mask=jnp.asarray(
+                step_is_real[hop_idx][:, :w], jnp.float32
+            ),
+            step_base=jnp.asarray(step_base[hop_idx][:, :w]),
+            call_sel=call_sel,
+            call_pos=jnp.asarray(call_pos, jnp.int32),
+            call_step=jnp.asarray(cstep, jnp.int32),
+            call_seg=jnp.asarray(call_seg_t, jnp.int32),
+            child_sel=child_sel,
+            child_pos=jnp.asarray(child_pos, jnp.int32),
+            child_step=jnp.asarray(child_step[child_sel], jnp.int32),
+            uniform_calls=uniform,
+        ))
+        hop_parts.append(hop_idx)
+        child_parts.append(child_sel)
+        elems += len(hop_idx) * w
+    residual = None
+    res_hops = res_call_sel = res_child_sel = None
+    res_child_pos = res_child_step = None
+    if len(plan.residual):
+        res_hops = plan.residual
+        res_part = len(plan.tiles)
+        res_call_sel = np.nonzero(part_of_call == res_part)[0]
+        call_pos_r = np.searchsorted(res_hops, call_parent[res_call_sel])
+        call_seg_r = call_pos_r * pmax + call_step_all[res_call_sel]
+        res_child_sel = np.nonzero(part_of_child == res_part)[0]
+        parent_r = np.searchsorted(
+            res_hops, parent_local[res_child_sel]
+        )
+        child_step_r = child_step[res_child_sel]
+        residual = _sparse_tables(
+            len(res_hops), pmax,
+            sleep_real[res_hops], step_base[res_hops],
+            call_seg_r, parent_r, child_step_r,
+        )
+        res_child_pos = jnp.asarray(parent_r, jnp.int32)
+        res_child_step = jnp.asarray(child_step_r, jnp.int32)
+        hop_parts.append(res_hops)
+        child_parts.append(res_child_sel)
+        elems += residual.n_slots
+    hop_order = np.concatenate(hop_parts) if hop_parts else np.zeros(
+        0, np.int64
+    )
+    child_order = (
+        np.concatenate(child_parts)
+        if child_parts
+        else np.zeros(0, np.int64)
+    )
+    return _TiledSteps(
+        tiles=tuple(tiles),
+        residual=residual,
+        res_hops=res_hops,
+        res_call_sel=res_call_sel,
+        res_child_sel=res_child_sel,
+        res_child_pos=res_child_pos,
+        res_child_step=res_child_step,
+        hop_inv=np.argsort(hop_order),
+        child_inv=np.argsort(child_order),
+        elems=int(elems),
+    )
+
+
+def _sparse_level_sweep(
+    sp: _SparseSteps,
+    n: int,
+    P: int,
+    size: int,
+    dur_call: jax.Array,
+    final_transport: Optional[jax.Array],
+    err_par: Optional[jax.Array],       # (n, size) parent 500 coins
+    child_parent_local: jax.Array,      # (C,) parent index in [0, size)
+    child_step: jax.Array,              # (C,)
+):
+    """The sparse call-slot sweep over one hop set.
+
+    Returns ``(busy, fail_step, off)`` — per-hop busy seconds (NOT yet
+    500-zeroed; the level tail applies the err mask), the per-hop fail
+    step (sentinel ``P`` = no transport failure; ``None`` when none can
+    occur), and per-child start offsets (fail- and err-adjusted, before
+    any retry att_off addition).  Shared by the pure sparse encoding
+    and a tiled level's residual part — inputs come pre-restricted.
+
+    Transport failures truncate via the per-slot fail scatter-min: a
+    failure can only originate at a call-bearing step, so the first
+    failing slot pins the hop's fail step exactly as the dense
+    executed-step mask would.
+    """
+    S = sp.n_slots
+    fail_step = None
+    if S == 0:
+        # call-free hop set (pure-sleep scripts wider than the tile
+        # cap): busy is fully static, nothing can transport-fail, and
+        # there are no children to offset
+        busy = jnp.broadcast_to(sp.sleep_total, (n, size))
+        off = jnp.zeros((n, child_step.shape[0]))
+        return busy, None, off
+    if sp.call_slot is None:
+        slot_agg = dur_call
+        slot_fail = final_transport
+    else:
+        slot_agg = (
+            jnp.zeros((n, S))
+            .at[:, sp.call_slot]
+            .max(dur_call)
+        )
+        slot_fail = (
+            jnp.zeros((n, S), bool)
+            .at[:, sp.call_slot]
+            .max(final_transport)
+            if final_transport is not None
+            else None
+        )
+    dyn = jnp.maximum(sp.slot_base, slot_agg)
+    if slot_fail is not None:
+        fail_slot = (
+            jnp.full((n, size), S, jnp.int32)
+            .at[:, sp.slot_hop]
+            .min(
+                jnp.where(
+                    slot_fail,
+                    jnp.arange(S, dtype=jnp.int32),
+                    S,
+                )
+            )
+        )
+        failed = fail_slot < S
+        safe = jnp.minimum(fail_slot, S - 1)
+        fail_step = jnp.where(failed, sp.slot_step[safe], P)
+        # slots past the hop's fail step don't execute
+        dyn = jnp.where(
+            sp.slot_step[None, :] <= fail_step[:, sp.slot_hop],
+            dyn,
+            0.0,
+        )
+        sleep_exec = jnp.where(
+            failed, sp.slot_sleep_prefix[safe], sp.sleep_total,
+        )
+    else:
+        sleep_exec = sp.sleep_total
+    pcs = jnp.cumsum(dyn, axis=1)
+    excl = pcs - dyn
+    seg_sum = jnp.where(
+        sp.has_slots,
+        pcs[:, sp.seg_last] - excl[:, sp.seg_first],
+        0.0,
+    )
+    busy = sleep_exec + seg_sum
+    off = (
+        sp.child_sleep_prefix
+        + excl[:, sp.child_slot]
+        - excl[:, sp.child_seg_first]
+    )
+    if fail_step is not None:
+        # children past the fail step aren't sent; the dense grid's
+        # prefix is flat there (== the truncated busy time) — match it
+        off = jnp.where(
+            child_step <= fail_step[:, child_parent_local],
+            off,
+            busy[:, child_parent_local],
+        )
+    if err_par is not None:
+        # a 500ing parent runs no steps (dense zeroes the grid before
+        # the prefix — match exactly)
+        off = off * ~err_par[:, child_parent_local]
+    return busy, fail_step, off
 
 
 # one definition serves both executors: the scan twin's bit-for-bit
@@ -626,13 +971,17 @@ class Simulator:
                 ):
                     uniform = c
 
-            # -- sparse call-slot encoding for skewed wide levels ------
-            # Transport failures (timeouts / chaos downs) are handled
-            # via per-slot fail scatter-mins (see _SparseSteps), so the
-            # encoding activates purely on shape.  Dense grids within
-            # 4x of the real call-step count (or small outright) aren't
-            # worth the extra gathers.
+            # -- non-dense step encodings for skewed wide levels -------
+            # A level whose dense (hops x Pmax) grid is pathological
+            # (engine docstring) leaves the dense path.  The default
+            # mitigation is the DENSE-BLOCKED tiling (_TiledSteps):
+            # hops binned by script-width class run the dense grid ops
+            # on fixed-width tiles, and only scripts wider than the
+            # tile cap keep the true sparse call-slot encoding
+            # (_SparseSteps) as a residual.  The decision is shared
+            # with the vet linter (compiler/buckets.level_encoding).
             sparse: Optional[_SparseSteps] = None
+            tiled: Optional[_TiledSteps] = None
             leaf_busy: Optional[jax.Array] = None
             sleep_real = lvl.step_is_real.astype(np.float64) * (
                 lvl.step_base
@@ -640,73 +989,40 @@ class Simulator:
             if n_calls == 0:
                 leaf_busy = jnp.asarray(sleep_real.sum(1), jnp.float32)
             else:
-                slot_segs = np.unique(call_seg_p)  # sorted
-                n_slots = len(slot_segs)
-                dense_elems = lvl.num_hops * pmax
-                if dense_elems > max(
-                    4 * n_slots, params.sparse_level_elems
-                ):
-                    slot_hop = slot_segs // pmax
-                    slot_step = slot_segs % pmax
-                    call_slot_np = np.searchsorted(slot_segs, call_seg_p)
-                    seg_first = np.zeros(lvl.num_hops, np.int64)
-                    seg_last = np.zeros(lvl.num_hops, np.int64)
-                    has = np.zeros(lvl.num_hops, bool)
-                    for i, h in enumerate(slot_hop):
-                        if not has[h]:
-                            seg_first[h] = i
-                            has[h] = True
-                        seg_last[h] = i
-                    has_call_step = np.zeros(
-                        (lvl.num_hops, pmax), bool
+                n_slots = len(np.unique(call_seg_p))
+                widths = lvl.step_is_real[:, :pmax].sum(1)
+                enc, tile_plan = buckets.level_encoding(
+                    lvl.num_hops, pmax, n_slots, widths,
+                    sparse_level_elems=params.sparse_level_elems,
+                    tiling=params.sparse_tiling,
+                    tile_pmax=params.sparse_tile_pmax,
+                )
+                if enc == "tiled":
+                    tiled = _build_tiled_steps(
+                        tile_plan, pmax, lvl.step_is_real,
+                        lvl.step_base, sleep_real, call_seg_p,
+                        parent_local, child_step,
                     )
-                    has_call_step[slot_hop, slot_step] = True
-                    sleep_only = sleep_real[:, :pmax] * ~has_call_step
-                    sleep_prefix = np.cumsum(sleep_only, 1) - sleep_only
-                    child_sleep_prefix = sleep_prefix[
-                        parent_local, child_step
-                    ]
-                    child_slot_np = np.searchsorted(
-                        slot_segs, parent_local * pmax + child_step
-                    )
-                    sparse = _SparseSteps(
-                        n_slots=n_slots,
-                        slot_base=jnp.asarray(
-                            lvl.step_base[slot_hop, slot_step],
-                            jnp.float32,
-                        ),
-                        call_slot=(
-                            None
-                            if np.array_equal(
-                                call_slot_np,
-                                np.arange(n_calls, dtype=np.int64),
-                            )
-                            else jnp.asarray(call_slot_np, jnp.int32)
-                        ),
-                        has_slots=jnp.asarray(has),
-                        seg_first=jnp.asarray(seg_first, jnp.int32),
-                        seg_last=jnp.asarray(seg_last, jnp.int32),
-                        sleep_total=jnp.asarray(
-                            sleep_only.sum(1), jnp.float32
-                        ),
-                        child_sleep_prefix=jnp.asarray(
-                            child_sleep_prefix, jnp.float32
-                        ),
-                        child_slot=jnp.asarray(child_slot_np, jnp.int32),
-                        child_seg_first=jnp.asarray(
-                            seg_first[parent_local], jnp.int32
-                        ),
-                        slot_hop=jnp.asarray(slot_hop, jnp.int32),
-                        slot_step=jnp.asarray(slot_step, jnp.int32),
-                        slot_sleep_prefix=jnp.asarray(
-                            sleep_prefix[slot_hop, slot_step],
-                            jnp.float32,
-                        ),
+                elif enc == "sparse":
+                    sparse = _sparse_tables(
+                        lvl.num_hops, pmax, sleep_real, lvl.step_base,
+                        call_seg_p, parent_local, child_step,
                     )
             meta = dict(
                 size=lvl.num_hops, pmax=pmax, C=len(cids), K=n_calls,
                 A=lvl.att_child.shape[0], offset=offset,
-                sparse=sparse is not None, leaf=n_calls == 0,
+                sparse=sparse is not None or tiled is not None,
+                leaf=n_calls == 0,
+                tiles=(
+                    tuple((len(t.hops), t.width) for t in tiled.tiles)
+                    if tiled is not None
+                    else None
+                ),
+                residual_slots=(
+                    tiled.residual.n_slots
+                    if tiled is not None and tiled.residual is not None
+                    else (sparse.n_slots if sparse is not None else 0)
+                ),
             )
             if params.bucketed_scan and not (meta["sparse"]
                                              or meta["leaf"]):
@@ -764,6 +1080,7 @@ class Simulator:
                     ),
                     uniform_calls=uniform,
                     sparse=sparse,
+                    tiled=tiled,
                     leaf_busy=leaf_busy,
                 )
             )
@@ -787,7 +1104,8 @@ class Simulator:
             buckets.LevelShape(
                 size=m["size"], pmax=m["pmax"], children=m["C"],
                 calls=m["K"], attempts=m["A"], sparse=m["sparse"],
-                offset=m["offset"],
+                offset=m["offset"], tiles=m.get("tiles"),
+                residual_slots=m.get("residual_slots", 0),
             )
             for m in np_meta
         ]
@@ -795,6 +1113,7 @@ class Simulator:
             shapes,
             waste=params.level_bucket_waste,
             enabled=params.bucketed_scan,
+            schedule=params.bucket_schedule,
         )
         self._segments = tuple(
             levelscan.build_bucket(p, np_meta, len(self._churn))
@@ -802,7 +1121,23 @@ class Simulator:
             else p
             for p in plan
         )
+        self._plan_shapes = tuple(shapes)
+        self._plan = tuple(plan)
         self._plan_sig = buckets.plan_signature(plan)
+        # -- Pallas census kernel flag (native/census_pallas.py) ------------
+        # auto: on for TPU backends, off elsewhere (the CPU
+        # interpreter-mode kernel exists for equivalence tests, not
+        # speed); False keeps today's op-by-op census byte-identical.
+        self._pallas_census = (
+            params.pallas_census
+            if params.pallas_census is not None
+            else jax.default_backend() == "tpu"
+        )
+        self._census_mod = None
+        if self._pallas_census:
+            from isotope_tpu.native import census_pallas
+
+            self._census_mod = census_pallas
 
         # -- AOT shape signature (compiler/cache.py) ------------------------
         # Everything a traced entry point bakes in: the bucket plan, the
@@ -1834,6 +2169,7 @@ class Simulator:
                                 tail_cut if attr == "tail" else None
                             ),
                             top_k=top_k, ex_state=ex,
+                            packed=self.params.packed_carries,
                         )
                         carry_out = (
                             (t_end, conn_end, req_off + per), ex
@@ -2353,6 +2689,7 @@ class Simulator:
             n=n, wait=wait, svc_time=svc_time, err_coin=err_coin,
             u_send=u_send, down=down, tax=tax, churn_w=churn_w,
             track_err=self._track_err,
+            pallas_census=self._pallas_census,
         )
         bucket_ys: Dict[int, dict] = {}
         up_units: List[tuple] = []
@@ -2397,6 +2734,7 @@ class Simulator:
             sl = slice(lvl.offset, lvl.offset + lvl.size)
             P = lvl.pmax
             fail_step = None
+            dense_excl = None  # census-kernel exclusive step prefix
             if lvl.num_children > 0:
                 nxt = self._levels[d + 1]
                 csl = slice(nxt.offset, nxt.offset + nxt.size)
@@ -2511,89 +2849,169 @@ class Simulator:
                 if lvl.sparse is not None:
                     # sparse call-slot path (skewed wide level): per-hop
                     # busy times are packed segment sums, pure-sleep
-                    # steps are static.  Transport failures truncate via
-                    # the per-slot fail scatter-min — a failure can only
-                    # originate at a call-bearing step, so the first
-                    # failing slot pins the hop's fail step exactly as
-                    # the dense executed-step mask would.
-                    sp = lvl.sparse
-                    S = sp.n_slots
-                    if sp.call_slot is None:
-                        slot_agg = dur_call
-                        slot_fail = final_transport
-                    else:
-                        slot_agg = (
-                            jnp.zeros((n, S))
-                            .at[:, sp.call_slot]
-                            .max(dur_call)
-                        )
-                        slot_fail = (
-                            jnp.zeros((n, S), bool)
-                            .at[:, sp.call_slot]
-                            .max(final_transport)
-                            if final_transport is not None
+                    # steps are static (_sparse_level_sweep — shared
+                    # with the tiled encoding's residual part).
+                    busy, fail_step, off = _sparse_level_sweep(
+                        lvl.sparse, n, P, lvl.size, dur_call,
+                        final_transport,
+                        (
+                            err_coin[:, sl]
+                            if err_coin is not None
                             else None
+                        ),
+                        lvl.child_parent_local,
+                        lvl.child_step,
+                    )
+                    if att_off is not None:
+                        off = off + used_lvls[d] * att_off[:, :C]
+                    off_lvls[d] = off
+                    step_dur = None
+                elif lvl.tiled is not None:
+                    # dense-blocked tiles + sparse residual (see
+                    # _TiledSteps): every tile runs the dense step-grid
+                    # ops restricted to its rows — bit-identical to the
+                    # full dense grid on those hops — and the residual
+                    # keeps the sparse call-slot sweep; per-part
+                    # busy/fail/off re-assemble into level order by the
+                    # static inverse gathers.
+                    tl = lvl.tiled
+                    err_lvl = (
+                        err_coin[:, sl] if err_coin is not None else None
+                    )
+                    transportable = final_transport is not None
+                    busy_parts: List[jax.Array] = []
+                    fail_parts: List[jax.Array] = []
+                    off_parts: List[jax.Array] = []
+                    for tile in tl.tiles:
+                        T, W = len(tile.hops), tile.width
+                        need_off = tile.child_sel.size > 0
+                        if tile.call_sel.size:
+                            dc = dur_call[:, tile.call_sel]
+                            if tile.uniform_calls is not None:
+                                agg = dc.reshape(
+                                    n, T, W, tile.uniform_calls
+                                ).max(-1)
+                            else:
+                                agg = (
+                                    jnp.zeros((n, T * W))
+                                    .at[:, tile.call_seg]
+                                    .max(dc)
+                                    .reshape(n, T, W)
+                                )
+                        else:
+                            agg = None
+                        fail_t = None
+                        if transportable:
+                            if tile.call_sel.size:
+                                ft = final_transport[:, tile.call_sel]
+                                fail_contrib = jnp.where(
+                                    ft, tile.call_step, P
+                                ).astype(jnp.int32)
+                                if tile.uniform_calls is not None:
+                                    fail_t = fail_contrib.reshape(
+                                        n, T, W * tile.uniform_calls
+                                    ).min(-1)
+                                else:
+                                    fail_t = (
+                                        jnp.full((n, T), P, jnp.int32)
+                                        .at[:, tile.call_pos]
+                                        .min(fail_contrib)
+                                    )
+                            else:
+                                # call-free rows cannot transport-fail
+                                fail_t = jnp.full((n, T), P, jnp.int32)
+                        prefix = None
+                        if agg is None:
+                            # the dense grid's agg is all-zero here
+                            busy_t = jnp.broadcast_to(
+                                (
+                                    jnp.maximum(tile.step_base, 0.0)
+                                    * tile.step_mask
+                                ).sum(-1),
+                                (n, T),
+                            )
+                        elif (
+                            self._census_mod is not None
+                            and self._census_mod.supported(T, W)
+                        ):
+                            busy_t, excl = self._census_mod.census(
+                                tile.step_base, tile.step_mask, agg,
+                                fail_t, None,
+                            )
+                            prefix = excl if need_off else None
+                        else:
+                            step_dur_t = (
+                                jnp.maximum(tile.step_base, agg)
+                                * tile.step_mask
+                            )
+                            if fail_t is not None:
+                                step_dur_t = step_dur_t * (
+                                    jnp.arange(W, dtype=jnp.int32)
+                                    <= fail_t[:, :, None]
+                                )
+                            busy_t = step_dur_t.sum(-1)
+                            if need_off:
+                                prefix = (
+                                    jnp.cumsum(step_dur_t, axis=-1)
+                                    - step_dur_t
+                                )
+                        busy_parts.append(busy_t)
+                        if transportable:
+                            fail_parts.append(fail_t)
+                        if need_off:
+                            off_t = prefix.reshape(n, -1)[
+                                :, tile.child_pos * W + tile.child_step
+                            ]
+                            if err_lvl is not None:
+                                # dense zeroes the grid before the
+                                # prefix for a 500ing parent — match
+                                off_t = off_t * ~err_lvl[
+                                    :, tile.hops
+                                ][:, tile.child_pos]
+                            off_parts.append(off_t)
+                    if tl.residual is not None:
+                        busy_r, fail_r, off_r = _sparse_level_sweep(
+                            tl.residual, n, P, len(tl.res_hops),
+                            dur_call[:, tl.res_call_sel],
+                            (
+                                final_transport[:, tl.res_call_sel]
+                                if transportable
+                                else None
+                            ),
+                            (
+                                err_lvl[:, tl.res_hops]
+                                if err_lvl is not None
+                                else None
+                            ),
+                            tl.res_child_pos,
+                            tl.res_child_step,
                         )
-                    dyn = jnp.maximum(sp.slot_base, slot_agg)
-                    if slot_fail is not None:
-                        fail_slot = (
-                            jnp.full((n, lvl.size), S, jnp.int32)
-                            .at[:, sp.slot_hop]
-                            .min(
-                                jnp.where(
-                                    slot_fail,
-                                    jnp.arange(S, dtype=jnp.int32),
-                                    S,
+                        busy_parts.append(busy_r)
+                        if transportable:
+                            # a call-free residual cannot fail: carry
+                            # the sentinel so the assembly stays dense
+                            fail_parts.append(
+                                fail_r
+                                if fail_r is not None
+                                else jnp.full(
+                                    (n, len(tl.res_hops)), P, jnp.int32
                                 )
                             )
-                        )
-                        failed = fail_slot < S
-                        safe = jnp.minimum(fail_slot, S - 1)
-                        fail_step = jnp.where(
-                            failed, sp.slot_step[safe], P
-                        )
-                        # slots past the hop's fail step don't execute
-                        dyn = jnp.where(
-                            sp.slot_step[None, :]
-                            <= fail_step[:, sp.slot_hop],
-                            dyn,
-                            0.0,
-                        )
-                        sleep_exec = jnp.where(
-                            failed, sp.slot_sleep_prefix[safe],
-                            sp.sleep_total,
-                        )
-                    else:
-                        sleep_exec = sp.sleep_total
-                    pcs = jnp.cumsum(dyn, axis=1)
-                    excl = pcs - dyn
-                    seg_sum = jnp.where(
-                        sp.has_slots,
-                        pcs[:, sp.seg_last] - excl[:, sp.seg_first],
-                        0.0,
-                    )
-                    busy = sleep_exec + seg_sum
-                    off = (
-                        sp.child_sleep_prefix
-                        + excl[:, sp.child_slot]
-                        - excl[:, sp.child_seg_first]
-                    )
-                    if fail_step is not None:
-                        # children past the fail step aren't sent; the
-                        # dense grid's prefix is flat there (== the
-                        # truncated busy time) — match it exactly
-                        off = jnp.where(
-                            lvl.child_step
-                            <= fail_step[:, lvl.child_parent_local],
-                            off,
-                            busy[:, lvl.child_parent_local],
-                        )
-                    if err_coin is not None:
-                        # a 500ing parent runs no steps (dense zeroes
-                        # the grid before the prefix — match exactly)
-                        off = off * ~err_coin[:, sl][
-                            :, lvl.child_parent_local
+                        if tl.res_child_sel.size:
+                            off_parts.append(off_r)
+                    busy = jnp.concatenate(busy_parts, axis=1)[
+                        :, tl.hop_inv
+                    ]
+                    fail_step = (
+                        jnp.concatenate(fail_parts, axis=1)[
+                            :, tl.hop_inv
                         ]
+                        if transportable
+                        else None
+                    )
+                    off = jnp.concatenate(off_parts, axis=1)[
+                        :, tl.child_inv
+                    ]
                     if att_off is not None:
                         off = off + used_lvls[d] * att_off[:, :C]
                     off_lvls[d] = off
@@ -2612,9 +3030,6 @@ class Simulator:
                             .max(dur_call)
                             .reshape(n, lvl.size, P)
                         )
-                    step_dur = (
-                        jnp.maximum(lvl.step_base, agg) * lvl.step_mask
-                    )
                     if final_transport is not None:
                         fail_contrib = jnp.where(
                             final_transport, lvl.call_step, P
@@ -2629,6 +3044,29 @@ class Simulator:
                                 .at[:, lvl.call_seg // P]
                                 .min(fail_contrib)
                             )
+                    if (
+                        self._census_mod is not None
+                        and self._census_mod.supported(lvl.size, P)
+                    ):
+                        # fused census kernel (native/census_pallas.py):
+                        # max + mask + fail/err truncation + row-sum +
+                        # exclusive prefix in one pass; the masked
+                        # (N, size, P) step grid never round-trips HBM
+                        busy, dense_excl = self._census_mod.census(
+                            lvl.step_base, lvl.step_mask, agg,
+                            fail_step,
+                            (
+                                err_coin[:, sl]
+                                if err_coin is not None
+                                else None
+                            ),
+                        )
+                        step_dur = None
+                    else:
+                        step_dur = (
+                            jnp.maximum(lvl.step_base, agg)
+                            * lvl.step_mask
+                        )
             else:
                 # call-free level: busy time is fully static
                 busy = jnp.broadcast_to(lvl.leaf_busy, (n, lvl.size))
@@ -2664,6 +3102,15 @@ class Simulator:
             if lvl.num_children > 0 and step_dur is not None:
                 prefix = jnp.cumsum(step_dur, axis=-1) - step_dur
                 off = prefix.reshape(n, -1)[:, lvl.child_seg]
+                if att_off is not None:
+                    off = off + (
+                        used_lvls[d] * att_off[:, : lvl.num_children]
+                    )
+                off_lvls[d] = off
+            elif lvl.num_children > 0 and dense_excl is not None:
+                # census-kernel path: the fused prefix already carries
+                # the fail/err truncation the masked grid would
+                off = dense_excl.reshape(n, -1)[:, lvl.child_seg]
                 if att_off is not None:
                     off = off + (
                         used_lvls[d] * att_off[:, : lvl.num_children]

@@ -9,7 +9,7 @@ from isotope_tpu.models.generators import (
     with_call_policy,
 )
 from isotope_tpu.models.graph import ServiceGraph
-from isotope_tpu.sim import LoadModel, Simulator
+from isotope_tpu.sim import LoadModel, SimParams, Simulator
 
 
 @pytest.fixture(scope="module")
@@ -42,22 +42,34 @@ def test_10k_simulates_through_scan_path(compiled10k):
 
 def test_star10k_with_timeouts_keeps_sparse_encoding():
     # BASELINE configs[3] names retries/timeouts on the 10k graph; the
-    # star archetype's skewed hub level is exactly where the sparse
-    # call-slot encoding matters (a dense grid block-starves it), and
-    # until r5 finite timeouts forced the dense fallback.  Pin that
-    # the policy-carrying star-10k still lowers to sparse slots.
+    # star archetype's skewed hub level is exactly where the non-dense
+    # step encodings matter (a dense grid block-starves it), and until
+    # r5 finite timeouts forced the dense fallback.  Since PR 6 the
+    # level lowers to the DENSE-BLOCKED tiling: the thousands of
+    # narrow spokes run as dense tiles while the ~2,000-step hubs keep
+    # the true sparse call-slot encoding as the residual — and the
+    # level still carries the finite timeouts.
     doc = with_call_policy(
         realistic_topology(10_000, archetype="star", seed=0),
         timeout="30s",
     )
     sim = Simulator(compile_graph(ServiceGraph.decode(doc)))
-    sparse_lvls = [
-        lvl for lvl in sim._levels if lvl.sparse is not None
+    tiled_lvls = [
+        lvl for lvl in sim._levels if lvl.tiled is not None
     ]
-    assert sparse_lvls, "the star hub level must stay sparse"
-    assert any(lvl.finite_timeout for lvl in sparse_lvls), (
-        "the sparse level itself carries the finite timeouts"
+    assert tiled_lvls, "the star hub level must tile"
+    assert any(
+        lvl.tiled.residual is not None for lvl in tiled_lvls
+    ), "the wide hubs must keep the sparse residual"
+    assert any(lvl.finite_timeout for lvl in tiled_lvls), (
+        "the tiled level itself carries the finite timeouts"
     )
+    # tiling off restores the pure sparse encoding (the pre-PR 6 pin)
+    sim_sp = Simulator(
+        compile_graph(ServiceGraph.decode(doc)),
+        SimParams(sparse_tiling=False),
+    )
+    assert any(lvl.sparse is not None for lvl in sim_sp._levels)
 
 
 def test_100k_generates_and_compiles_host_side():

@@ -49,7 +49,10 @@ services:
 - name: w3
 """
 
-SPARSE = SimParams(sparse_level_elems=1)
+# sparse_tiling=False pins the TRUE sparse call-slot encoding; the
+# dense-blocked tiling that normally mitigates skewed levels first has
+# its own equivalence pins in tests/test_sparse_tiles.py
+SPARSE = SimParams(sparse_level_elems=1, sparse_tiling=False)
 LOAD = LoadModel(kind="open", qps=0.4 / SimParams().cpu_time_s)
 
 
@@ -57,7 +60,9 @@ def both_encodings(yaml_text, load=LOAD, n=20_000, chaos=(), **kw):
     g = ServiceGraph.from_yaml(yaml_text)
     dense = Simulator(compile_graph(g), SimParams(**kw), chaos)
     sparse = Simulator(
-        compile_graph(g), SimParams(sparse_level_elems=1, **kw), chaos
+        compile_graph(g),
+        SimParams(sparse_level_elems=1, sparse_tiling=False, **kw),
+        chaos,
     )
     # the threshold actually flipped the encoding somewhere
     assert all(lvl.sparse is None for lvl in dense._levels)

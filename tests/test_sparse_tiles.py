@@ -1,0 +1,388 @@
+"""Dense-blocked sparse levels (engine._TiledSteps).
+
+Equivalence contract: every TILE runs the dense step-grid ops
+restricted to its rows, so a fully-tiled level is **bit-for-bit
+identical to the dense grid in eager** (and <= 1 f32 ULP under jit —
+XLA fuses the two program shapes differently); the residual part keeps
+the sparse call-slot encoding and inherits its existing ~1 ULP-vs-
+dense contract.  The tiling decision itself lives in
+compiler/buckets.level_encoding and is shared with the vet linter.
+"""
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from isotope_tpu.compiler import compile_graph
+from isotope_tpu.compiler.buckets import (
+    DEFAULT_TILE_PMAX,
+    level_encoding,
+    plan_tiles,
+)
+from isotope_tpu.models.graph import ServiceGraph
+from isotope_tpu.sim import LoadModel, SimParams, Simulator
+from isotope_tpu.sim.config import OPEN_LOOP, ChaosEvent
+
+KEY = jax.random.PRNGKey(7)
+LOAD = LoadModel(kind="open", qps=0.4 / SimParams().cpu_time_s)
+
+# the skewed-level shape: one long mixed script among short/leaf
+# siblings at the same depth (tests/test_sparse.py's fixture)
+SKEWED = """
+services:
+- name: entry
+  isEntrypoint: true
+  script:
+  - [{call: hub}, {call: s0}, {call: s1}, {call: s2}]
+- name: hub
+  script:
+  - sleep: 1ms
+  - call: w0
+  - sleep: 2ms
+  - call: w1
+  - call: w2
+  - sleep: 3ms
+  - call: w3
+- name: s0
+- name: s1
+- name: s2
+- name: w0
+  script: [{sleep: 5ms}]
+- name: w1
+- name: w2
+  script: [{sleep: 1ms}]
+- name: w3
+"""
+
+
+def _sims(yaml_text, chaos=(), tile_pmax=DEFAULT_TILE_PMAX, **kw):
+    g = ServiceGraph.from_yaml(yaml_text)
+    dense = Simulator(compile_graph(g), SimParams(**kw), chaos)
+    tiled = Simulator(
+        compile_graph(g),
+        SimParams(
+            sparse_level_elems=1, sparse_tile_pmax=tile_pmax, **kw
+        ),
+        chaos,
+    )
+    sparse = Simulator(
+        compile_graph(g),
+        SimParams(sparse_level_elems=1, sparse_tiling=False, **kw),
+        chaos,
+    )
+    assert all(lvl.tiled is None for lvl in dense._levels)
+    assert any(lvl.tiled is not None for lvl in tiled._levels)
+    assert any(lvl.sparse is not None for lvl in sparse._levels)
+    return dense, tiled, sparse
+
+
+def _assert_jit_close(ra, rb, rtol):
+    for f in ra._fields:
+        a, b = getattr(ra, f), getattr(rb, f)
+        if a is None or b is None:
+            assert a is None and b is None, f
+            continue
+        a, b = np.asarray(a), np.asarray(b)
+        if a.dtype == bool or np.issubdtype(a.dtype, np.integer):
+            np.testing.assert_array_equal(a, b, err_msg=f)
+        else:
+            np.testing.assert_allclose(
+                a, b, rtol=rtol, atol=1e-9, err_msg=f
+            )
+
+
+def _assert_eager_bitwise(sim_a, sim_b, n=512):
+    args = (KEY, jnp.float32(LOAD.qps), jnp.float32(0.0),
+            jnp.float32(LOAD.qps))
+    ra = sim_a._simulate(n, OPEN_LOOP, 0, False, *args)
+    rb = sim_b._simulate(n, OPEN_LOOP, 0, False, *args)
+    for f in ra._fields:
+        a, b = getattr(ra, f), getattr(rb, f)
+        if a is None or b is None:
+            assert a is None and b is None, f
+            continue
+        np.testing.assert_array_equal(
+            np.asarray(a), np.asarray(b), err_msg=f"eager {f}"
+        )
+
+
+def _check(yaml_text, chaos=(), n=20_000, tile_pmax=DEFAULT_TILE_PMAX,
+           eager_bitwise=True, **kw):
+    dense, tiled, sparse = _sims(
+        yaml_text, chaos=chaos, tile_pmax=tile_pmax, **kw
+    )
+    rd = dense.run(LOAD, n, KEY)
+    rt = tiled.run(LOAD, n, KEY)
+    rs = sparse.run(LOAD, n, KEY)
+    _assert_jit_close(rd, rt, rtol=3e-7)   # dense vs tiled: ~1 ULP
+    _assert_jit_close(rt, rs, rtol=1e-5)   # tiled vs sparse encoding
+    if eager_bitwise:
+        _assert_eager_bitwise(dense, tiled)
+    return dense, tiled, sparse
+
+
+def test_tiled_matches_dense_bitwise_eager():
+    _check(SKEWED)
+
+
+def test_tiled_with_error_rates():
+    _check(
+        SKEWED.replace(
+            "- name: hub\n", "- name: hub\n  errorRate: 30%\n"
+        ).replace("- name: w1\n", "- name: w1\n  errorRate: 20%\n")
+    )
+
+
+def test_tiled_with_send_probability():
+    _check(
+        SKEWED.replace(
+            "  - call: w1\n",
+            "  - call: {service: w1, probability: 60}\n",
+        )
+    )
+
+
+def test_tiled_with_retries():
+    _check(
+        SKEWED.replace(
+            "  - call: w3\n",
+            "  - call: {service: w3, retries: 2}\n",
+        ).replace("- name: w3\n", "- name: w3\n  errorRate: 40%\n")
+    )
+
+
+def test_tiled_with_firing_timeouts():
+    dense, _, _ = _check(
+        SKEWED.replace(
+            "  - call: w0\n",
+            "  - call: {service: w0, timeout: 3ms}\n",
+        )
+    )
+    # the truncation genuinely fires (same evidence as the sparse pin)
+    rd = dense.run(LOAD, 20_000, KEY)
+    assert np.asarray(rd.hop_error)[:, 1].all()
+    sent = np.asarray(rd.hop_sent)
+    assert sent[:, 5].all() and not sent[:, 6:9].any()
+
+
+def test_tiled_with_timeout_retries():
+    _check(
+        SKEWED.replace(
+            "  - call: w1\n",
+            "  - call: {service: w1, timeout: 0.2ms, retries: 2}\n",
+        )
+    )
+
+
+def test_tiled_concurrent_shared_slot_timeout():
+    _check(
+        SKEWED.replace(
+            "  - call: w1\n  - call: w2\n",
+            "  - [{call: {service: w1, timeout: 0.1ms}}, {call: w2}]\n",
+        )
+    )
+
+
+def test_tiled_with_chaos_total():
+    n = 20_000
+    dur = n / LOAD.qps
+    _check(
+        SKEWED,
+        chaos=(
+            ChaosEvent(
+                service="w2",
+                start_s=0.25 * dur,
+                end_s=0.75 * dur,
+                replicas_down=None,
+            ),
+        ),
+        n=n,
+    )
+
+
+def test_residual_sparse_engages_past_tile_cap():
+    """A tile cap below the hub's width forces the hub onto the
+    residual sparse path; tiles + residual still match dense to the
+    sparse contract's tolerance (the residual's cumsum ordering is
+    the sparse encoding's, not the dense grid's)."""
+    dense, tiled, _ = _sims(SKEWED, tile_pmax=4)
+    tl = [lvl.tiled for lvl in tiled._levels if lvl.tiled is not None]
+    assert tl and tl[0].residual is not None
+    assert len(tl[0].res_hops) == 1  # the hub
+    rd = dense.run(LOAD, 20_000, KEY)
+    rt = tiled.run(LOAD, 20_000, KEY)
+    _assert_jit_close(rd, rt, rtol=1e-5)
+
+
+def test_residual_with_firing_timeout():
+    dense, tiled, sparse = _sims(
+        SKEWED.replace(
+            "  - call: w0\n",
+            "  - call: {service: w0, timeout: 3ms}\n",
+        ),
+        tile_pmax=4,
+    )
+    assert any(
+        lvl.tiled is not None and lvl.tiled.residual is not None
+        for lvl in tiled._levels
+    )
+    rd = dense.run(LOAD, 20_000, KEY)
+    rt = tiled.run(LOAD, 20_000, KEY)
+    rs = sparse.run(LOAD, 20_000, KEY)
+    _assert_jit_close(rd, rt, rtol=1e-5)
+    _assert_jit_close(rt, rs, rtol=1e-5)
+    np.testing.assert_array_equal(
+        np.asarray(rd.hop_sent), np.asarray(rt.hop_sent)
+    )
+
+
+def test_callfree_wide_hop_in_residual():
+    """A pure-sleep script wider than the tile cap lands in the
+    residual with ZERO call slots; with a firing timeout elsewhere in
+    the level (transport machinery armed level-wide) the static-busy
+    guard must hold and match the dense grid."""
+    yaml_text = """
+services:
+- name: entry
+  isEntrypoint: true
+  script:
+  - [{call: hub}, {call: slow}, {call: s0}, {call: s1}, {call: s2},
+     {call: s3}, {call: s4}]
+- name: hub
+  script:
+  - sleep: 1ms
+  - call: {service: w0, timeout: 3ms}
+  - call: w1
+- name: slow
+  script:
+  - sleep: 1ms
+  - sleep: 1ms
+  - sleep: 1ms
+  - sleep: 1ms
+  - sleep: 1ms
+  - sleep: 1ms
+- name: s0
+- name: s1
+- name: s2
+- name: s3
+- name: s4
+- name: w0
+  script: [{sleep: 5ms}]
+- name: w1
+"""
+    dense, tiled, sparse = _sims(yaml_text, tile_pmax=3)
+    tl = [lvl.tiled for lvl in tiled._levels if lvl.tiled is not None]
+    assert tl and tl[0].residual is not None
+    assert tl[0].residual.n_slots == 0  # the pure-sleep 'slow' hop
+    rd = dense.run(LOAD, 8_192, KEY)
+    rt = tiled.run(LOAD, 8_192, KEY)
+    _assert_jit_close(rd, rt, rtol=1e-5)
+    # the hub's timeout genuinely fires while 'slow' still runs whole
+    assert np.asarray(rd.hop_error)[:, 1].all()
+
+
+def test_deterministic_exact_latency_through_tiles():
+    """Quiet-load deterministic run: the tiled hub's latency is the
+    exact sum of its steps (the sparse fixture's arithmetic pin)."""
+    g = ServiceGraph.from_yaml(SKEWED)
+    p = SimParams(
+        sparse_level_elems=1, service_time="deterministic"
+    )
+    sim = Simulator(compile_graph(g), p)
+    assert any(lvl.tiled is not None for lvl in sim._levels)
+    res = sim.run(LoadModel(kind="open", qps=0.001), 8, KEY)
+    cpu = p.cpu_time_s
+    net = p.network.one_way(0.0)
+    hub = (
+        0.001 + 0.002 + 0.003
+        + (2 * net + cpu + 0.005)
+        + (2 * net + cpu)
+        + (2 * net + cpu + 0.001)
+        + (2 * net + cpu)
+        + cpu
+    )
+    total = 2 * net + cpu + max(2 * net + hub, 2 * net + cpu)
+    np.testing.assert_allclose(
+        np.asarray(res.client_latency), total, rtol=1e-5
+    )
+
+
+def test_summary_scan_path_through_tiles():
+    _, tiled, sparse = _sims(SKEWED)
+    s1 = tiled.run_summary(LOAD, 4096, KEY, block_size=1024)
+    s2 = sparse.run_summary(LOAD, 4096, KEY, block_size=1024)
+    assert float(s1.count) == float(s2.count)
+    assert float(s1.hop_events) == float(s2.hop_events)
+    assert float(s1.error_count) == float(s2.error_count)
+    np.testing.assert_allclose(
+        float(s1.latency_sum), float(s2.latency_sum), rtol=1e-6
+    )
+
+
+def test_attribution_oblivious_to_tiling():
+    """The blame sweep reads only assembled (N, H) outputs, so an
+    attributed tiled run reproduces the sparse engine's blame."""
+    g = ServiceGraph.from_yaml(SKEWED)
+    pt = SimParams(sparse_level_elems=1, attribution=True)
+    ps = dataclasses.replace(pt, sparse_tiling=False)
+    st = Simulator(compile_graph(g), pt)
+    ss = Simulator(compile_graph(g), ps)
+    assert any(lvl.tiled is not None for lvl in st._levels)
+    _, at = st.run_attributed(LOAD, 2048, KEY, block_size=512)
+    _, as_ = ss.run_attributed(LOAD, 2048, KEY, block_size=512)
+    assert float(at.count) == float(as_.count)
+    np.testing.assert_allclose(
+        np.asarray(at.wait_blame, np.float64),
+        np.asarray(as_.wait_blame, np.float64),
+        rtol=1e-5, atol=1e-9,
+    )
+    assert float(at.residual_abs) / float(at.count) < 1e-6
+
+
+# ---------------------------------------------------------------------------
+# planner unit tests (compiler/buckets.plan_tiles / level_encoding)
+
+
+def test_plan_tiles_bins_by_width_class():
+    widths = np.asarray([1] * 100 + [3] * 10 + [40] * 2 + [2000])
+    plan = plan_tiles(widths, cap=64, waste=1.6)
+    assert list(plan.residual) == [112]  # the 2000-step hub
+    sizes = dict(plan.shapes())
+    # the 100 single-step hops tile at width 1 (padding a 1-wide hop
+    # to 3 would bust the 1.6x budget across 100 rows)
+    assert (100, 1) in plan.shapes()
+    assert plan.tiled_elems < 0.2 * len(widths) * 2000
+    assert sizes  # non-empty
+    covered = sorted(
+        np.concatenate([idx for _, idx in plan.tiles]).tolist()
+        + list(plan.residual)
+    )
+    assert covered == list(range(len(widths)))
+
+
+def test_level_encoding_decision_points():
+    widths = np.asarray([1] * 999 + [500])
+    # tight grid: stays dense
+    enc, _ = level_encoding(
+        4, 2, 8, np.asarray([2, 2, 2, 2]),
+        sparse_level_elems=262_144,
+    )
+    assert enc == "dense"
+    # skewed + tiling on: tiles
+    enc, plan = level_encoding(
+        1000, 500, 1499, widths, sparse_level_elems=1,
+    )
+    assert enc == "tiled" and plan is not None
+    assert len(plan.residual) == 1
+    # tiling off: the true sparse encoding
+    enc, plan = level_encoding(
+        1000, 500, 1499, widths, sparse_level_elems=1, tiling=False,
+    )
+    assert enc == "sparse" and plan is None
+    # a single wide mostly-sleep hop: every hop is past the tile cap,
+    # tiling saves nothing — the true sparse encoding keeps the level
+    enc, plan = level_encoding(
+        1, 500, 10, np.asarray([500]), sparse_level_elems=1,
+    )
+    assert enc == "sparse" and plan is None

@@ -31,6 +31,10 @@ threshold (unset = not gated), compared per case over the
   pass (``vet_errors`` in the telemetry block — bench runs the
   no-trace vet per case) reports MORE errors than the previous
   capture's; captures without vet data on either side are skipped.
+- ``BENCH_REGRESS_SPREAD_THRESHOLD``: relative spread bound on
+  ``<case>_spread`` — a case past it that also got noisier than the
+  previous capture fails (keeps bench.py's steady-state warmup
+  discipline from silently regressing);
 - ``BENCH_REGRESS_BLAME_THRESHOLD``: ABSOLUTE per-service drift
   allowed on the critical-path blame shares (``<case>_blame`` blocks
   from bench's attributed probe), e.g. ``0.1`` = 10 share points; a
@@ -97,7 +101,7 @@ def _cases(doc: dict, prefer_best: bool = False) -> dict:
         if not isinstance(v, (int, float)):
             continue
         if k.endswith(("_inflight", "_spread", "_census", "_best",
-                       "_compile_s")):
+                       "_compile_s", "_warmup_windows")):
             continue  # evidence / variance keys, not rates
         cases[k] = float(v)
     if prefer_best:
@@ -259,6 +263,41 @@ def blame_failures(prev_doc: dict, new_doc: dict) -> list:
     return failures
 
 
+def spread_failures(prev_doc: dict, new_doc: dict) -> list:
+    """Opt-in gate (``BENCH_REGRESS_SPREAD_THRESHOLD=<ratio>``): a case
+    whose window-to-window relative spread (``<case>_spread``) exceeds
+    the threshold AND got noisier than the previous capture regressed.
+
+    This keeps noise fixes fixed: once a case's steady-state discipline
+    (bench.py warmup windows) brings its spread under the threshold, a
+    later change that re-noises it fails the round — deltas measured
+    through a 25% spread cannot clear the 15% rate gate honestly.  A
+    case already past the threshold in the baseline only fails when it
+    gets WORSE (no permanent alarm on known-noisy cases).
+    """
+    raw = os.environ.get("BENCH_REGRESS_SPREAD_THRESHOLD")
+    if raw is None or raw == "":
+        return []
+    thr = float(raw)
+    prev_extra = prev_doc.get("extra", {})
+    new_extra = new_doc.get("extra", {})
+    failures = []
+    for k, v in sorted(new_extra.items()):
+        if not k.endswith("_spread") or not isinstance(v, (int, float)):
+            continue
+        case = k[: -len("_spread")]
+        old = prev_extra.get(k)
+        old_ok = isinstance(old, (int, float))
+        bad = float(v) > thr and (not old_ok or float(v) > float(old))
+        verdict = "REGRESSION" if bad else "OK"
+        prev_txt = f"{float(old):.3f}" if old_ok else "n/a"
+        print(f"bench_regress: {case}.spread: {prev_txt} -> "
+              f"{float(v):.3f} (threshold {thr:.3f}) {verdict}")
+        if bad:
+            failures.append(f"{case}.spread")
+    return failures
+
+
 def degradation_failures(prev_doc: dict, new_doc: dict) -> list:
     """Always-armed gate: a case that DEGRADED in the new capture but
     ran clean in the previous round is a regression.
@@ -356,6 +395,7 @@ def main() -> int:
     failures.extend(degradation_failures(prev_doc, new_doc))
     failures.extend(vet_failures(prev_doc, new_doc))
     failures.extend(blame_failures(prev_doc, new_doc))
+    failures.extend(spread_failures(prev_doc, new_doc))
     if failures:
         print(f"bench_regress: FAIL vs {prev_path}: "
               f"{', '.join(failures)} regressed >"
