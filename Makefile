@@ -7,9 +7,9 @@ QPS ?= 1000
 DURATION ?= 120s
 
 .PHONY: test lint vet-smoke bench telemetry-smoke resilience-smoke \
-	attribution-smoke sparse-smoke examples canonical tree star \
-	multitier auxiliary-services star-auxiliary latency cpu_mem dot \
-	clean
+	attribution-smoke sparse-smoke timeline-smoke examples canonical \
+	tree star multitier auxiliary-services star-auxiliary latency \
+	cpu_mem dot clean
 
 test:
 	$(PY) -m pytest tests/ -x -q
@@ -117,6 +117,39 @@ attribution-smoke:
 		assert {'tail_rank', 'tail_cut_s'} <= tags, tags; \
 		print('attribution-smoke: blame sums to 1, flamegraph parses,', \
 		      len(ex['data']), 'exemplar trace(s) validate')"
+
+# flight-recorder end-to-end check: the timeline subcommand records a
+# short run into windowed series, then the artifacts are validated —
+# window counts reconciling with the run total, the timestamped
+# Prometheus exposition parsing (with timestamps) and round-tripping
+# through the query layer, and per-window alarm rows carrying sim-time
+# stamps.
+timeline-smoke:
+	rm -f /tmp/isotope_tl.json /tmp/isotope_tl.prom \
+		/tmp/isotope_tl_monitor.jsonl
+	$(PY) -m isotope_tpu timeline examples/topologies/tree-13-services.yaml \
+		--qps 200 --duration 6s --load-kind open --max-requests 1024 \
+		--window 1s --out /tmp/isotope_tl.json \
+		--prometheus /tmp/isotope_tl.prom \
+		--alarms --alarm-sink /tmp/isotope_tl_monitor.jsonl \
+		> /dev/null
+	$(PY) -c "import json; \
+		doc = json.load(open('/tmp/isotope_tl.json')); \
+		assert doc['schema'] == 'isotope-timeline/v1', doc['schema']; \
+		wins = doc['windows']; \
+		total = sum(w['arrivals'] for w in wins); \
+		assert total == doc['count'], (total, doc['count']); \
+		assert doc['services'], 'no service series'; \
+		from isotope_tpu.metrics.query import MetricStore; \
+		store = MetricStore.from_text(open('/tmp/isotope_tl.prom').read(), 1.0); \
+		v = store.query_value('timeline_client_requests_total'); \
+		assert v == total, (v, total); \
+		from isotope_tpu.metrics.monitor import MonitorSink; \
+		rows = MonitorSink('/tmp/isotope_tl_monitor.jsonl').read(); \
+		assert rows and all(r.window_index is not None for r in rows), \
+			rows[:2]; \
+		print('timeline-smoke:', len(wins), 'windows reconcile,', \
+		      len(rows), 'window-stamped monitor rows')"
 
 # sparse-executor end-to-end check: force the non-dense encodings
 # (sparse_level_elems lowered) on a small star graph, run the dense /

@@ -34,6 +34,12 @@ from isotope_tpu.analysis.findings import (
 
 ENV_DEVICE_BYTES = "ISOTOPE_VET_DEVICE_BYTES"
 
+#: share of device capacity the timeline recorder's O(S x W) carries
+#: may take before VET-M003 reports them (informational — the window
+#: planner clamps instead of OOMing)
+ENV_TIMELINE_SHARE = "ISOTOPE_VET_TIMELINE_SHARE"
+DEFAULT_TIMELINE_SHARE = 0.10
+
 #: fraction of reported device capacity the estimate may fill — XLA
 #: needs headroom for fusion temporaries and the allocator never packs
 #: perfectly
@@ -274,6 +280,39 @@ def device_capacity_bytes(override: Optional[float] = None
     return None
 
 
+def timeline_bytes(sim, num_windows: Optional[int] = None) -> float:
+    """Worst-case bytes of the flight recorder's windowed carries
+    (metrics/timeline.py): the per-service (S, W) series (5 fields),
+    the client (W,) series, and the (W, 64) latency histogram.  The
+    recorder accumulates these in the scan CARRY (one persistent copy,
+    independent of the block count — timeline.zeros_summary), so this
+    IS the run-long device footprint, not a per-block term that
+    multiplies.  Zero when ``SimParams.timeline`` is off.
+
+    ``num_windows`` defaults to the planner's worst case —
+    ``timeline_max_windows`` clamped by the recorder's element budget
+    — exactly the bound the run-time planner enforces."""
+    params = sim.params
+    if not getattr(params, "timeline", False):
+        return 0.0
+    from isotope_tpu.metrics.timeline import (
+        ELEM_BUDGET,
+        NUM_BLAME_BUCKETS,
+    )
+
+    s = max(sim.compiled.num_services, 1)
+    w = (
+        int(num_windows)
+        if num_windows
+        else max(
+            1,
+            min(int(params.timeline_max_windows), ELEM_BUDGET // s),
+        )
+    )
+    elems = 5 * s * w + 4 * w + w * NUM_BLAME_BUCKETS
+    return 4.0 * elems
+
+
 @dataclasses.dataclass(frozen=True)
 class CostEstimate:
     """The pre-flight verdict for one planned run."""
@@ -286,6 +325,9 @@ class CostEstimate:
     critical_path: int
     segments: List[dict]
     capacity_bytes: Optional[float]
+    # flight-recorder carry bytes (0 when SimParams.timeline is off);
+    # already included in peak_bytes_at_block
+    timeline_bytes: float = 0.0
 
     def to_dict(self) -> dict:
         d = dataclasses.asdict(self)
@@ -323,16 +365,47 @@ def estimate_run(
         peak = 10.0 * 4.0 * block_requests * h
         flops = plan_bytes / 4.0  # ~1 flop per touched element
         depth = len(segments)
+    # the flight recorder's O(S x W) carries ride the scan next to the
+    # event tensors (the traced plain program doesn't contain them)
+    tl_bytes = timeline_bytes(sim)
     return CostEstimate(
         block_requests=int(block_requests),
         trace_requests=int(trace_requests),
         jaxpr=jc,
-        peak_bytes_at_block=float(peak),
+        peak_bytes_at_block=float(peak) + tl_bytes,
         flops_at_block=float(flops),
         critical_path=int(depth),
         segments=segments,
         capacity_bytes=device_capacity_bytes(capacity_override),
+        timeline_bytes=tl_bytes,
     )
+
+
+def timeline_findings(estimate: CostEstimate) -> List[Finding]:
+    """The VET-M003 info verdict: the recorder's windowed carries take
+    more than the configured share of device capacity.
+
+    Informational by design — the run-time window planner clamps the
+    window count (widening windows, with a warning) instead of OOMing,
+    so the finding documents the pressure rather than blocking."""
+    from isotope_tpu.analysis.findings import SEV_INFO
+
+    tl = estimate.timeline_bytes
+    cap = estimate.capacity_bytes
+    if tl <= 0 or cap is None or cap <= 0:
+        return []
+    share_env = os.environ.get(ENV_TIMELINE_SHARE, "").strip()
+    share = float(share_env) if share_env else DEFAULT_TIMELINE_SHARE
+    if tl <= share * cap:
+        return []
+    return [Finding(
+        "VET-M003", SEV_INFO,
+        f"timeline recorder carries {tl:.3g} B exceed "
+        f"{share:.0%} of the {cap:.3g} B device capacity; the window "
+        "planner will clamp the window count (widening windows) — "
+        "lower SimParams.timeline_max_windows or widen "
+        "timeline_window_s to silence",
+    )]
 
 
 def memory_findings(

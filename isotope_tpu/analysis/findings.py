@@ -65,6 +65,9 @@ RULES: Dict[str, str] = {
                 "on-device ladder rung (predictable OOM)",
     "VET-M002": "memory estimate exceeds device capacity at the default "
                 "rung; the resilience ladder should start degraded",
+    "VET-M003": "timeline recorder carries (O(services x windows) per "
+                "scan block) take a large share of device capacity; "
+                "the window planner will clamp or widen windows",
 }
 
 

@@ -132,6 +132,7 @@ def vet_simulator(
             est, rung_names=rung_names,
         )
         report.extend(mem_findings)
+        report.extend(costmodel.timeline_findings(est))
         report.meta["cost"] = {
             "block_requests": est.block_requests,
             "flops_at_block": est.flops_at_block,
@@ -139,6 +140,7 @@ def vet_simulator(
             "critical_path": est.critical_path,
             "capacity_bytes": est.capacity_bytes,
             "num_segments": len(est.segments),
+            "timeline_bytes": est.timeline_bytes,
         }
         # the engine's chosen bucket schedule, ranked by per-segment
         # critical-path cost (``vet --json`` surfaces it verbatim)

@@ -17,6 +17,7 @@ def register_all(sub) -> None:
         simulate_cmd,
         suite_cmd,
         telemetry_cmd,
+        timeline_cmd,
         vet_cmd,
     )
 
@@ -24,4 +25,5 @@ def register_all(sub) -> None:
     suite_cmd.register(sub)
     fidelity_cmd.register(sub)
     telemetry_cmd.register(sub)
+    timeline_cmd.register(sub)
     vet_cmd.register(sub)

@@ -56,6 +56,28 @@ def _add_attribution_args(parser) -> None:
              "exemplars")
 
 
+def _add_timeline_args(parser) -> None:
+    """The flight-recorder knobs (metrics/timeline.py), shared by
+    simulate and sweep."""
+    parser.add_argument(
+        "--timeline", nargs="?", const="10s", default=None,
+        metavar="WINDOW",
+        help="simulation flight recorder: after the main run, a "
+             "timeline pass (identical request streams) bins every "
+             "hop event into fixed sim-time windows on device and "
+             "reports per-service x per-window series (throughput, "
+             "errors, in-flight, queue depth, utilization) plus the "
+             "convoy detector.  Optional value = window width "
+             "(default 10s)")
+
+
+def _timeline_window(args):
+    """The ``--timeline`` window in seconds, or None when off."""
+    if args.timeline is None:
+        return None
+    return dur.parse_duration_seconds(args.timeline)
+
+
 def _add_vet_arg(parser) -> None:
     """The static pre-flight gate (analysis/), shared by every
     run-executing subcommand."""
@@ -147,6 +169,18 @@ def register(sub) -> None:
                         "annotated spans; no dense re-run)")
     s.add_argument("--exemplar-format", choices=["chrome", "jaeger"],
                    default="jaeger")
+    _add_timeline_args(s)
+    s.add_argument("--timeline-out", metavar="FILE", default=None,
+                   help="write the windowed series as JSON "
+                        "(isotope-timeline/v1)")
+    s.add_argument("--timeline-perfetto", metavar="FILE", default=None,
+                   help="write the windowed series as Perfetto/Chrome "
+                        "counter tracks over real sim time")
+    s.add_argument("--timeline-prometheus", metavar="FILE",
+                   default=None,
+                   help="write the timestamped Prometheus exposition "
+                        "(one sample per window, like a scrape "
+                        "sequence)")
     _add_resilience_args(s)
     _add_vet_arg(s)
     s.set_defaults(func=run_simulate)
@@ -198,6 +232,7 @@ def register(sub) -> None:
                         "plus <out>/telemetry.jsonl ('detail' adds "
                         "segment fences — diagnosis, not benchmarking)")
     _add_attribution_args(w)
+    _add_timeline_args(w)
     _add_resilience_args(w)
     _add_vet_arg(w)
     w.set_defaults(func=run_sweep)
@@ -263,6 +298,7 @@ def run_simulate(args) -> int:
         extra["service_time_param"] = args.service_time_param
     elif args.service_time == "pareto":
         extra["service_time_param"] = 1.5  # a sane heavy-tail default
+    tl_window = _timeline_window(args)
     config = ExperimentConfig(
         topology_paths=(args.topology,),
         environments=(DEFAULT_ENVIRONMENTS[args.environment],),
@@ -276,11 +312,13 @@ def run_simulate(args) -> int:
         service_time=args.service_time,
         entry=args.entry,
         attribution=args.attribution is not None,
+        timeline=tl_window is not None,
         **extra,
     )
     (result,) = run_experiment(config, policy=_policy(args),
                                vet=args.vet,
-                               attribution=args.attribution)
+                               attribution=args.attribution,
+                               timeline=tl_window)
     if result.failed:
         print(f"error: run failed: {result.error}", file=sys.stderr)
         return 1
@@ -297,6 +335,13 @@ def run_simulate(args) -> int:
     elif args.attribution:
         print(
             "warning: attribution pass produced no blame document",
+            file=sys.stderr,
+        )
+    if tl_window is not None and result.timeline is not None:
+        _write_timeline_artifacts(args, result)
+    elif tl_window is not None:
+        print(
+            "warning: timeline pass produced no windowed series",
             file=sys.stderr,
         )
     doc = result.flat if args.flat else result.fortio_json
@@ -394,6 +439,42 @@ def _write_attribution_artifacts(args, result) -> None:
               f"{args.exemplar_trace}", file=sys.stderr)
 
 
+def _write_timeline_artifacts(args, result) -> None:
+    """The flight recorder's artifacts (simulate-only flags): the
+    per-window table on stderr, plus the JSON / Perfetto / timestamped
+    Prometheus files when requested."""
+    from isotope_tpu.metrics import timeline as timeline_mod
+
+    print(timeline_mod.format_table(result.timeline), file=sys.stderr)
+    if args.timeline_out:
+        with open(args.timeline_out, "w") as f:
+            json.dump(result.timeline, f, indent=2)
+        print(f"timeline -> {args.timeline_out}", file=sys.stderr)
+    needs_summary = args.timeline_perfetto or args.timeline_prometheus
+    if not needs_summary:
+        return
+    tl = result.timeline_summary
+    compiled = result.compiled
+    if tl is None or compiled is None:
+        print(
+            "warning: timeline summary unavailable; perfetto/"
+            "prometheus artifacts skipped",
+            file=sys.stderr,
+        )
+        return
+    if args.timeline_perfetto:
+        from isotope_tpu.metrics.export import write_timeline_perfetto
+
+        n = write_timeline_perfetto(args.timeline_perfetto, compiled, tl)
+        print(f"timeline counters ({n} events) -> "
+              f"{args.timeline_perfetto}", file=sys.stderr)
+    if args.timeline_prometheus:
+        with open(args.timeline_prometheus, "w") as f:
+            f.write(timeline_mod.prometheus_text(compiled, tl))
+        print(f"timestamped exposition -> {args.timeline_prometheus}",
+              file=sys.stderr)
+
+
 def run_check(args) -> int:
     _require_jax()
     import pathlib
@@ -479,6 +560,15 @@ def run_sweep(args) -> int:
     config = load_toml(args.config)
     if args.attribution and not config.attribution:
         config = dataclasses.replace(config, attribution=True)
+    tl_window = _timeline_window(args)
+    if tl_window is None and config.timeline:
+        # [sim] timeline = true in the TOML arms the pass without a
+        # CLI flag
+        tl_window = config.timeline_window_s
+    if tl_window is not None and not config.timeline:
+        config = dataclasses.replace(
+            config, timeline=True, timeline_window_s=tl_window
+        )
     results = run_experiment(
         config,
         out_dir=args.out,
@@ -489,6 +579,7 @@ def run_sweep(args) -> int:
         policy=_policy(args),
         vet=args.vet,
         attribution=args.attribution,
+        timeline=tl_window,
     )
     discarded = [r.label for r in results if r.window.discarded]
     failed = [r.label for r in results if r.failed]

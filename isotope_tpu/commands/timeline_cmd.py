@@ -1,0 +1,212 @@
+"""``isotope-tpu timeline`` — the simulation flight recorder probe.
+
+Runs one labeled simulation with the windowed recorder armed
+(metrics/timeline.py) and reports the run as a TIME SERIES instead of
+an end-of-run aggregate: per-window client throughput/error/latency
+rows, per-service utilization / queue-depth sparklines, the convoy
+detector's entry-wait-vs-leaf-busy correlation, and (per window) the
+standard stability alarms so an SLO breach gets a sim-time ONSET.
+
+``--controlplane N:M:P`` co-simulates an istiod config push (N
+ServiceEntries x M endpoints to P proxies, sim/controlplane.py) and
+projects its convergence events onto the same window axis, so
+config-push and data-plane timelines compose.
+"""
+from __future__ import annotations
+
+import json
+import sys
+
+from isotope_tpu.utils import duration as dur
+
+
+def register(sub) -> None:
+    t = sub.add_parser(
+        "timeline",
+        help="record a run as windowed per-service time series",
+    )
+    t.add_argument("topology", help="path to the service graph YAML")
+    t.add_argument("--qps", default="1000",
+                   help='target QPS, or "max" (fortio -qps max)')
+    t.add_argument("--connections", "-c", type=int, default=64)
+    t.add_argument("--duration", "-t", default="240s",
+                   help='run duration, e.g. "240s" or "5m"')
+    t.add_argument("--load-kind", choices=["open", "closed"],
+                   default="open")
+    t.add_argument("--max-requests", type=int, default=200_000)
+    t.add_argument("--window", "-w", default="10s",
+                   help="sim-time window width (the scrape interval)")
+    t.add_argument("--seed", type=int, default=0)
+    t.add_argument("--entry", default=None,
+                   help="entrypoint service override")
+    t.add_argument("--out", metavar="FILE", default=None,
+                   help="write the isotope-timeline/v1 JSON artifact")
+    t.add_argument("--perfetto", metavar="FILE", default=None,
+                   help="write Perfetto/Chrome counter tracks over "
+                        "real sim time")
+    t.add_argument("--prometheus", metavar="FILE", default=None,
+                   help="write the timestamped Prometheus exposition "
+                        "(one sample per window)")
+    t.add_argument("--json", action="store_true",
+                   help="print the timeline doc as JSON instead of "
+                        "the table")
+    t.add_argument("--alarms", action="store_true",
+                   help="evaluate the standard stability alarms per "
+                        "window and report the first breach's sim-time "
+                        "onset")
+    t.add_argument("--alarm-sink", metavar="FILE", default=None,
+                   help="append per-window MonitorStatus rows to this "
+                        "JSONL sink (with --alarms)")
+    # NOTE the semantics: the per-window CPU series is the BUSY
+    # OCCUPANCY integral (server-side time incl. script sleeps and
+    # downstream blocking), an upper bound on CPU burn — so the
+    # defaults are sized in occupancy terms (one full core per
+    # service), NOT the reference's 50-milli-core vCPU budget, which
+    # would fire on any service >5% busy
+    t.add_argument("--cpu-limit", type=float, default=1000.0,
+                   help="per-service CPU-OCCUPANCY alarm threshold, "
+                        "milli-cores (busy time incl. sleeps and "
+                        "downstream blocking; default = 1 core)")
+    t.add_argument("--mem-limit", type=float, default=1024.0,
+                   help="per-service working-set alarm threshold, MiB")
+    t.add_argument("--controlplane", metavar="N:M:P", default=None,
+                   help="co-simulate a config push (N entries x M "
+                        "endpoints to P proxies) onto the same window "
+                        "axis")
+    t.set_defaults(func=run_timeline_cmd)
+
+
+def run_timeline_cmd(args) -> int:
+    try:
+        import jax
+    except ModuleNotFoundError as e:
+        raise ValueError(
+            "the timeline command needs jax, which is not installed "
+            "in this environment"
+        ) from e
+
+    import dataclasses
+
+    from isotope_tpu.compiler import compile_graph
+    from isotope_tpu.metrics import timeline as timeline_mod
+    from isotope_tpu.models.graph import ServiceGraph
+    from isotope_tpu.sim.config import LoadModel, SimParams
+    from isotope_tpu.sim.engine import Simulator
+
+    window_s = dur.parse_duration_seconds(args.window)
+    compiled = compile_graph(
+        ServiceGraph.from_yaml_file(args.topology), entry=args.entry
+    )
+    sim = Simulator(
+        compiled,
+        dataclasses.replace(
+            SimParams(), timeline=True, timeline_window_s=window_s
+        ),
+    )
+    qps = None if args.qps == "max" else float(args.qps)
+    load = LoadModel(
+        kind=args.load_kind,
+        qps=qps,
+        connections=args.connections,
+        duration_s=dur.parse_duration_seconds(args.duration),
+    )
+    rate = qps if qps is not None else sim.capacity_qps()
+    n = max(1, min(int(rate * load.duration_s), args.max_requests))
+    _, tl = sim.run_timeline(
+        load, n, jax.random.PRNGKey(args.seed),
+        block_size=sim.default_block_size(),
+    )
+    jax.block_until_ready(tl.count)
+
+    controlplane = None
+    if args.controlplane:
+        from isotope_tpu.sim.controlplane import (
+            PilotModel,
+            push_convergence,
+        )
+
+        try:
+            n_e, m_e, p_e = (
+                int(x) for x in args.controlplane.split(":")
+            )
+        except ValueError:
+            raise ValueError(
+                f"--controlplane wants N:M:P integers, got "
+                f"{args.controlplane!r}"
+            )
+        conv = push_convergence(
+            PilotModel(), n_e, m_e, p_e,
+            key=jax.random.PRNGKey(args.seed),
+        )
+        controlplane = conv.window_series(
+            float(tl.window_s), tl.num_windows
+        )
+
+    doc = timeline_mod.to_doc(
+        compiled, tl, controlplane=controlplane
+    )
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(doc, f, indent=2)
+        print(f"timeline -> {args.out}", file=sys.stderr)
+    if args.perfetto:
+        from isotope_tpu.metrics.export import write_timeline_perfetto
+
+        ev = write_timeline_perfetto(args.perfetto, compiled, tl)
+        print(f"timeline counters ({ev} events) -> {args.perfetto}",
+              file=sys.stderr)
+    if args.prometheus:
+        with open(args.prometheus, "w") as f:
+            f.write(timeline_mod.prometheus_text(compiled, tl))
+        print(f"timestamped exposition -> {args.prometheus}",
+              file=sys.stderr)
+
+    if args.json:
+        json.dump(doc, sys.stdout, indent=2)
+        sys.stdout.write("\n")
+    else:
+        print(timeline_mod.format_table(doc))
+        if controlplane is not None:
+            frac = controlplane["converged_fraction"]
+            print(
+                f"controlplane: push converged in window "
+                f"{controlplane['converged_window']} "
+                f"({controlplane['proxies']} proxies) "
+                f"{timeline_mod.sparkline(frac)}"
+            )
+
+    rc = 0
+    if args.alarms:
+        import pathlib
+
+        from isotope_tpu.metrics import monitor
+        from isotope_tpu.metrics.alarms import standard_queries
+
+        label = pathlib.Path(args.topology).stem
+        queries = standard_queries(
+            label, cpu_lim=args.cpu_limit, mem_lim=args.mem_limit
+        )
+        rows = monitor.evaluate_windows(
+            queries, timeline_mod.window_stores(compiled, tl),
+            run_label=label,
+        )
+        if args.alarm_sink:
+            monitor.MonitorSink(args.alarm_sink).write(rows)
+            print(f"{len(rows)} monitor rows -> {args.alarm_sink}",
+                  file=sys.stderr)
+        onset = monitor.first_alarm_onset(rows)
+        n_alarms = sum(
+            1 for r in rows if r.status == monitor.STATUS_ALARM
+        )
+        if onset is not None:
+            print(
+                f"ALARM onset at window {onset.window_index} "
+                f"(t={onset.sim_time_s:g}s): {onset.monitor} = "
+                f"{onset.value:g} ({n_alarms} alarming "
+                f"window-checks total)",
+                file=sys.stderr,
+            )
+            rc = 1
+        else:
+            print("alarms: all windows clean", file=sys.stderr)
+    return rc

@@ -16,7 +16,11 @@ existing analysis keeps working (SURVEY.md §5.5):
 - on-device critical-path blame attribution (per-service wait/self/
   wire/timeout decomposition, conditional tail histograms, top-K
   exemplar mining) — see :mod:`isotope_tpu.metrics.attribution`
-  (imported lazily; attribution-off paths never touch it).
+  (imported lazily; attribution-off paths never touch it);
+- the simulation flight recorder (per-service x per-window throughput
+  / occupancy series binned on device, timestamped expositions,
+  convoy detection) — see :mod:`isotope_tpu.metrics.timeline`
+  (imported lazily; timeline-off paths never touch it).
 """
 from isotope_tpu.metrics.prometheus import (
     DURATION_BUCKETS,

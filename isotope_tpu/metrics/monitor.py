@@ -15,7 +15,7 @@ from __future__ import annotations
 import dataclasses
 import json
 import pathlib
-from typing import List, Sequence
+from typing import Iterable, List, Optional, Sequence, Tuple
 
 from isotope_tpu.metrics.alarms import Query
 from isotope_tpu.metrics.query import MetricStore
@@ -28,13 +28,22 @@ STATUS_INCONCLUSIVE = "INCONCLUSIVE"
 @dataclasses.dataclass(frozen=True)
 class MonitorStatus:
     """One check outcome (webhook.go's Spanner row shape: monitor name,
-    status, detail, and the observed value)."""
+    status, detail, and the observed value).
+
+    ``window_index`` / ``sim_time_s`` localize a per-window evaluation
+    (the timeline recorder's scrape sequence) on the sim-time axis —
+    an SLO breach gets an ONSET, not just a run-level verdict.  Legacy
+    run-level rows leave both ``None``; JSONL rows written before the
+    fields existed read back with the same defaults.
+    """
 
     monitor: str
     status: str
     value: float
     detail: str
     run_label: str = ""
+    window_index: Optional[int] = None
+    sim_time_s: Optional[float] = None
 
     def to_json(self) -> str:
         return json.dumps(dataclasses.asdict(self))
@@ -44,8 +53,13 @@ def evaluate(
     queries: Sequence[Query],
     store: MetricStore,
     run_label: str = "",
+    window_index: Optional[int] = None,
+    sim_time_s: Optional[float] = None,
 ) -> List[MonitorStatus]:
-    """Evaluate every check, re-querying to confirm alarms."""
+    """Evaluate every check, re-querying to confirm alarms.
+
+    ``window_index`` / ``sim_time_s`` stamp every produced row when the
+    store covers one timeline window instead of a whole run."""
     rows: List[MonitorStatus] = []
     for q in queries:
         if q.running_query is not None and (
@@ -56,7 +70,7 @@ def evaluate(
         if not q.alarm.in_alarm(value):
             rows.append(
                 MonitorStatus(q.description, STATUS_OK, float(value), "",
-                              run_label)
+                              run_label, window_index, sim_time_s)
             )
             continue
         # the webhook re-queries before writing an alarm row; a source
@@ -67,6 +81,7 @@ def evaluate(
                 MonitorStatus(
                     q.description, STATUS_ALARM, float(confirm),
                     q.alarm.error_message, run_label,
+                    window_index, sim_time_s,
                 )
             )
         else:
@@ -74,9 +89,42 @@ def evaluate(
                 MonitorStatus(
                     q.description, STATUS_INCONCLUSIVE, float(confirm),
                     "alarm did not confirm on re-query", run_label,
+                    window_index, sim_time_s,
                 )
             )
     return rows
+
+
+def evaluate_windows(
+    queries: Sequence[Query],
+    window_stores: Iterable[Tuple[int, float, MetricStore]],
+    run_label: str = "",
+) -> List[MonitorStatus]:
+    """Evaluate the checks once per timeline window.
+
+    ``window_stores`` yields ``(window_index, sim_time_s, store)``
+    (the shape :func:`isotope_tpu.metrics.timeline.window_stores`
+    produces); every returned row carries its window's sim-time stamp,
+    so ``first_alarm_onset`` can report when a breach STARTED."""
+    rows: List[MonitorStatus] = []
+    for w, t, store in window_stores:
+        rows.extend(
+            evaluate(queries, store, run_label,
+                     window_index=int(w), sim_time_s=float(t))
+        )
+    return rows
+
+
+def first_alarm_onset(
+    rows: Sequence[MonitorStatus],
+) -> Optional[MonitorStatus]:
+    """The earliest-window ALARM row, or None — the sim-time onset of
+    the first SLO breach."""
+    alarms = [
+        r for r in rows
+        if r.status == STATUS_ALARM and r.window_index is not None
+    ]
+    return min(alarms, key=lambda r: r.window_index) if alarms else None
 
 
 class MonitorSink:

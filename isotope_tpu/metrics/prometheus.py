@@ -48,6 +48,52 @@ CLIENT_NAME = "fortio-client"
 _NB = len(DURATION_BUCKETS) + 1  # +overflow (+Inf)
 
 
+def escape_label_value(value: str) -> str:
+    """Prometheus text-format label-value escaping (backslash, quote,
+    newline — the exposition-format spec's three escapes)."""
+    return (
+        str(value)
+        .replace("\\", "\\\\")
+        .replace('"', '\\"')
+        .replace("\n", "\\n")
+    )
+
+
+def render_labels(labels: Dict[str, str]) -> str:
+    """``{a="x",b="y"}`` with escaped values; empty dict renders
+    nothing."""
+    if not labels:
+        return ""
+    body = ",".join(
+        f'{k}="{escape_label_value(v)}"' for k, v in labels.items()
+    )
+    return "{" + body + "}"
+
+
+def timestamped_series(
+    out: List[str],
+    name: str,
+    help_text: str,
+    type_: str,
+    rows,
+) -> None:
+    """Append one metric family of TIMESTAMPED samples to ``out``.
+
+    ``rows`` is an iterable of ``(labels: dict, value, timestamp_ms)``
+    — the exposition-format's optional trailing timestamp, which lets
+    one scrape carry a whole time series (each sim-time window renders
+    as the sample a scrape at that instant would have returned).  Rows
+    render in the given order; keep them (labels, then window) sorted
+    so the exposition is deterministic.
+    """
+    out.append(f"# HELP {name} {help_text}")
+    out.append(f"# TYPE {name} {type_}")
+    for labels, value, ts_ms in rows:
+        out.append(
+            f"{name}{render_labels(labels)} {value:.10g} {int(ts_ms)}"
+        )
+
+
 class ServiceMetrics(NamedTuple):
     """Device-side accumulators (all counts are float32 for scatter-adds)."""
 

@@ -40,6 +40,10 @@ class Sample:
     name: str
     labels: Dict[str, str]
     value: float
+    # optional exposition timestamp (ms) — one series may carry several
+    # timestamped samples (the timeline's per-window scrape sequence);
+    # instant queries read the LATEST one (see MetricStore._select)
+    timestamp_ms: Optional[int] = None
 
     def key(self, drop: Sequence[str] = ()) -> LabelSet:
         return tuple(
@@ -50,7 +54,8 @@ class Sample:
 _LINE_RE = re.compile(
     r'^(?P<name>[a-zA-Z_:][a-zA-Z0-9_:]*)'
     r'(?:\{(?P<labels>[^}]*)\})?'
-    r'\s+(?P<value>[^\s]+)\s*$'
+    r'\s+(?P<value>[^\s]+)'
+    r'(?:\s+(?P<ts>-?[0-9]+))?\s*$'
 )
 _LABEL_RE = re.compile(r'([a-zA-Z_][a-zA-Z0-9_]*)="((?:[^"\\]|\\.)*)"')
 # the whole label body must be well-formed pairs, not just contain some
@@ -58,6 +63,18 @@ _LABELS_BODY_RE = re.compile(
     r'^\s*(?:[a-zA-Z_][a-zA-Z0-9_]*="(?:[^"\\]|\\.)*"\s*'
     r'(?:,\s*[a-zA-Z_][a-zA-Z0-9_]*="(?:[^"\\]|\\.)*"\s*)*,?\s*)?$'
 )
+
+
+# single-pass unescape: sequential str.replace passes corrupt values
+# like '\\' + 'n' (escaped backslash followed by a literal n)
+_UNESCAPE_RE = re.compile(r'\\(.)')
+_UNESCAPE_MAP = {'"': '"', "\\": "\\", "n": "\n"}
+
+
+def _unescape_label(v: str) -> str:
+    return _UNESCAPE_RE.sub(
+        lambda m: _UNESCAPE_MAP.get(m.group(1), m.group(0)), v
+    )
 
 
 def parse_exposition(text: str) -> List[Sample]:
@@ -74,10 +91,13 @@ def parse_exposition(text: str) -> List[Sample]:
         if not _LABELS_BODY_RE.match(body):
             raise ValueError(f"malformed labels in line: {line!r}")
         labels = {
-            k: v.replace('\\"', '"').replace("\\\\", "\\")
-            for k, v in _LABEL_RE.findall(body)
+            k: _unescape_label(v) for k, v in _LABEL_RE.findall(body)
         }
-        out.append(Sample(m.group("name"), labels, float(m.group("value"))))
+        ts = m.group("ts")
+        out.append(Sample(
+            m.group("name"), labels, float(m.group("value")),
+            timestamp_ms=int(ts) if ts is not None else None,
+        ))
     return out
 
 
@@ -300,9 +320,21 @@ class MetricStore:
 
     def _select(self, name: str, matchers) -> Vector:
         out: Vector = {}
+        # instant-query semantics for TIMESTAMPED series: the latest
+        # sample of each label set wins (a timeline exposition carries
+        # one sample per window); untimestamped duplicates keep the
+        # historical summing behavior
+        latest_ts: Dict[LabelSet, int] = {}
         for s in self._by_name.get(name, ()):
-            if all(m.ok(s.labels) for m in matchers):
-                out[s.key()] = out.get(s.key(), 0.0) + s.value
+            if not all(m.ok(s.labels) for m in matchers):
+                continue
+            k = s.key()
+            if s.timestamp_ms is not None:
+                if k not in latest_ts or s.timestamp_ms >= latest_ts[k]:
+                    latest_ts[k] = s.timestamp_ms
+                    out[k] = s.value
+            else:
+                out[k] = out.get(k, 0.0) + s.value
         return out
 
     def _eval(self, node):

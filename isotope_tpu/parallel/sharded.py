@@ -740,6 +740,251 @@ class ShardedSimulator:
             ),
         )
 
+    # -- timeline runs (metrics/timeline.py) ----------------------------
+
+    def _timeline_plan(self, plan: _RunPlan, window_s):
+        """The static window grid for a sharded run: every shard bins
+        into the SAME absolute sim-time grid (shard clocks all start at
+        t=0), sized from the TOTAL request count and offered rate."""
+        total = plan.num_blocks * plan.block * self.n_shards
+        return self.sim.plan_timeline_windows(
+            total, plan.offered, window_s
+        )
+
+    def run_timeline(
+        self,
+        load: LoadModel,
+        num_requests: int,
+        key: jax.Array,
+        offered_qps=None,
+        block_size: int = 65_536,
+        trim: bool = False,
+        window_s=None,
+    ):
+        """Sharded twin of :meth:`Simulator.run_timeline`: every shard
+        reduces its block scan to (RunSummary, TimelineSummary) and the
+        timeline leaves merge with ``psum`` — windows align because all
+        shards share the absolute sim-time axis.  Returns
+        ``(RunSummary, TimelineSummary)``."""
+        if not self.sim.params.timeline:
+            raise ValueError(
+                "timeline runs need SimParams(timeline=True)"
+            )
+        plan = self._plan_run(load, num_requests, key, offered_qps,
+                              block_size, trim)
+        tl_plan = self._timeline_plan(plan, window_s)
+        telemetry.counter_inc("sharded_timeline_runs")
+        fn = self._get_tl(plan, tl_plan)
+        vis, windows = self._args_put(plan)
+        faults.check("sharded.compute")
+        out = fn(
+            key, jnp.float32(plan.offered), jnp.float32(plan.gap),
+            jnp.float32(plan.nominal_gap),
+            jnp.float32(plan.window[0]), jnp.float32(plan.window[1]),
+            vis, windows,
+        )
+        faults.check("sharded.gather")
+        return out
+
+    def run_timeline_emulated(
+        self,
+        load: LoadModel,
+        num_requests: int,
+        key: jax.Array,
+        offered_qps=None,
+        block_size: int = 65_536,
+        trim: bool = False,
+        window_s=None,
+    ):
+        """The timeline mesh program replayed shard-by-shard on one
+        device with the psum merged on host (sequential shard-order
+        sums) — the degradation rung / equivalence reference for
+        :meth:`run_timeline`."""
+        if not self.sim.params.timeline:
+            raise ValueError(
+                "timeline runs need SimParams(timeline=True)"
+            )
+        from isotope_tpu.metrics import timeline as timeline_mod
+
+        plan = self._plan_run(load, num_requests, key, offered_qps,
+                              block_size, trim)
+        tl_plan = self._timeline_plan(plan, window_s)
+        fn = self._get_local_tl_fn(plan, tl_plan)
+        vis, windows = self._args_put(plan)
+        shards = []
+        with telemetry.phase("sharded.emulated"):
+            for s in range(self.n_shards):
+                out = fn(
+                    jnp.int32(s), key,
+                    jnp.float32(plan.offered), jnp.float32(plan.gap),
+                    jnp.float32(plan.nominal_gap),
+                    jnp.float32(plan.window[0]),
+                    jnp.float32(plan.window[1]),
+                    vis, windows,
+                )
+                jax.block_until_ready(out[0].count)
+                shards.append(out)
+        summary = self._merge_shard_summaries([s for s, _ in shards])
+        return summary, timeline_mod.merge_host(
+            [t for _, t in shards]
+        )
+
+    def _local_scan_tl(
+        self,
+        block: int,
+        num_blocks: int,
+        kind: str,
+        conns_local: int,
+        trim: bool,
+        sat_conns: int,
+        tl_plan: Tuple[int, float],
+        shard: jax.Array,
+        key: jax.Array,
+        offered_qps: jax.Array,
+        pace_gap: jax.Array,
+        nominal_gap: jax.Array,
+        win_lo: jax.Array,
+        win_hi: jax.Array,
+        visits_pc: jax.Array,
+        phase_windows: jax.Array,
+    ):
+        """One shard's pre-collective timeline block scan (the
+        ``_local_scan`` twin; identical RNG stream layout, so the
+        RunSummary half matches the unrecorded path bit-for-bit)."""
+        from isotope_tpu.metrics import timeline as timeline_mod
+
+        spec = timeline_mod.build_spec(
+            self.compiled, tl_plan[0], tl_plan[1]
+        )
+        local_key = jax.random.fold_in(key, 500_000 + shard)
+        c = max(conns_local, 1)
+        per = block // c
+
+        def block_body(carry, b):
+            (t0, conn_t0, req_off), tl_acc = carry
+            kb = jax.random.fold_in(local_key, 1_000_000 + b)
+            res, t_end, conn_end = self.sim._simulate_core(
+                block, kind, conns_local, kb, offered_qps, pace_gap,
+                offered_qps / self.n_shards, nominal_gap, t0, conn_t0,
+                req_off,
+                sat_conns=sat_conns,
+                visits_pc=visits_pc,
+                phase_windows=phase_windows,
+            )
+            s = summarize(
+                res, self.collector,
+                window=(win_lo, win_hi) if trim else None,
+            )
+            # carry accumulation (not stacked ys): one O(S * W)
+            # recorder state per shard, independent of num_blocks
+            tl_acc = timeline_mod.accumulate(
+                tl_acc,
+                timeline_mod.timeline_block(
+                    res, spec, packed=self.sim.params.packed_carries
+                ),
+            )
+            return ((t_end, conn_end, req_off + per), tl_acc), s
+
+        carry0 = (
+            (
+                jnp.float32(0.0),
+                jnp.zeros((c,), jnp.float32),
+                jnp.float32(0.0),
+            ),
+            timeline_mod.zeros_summary(
+                spec, packed=self.sim.params.packed_carries
+            ),
+        )
+        (_, tl_final), parts = jax.lax.scan(
+            block_body, carry0, jnp.arange(num_blocks)
+        )
+        return reduce_stacked(parts), tl_final
+
+    def _tl_body(
+        self,
+        block: int,
+        num_blocks: int,
+        kind: str,
+        conns_local: int,
+        trim: bool,
+        sat_conns: int,
+        tl_plan: Tuple[int, float],
+        key: jax.Array,
+        offered_qps: jax.Array,
+        pace_gap: jax.Array,
+        nominal_gap: jax.Array,
+        win_lo: jax.Array,
+        win_hi: jax.Array,
+        visits_pc: jax.Array,
+        phase_windows: jax.Array,
+    ):
+        both = tuple(self.mesh.axis_names)
+        shard = jnp.int32(0)
+        for a in self.mesh.axis_names:
+            shard = shard * self.mesh.shape[a] + jax.lax.axis_index(a)
+        summary, tl = self._local_scan_tl(
+            block, num_blocks, kind, conns_local, trim, sat_conns,
+            tl_plan, shard, key, offered_qps, pace_gap, nominal_gap,
+            win_lo, win_hi, visits_pc, phase_windows,
+        )
+        merged_summary = self._merge_summary_collective(summary, both)
+        # window_s is identical on every shard — exclude it from the
+        # psum (the attribution tail_cut idiom)
+        psummed = jax.tree.map(
+            lambda x: jax.lax.psum(x, both),
+            tl._replace(window_s=jnp.float32(0.0)),
+        )
+        return merged_summary, psummed._replace(window_s=tl.window_s)
+
+    def _get_tl(self, plan: _RunPlan, tl_plan: Tuple[int, float]):
+        cache_key = (plan.block, plan.num_blocks, plan.kind,
+                     plan.conns_local, plan.trim, plan.sat_conns,
+                     tl_plan)
+        key = ("sharded-tl",) + cache_key
+        if key not in self._fns:
+            from isotope_tpu.metrics import timeline as timeline_mod
+
+            body = partial(self._tl_body, *cache_key)
+            n_fields = len(timeline_mod.TimelineSummary._fields)
+            tl_spec = timeline_mod.TimelineSummary(
+                *([P()] * n_fields)
+            )
+            mapped = _shard_map(
+                body,
+                mesh=self.mesh,
+                in_specs=tuple(P() for _ in range(8)),
+                out_specs=(self._summary_out_specs(), tl_spec),
+            )
+            mesh_sig = (
+                tuple(self.mesh.axis_names),
+                tuple(int(self.mesh.shape[a])
+                      for a in self.mesh.axis_names),
+                tuple(d.id for d in self.mesh.devices.flat),
+            )
+            self._fns[key] = executable_cache.get_or_build(
+                ("sharded-tl", self.sim.signature, mesh_sig)
+                + cache_key,
+                lambda: telemetry.time_first_call(
+                    jax.jit(mapped), "compile.jit_first_call"
+                ),
+            )
+        return self._fns[key]
+
+    def _get_local_tl_fn(self, plan: _RunPlan,
+                         tl_plan: Tuple[int, float]):
+        cache_key = (plan.block, plan.num_blocks, plan.kind,
+                     plan.conns_local, plan.trim, plan.sat_conns,
+                     tl_plan)
+        full_key = ("sharded-tl-local", self.sim.signature,
+                    self.n_shards) + cache_key
+        return executable_cache.get_or_build(
+            full_key,
+            lambda: telemetry.time_first_call(
+                jax.jit(partial(self._local_scan_tl, *cache_key)),
+                "compile.jit_first_call",
+            ),
+        )
+
     # -- single-device degradation rung --------------------------------
 
     def run_emulated(

@@ -244,6 +244,7 @@ th { background: #f1f0ec; }
 .regress { color: #a11a1a; font-weight: 600; }
 .improve { color: #0a6b0a; font-weight: 600; }
 .discarded td { opacity: .5; }
+.spark { vertical-align: middle; }
 SERIES_CSS
 @media (prefers-color-scheme: dark) {
   body { background: #1a1a19; color: #ffffff; }
@@ -366,6 +367,80 @@ def _regression_table(rows: List[dict]) -> str:
     )
 
 
+def load_timelines(results_dir) -> Dict[str, dict]:
+    """Per-run timeline documents (``<label>.timeline.json``) written
+    by a ``--timeline`` sweep (metrics/timeline.py); {} when none
+    exist."""
+    out: Dict[str, dict] = {}
+    for p in sorted(pathlib.Path(results_dir).glob("*.timeline.json")):
+        try:
+            out[p.name[: -len(".timeline.json")]] = json.loads(
+                p.read_text()
+            )
+        except (OSError, json.JSONDecodeError):
+            continue
+    return out
+
+
+def _svg_sparkline(values: Sequence[float], width: int = 140,
+                   height: int = 28) -> str:
+    """A tiny inline-SVG sparkline for one windowed series (the
+    dataviz sparkline form: one recessive line, no axes)."""
+    vs = [float(v) for v in values]
+    if not vs:
+        return ""
+    hi = max(vs) or 1.0
+    n = max(len(vs) - 1, 1)
+    pts = " ".join(
+        f"{2 + i / n * (width - 4):.1f},"
+        f"{height - 3 - v / hi * (height - 6):.1f}"
+        for i, v in enumerate(vs)
+    )
+    return (
+        f'<svg viewBox="0 0 {width} {height}" class="spark" '
+        f'width="{width}" height="{height}" role="img">'
+        f'<polyline points="{pts}" fill="none" class="s0" '
+        'stroke-width="1.5"/></svg>'
+    )
+
+
+def _timeline_section(timelines: Dict[str, dict]) -> str:
+    """Per-run timeline rows: client-qps and error sparklines over sim
+    time plus the busiest services' peak utilization / queue."""
+    head = (
+        "<th>run</th><th>windows</th><th>qps over time</th>"
+        "<th>errors over time</th><th>peak service</th>"
+        "<th>peak util</th><th>convoy r</th>"
+    )
+    body = []
+    for label, doc in timelines.items():
+        wins = doc.get("windows", [])
+        qps = [w.get("qps", 0.0) for w in wins]
+        errs = [w.get("errors", 0.0) for w in wins]
+        services = doc.get("services", {})
+        peak_name, peak_util = "-", 0.0
+        for name, svc in services.items():
+            u = float(svc.get("peak_utilization", 0.0))
+            if u > peak_util:
+                peak_name, peak_util = name, u
+        conv = (doc.get("convoy") or {}).get("correlation")
+        body.append(
+            "<tr>"
+            f"<td>{html.escape(label)}</td>"
+            f"<td>{len(wins)} x {doc.get('window_s', 0):g}s</td>"
+            f"<td>{_svg_sparkline(qps)}</td>"
+            f"<td>{_svg_sparkline(errs)}</td>"
+            f"<td>{html.escape(peak_name)}</td>"
+            f"<td>{peak_util * 100:.0f}%</td>"
+            f"<td>{conv if conv is not None else '-'}</td>"
+            "</tr>"
+        )
+    return (
+        f"<table><thead><tr>{head}</tr></thead>"
+        f"<tbody>{''.join(body)}</tbody></table>"
+    )
+
+
 def load_blame(results_dir) -> Dict[str, dict]:
     """Per-run blame documents (``<label>.blame.json``) written by an
     attributed sweep (``--attribution``); {} when none exist."""
@@ -424,6 +499,7 @@ def build_report(
     baseline_rows: Optional[Sequence[dict]] = None,
     title: str = "isotope-tpu benchmark report",
     blame: Optional[Dict[str, dict]] = None,
+    timelines: Optional[Dict[str, dict]] = None,
 ) -> str:
     x_col, x_label = _pick_x(rows)
     discarded = sum(1 for r in rows if r.get("windowDiscarded"))
@@ -511,6 +587,16 @@ def build_report(
             "along the critical path.</p>"
         )
         doc.append(_blame_table(blame))
+    if timelines:
+        doc.append("<h2>Timelines</h2>")
+        doc.append(
+            "<p>Windowed series of the recorded runs "
+            "(metrics/timeline.py): client throughput and errors over "
+            "sim time, plus the busiest service's peak utilization "
+            "and the convoy detector's entry-wait-vs-leaf-busy "
+            "correlation.</p>"
+        )
+        doc.append(_timeline_section(timelines))
     doc.append("<h2>All runs</h2>")
     doc.append(_results_table(rows))
     doc.append("</body></html>")
@@ -533,6 +619,7 @@ def write_report(
         baseline,
         title or f"isotope-tpu report — {pathlib.Path(results_dir).name}",
         blame=load_blame(results_dir),
+        timelines=load_timelines(results_dir),
     )
     pathlib.Path(out_path).write_text(doc)
     return len(rows)

@@ -143,6 +143,11 @@ class ExperimentConfig:
     # SimParams.attribution so the runner's attributed pass can reduce
     # per-service blame on device (--attribution[=tail])
     attribution: bool = False
+    # simulation flight recorder (metrics/timeline.py): arms
+    # SimParams.timeline so the runner's timeline pass can accumulate
+    # windowed series on device (--timeline[=<window>])
+    timeline: bool = False
+    timeline_window_s: float = SimParams().timeline_window_s
 
     def sim_params(self) -> SimParams:
         return SimParams(
@@ -150,6 +155,8 @@ class ExperimentConfig:
             service_time=self.service_time,
             service_time_param=self.service_time_param,
             attribution=self.attribution,
+            timeline=self.timeline,
+            timeline_window_s=self.timeline_window_s,
         )
 
     def load_models(self):
@@ -363,4 +370,10 @@ def load_toml(path) -> ExperimentConfig:
         churn=tuple(churn),
         mtls=mtls,
         entry=sim.get("entry"),
+        timeline=bool(sim.get("timeline", False)),
+        timeline_window_s=(
+            dur.parse_duration_seconds(sim["timeline_window"])
+            if "timeline_window" in sim
+            else SimParams().timeline_window_s
+        ),
     )

@@ -77,6 +77,11 @@ class RunResult:
     blame: Optional[dict] = None
     attribution: Optional[object] = None
     compiled: Optional[object] = None
+    # flight-recorder windowed series (metrics/timeline.py): the
+    # timeline.json doc and the raw TimelineSummary; None when the
+    # timeline pass was off or failed
+    timeline: Optional[dict] = None
+    timeline_summary: Optional[object] = None
 
 
 def _failed_window(reason: str) -> WindowSummary:
@@ -317,6 +322,32 @@ def _attribution_pass(sim, sharded, use_sharded, topo, load, n, key,
         return None, None
 
 
+def _timeline_pass(sim, sharded, use_sharded, topo, load, n, key,
+                   block, window_s):
+    """The post-ladder timeline pass for one case: identical request
+    streams to the main scan run (same executor, key, and blocking —
+    the sharded twin when the mesh served the case), reduced to the
+    windowed series on device.  Best-effort — a recorder failure must
+    never fail a case whose metrics already landed."""
+    from isotope_tpu.metrics import timeline as timeline_mod
+
+    runner = sharded if (use_sharded and sharded is not None) else sim
+    try:
+        with telemetry.phase("timeline.pass"):
+            _, tl = runner.run_timeline(
+                load, n, key, block_size=block, trim=True,
+                window_s=window_s,
+            )
+            jax.block_until_ready(tl.count)
+        doc = timeline_mod.to_doc(topo.compiled, tl)
+        telemetry.counter_inc("timeline_passes")
+        return doc, tl
+    except Exception as e:  # pragma: no cover - best-effort surface
+        telemetry.counter_inc("timeline_pass_failures")
+        print(f"warning: timeline pass failed: {e}", file=sys.stderr)
+        return None, None
+
+
 def _record_vet_memory_ratio() -> None:
     """Measured/estimated device-peak-bytes ratio gauge: pairs the
     VET-M cost-model estimate with the run's real high-water so
@@ -339,6 +370,7 @@ def run_experiment(
     policy: Optional[ResiliencePolicy] = None,
     vet: Optional[str] = None,
     attribution: Optional[str] = None,
+    timeline: Optional[float] = None,
 ) -> List[RunResult]:
     """``profile_dir`` captures a ``jax.profiler`` trace per executed run
     into ``<profile_dir>/<label>/`` — the analogue of the reference's
@@ -367,7 +399,13 @@ def run_experiment(
     ``config.attribution``) runs a critical-path blame pass per case
     after its metrics land: the blame tables ride ``RunResult.blame``
     and, with an output directory, ``<label>.blame.json`` +
-    ``<label>.flame.txt`` artifacts the ``report`` command renders."""
+    ``<label>.flame.txt`` artifacts the ``report`` command renders.
+
+    ``timeline`` (a window width in seconds; requires
+    ``config.timeline``) runs a flight-recorder pass per case: the
+    windowed series ride ``RunResult.timeline`` and, with an output
+    directory, a ``<label>.timeline.json`` artifact the ``report``
+    command renders as per-run sparklines."""
     from isotope_tpu.analysis.vet import vet_mode
 
     vet = vet_mode(vet)
@@ -570,6 +608,12 @@ def run_experiment(
                             run_key, block,
                             tail=attribution == "tail",
                         )
+                    tl_doc = tl_summary = None
+                    if timeline is not None:
+                        tl_doc, tl_summary = _timeline_pass(
+                            sim, sharded, use_sharded, topo, load, n,
+                            run_key, block, window_s=timeline,
+                        )
                     doc = fortio_result_from_summary(
                         summary, load, labels=label,
                         response_size_bytes=topo.entry_response_size,
@@ -621,8 +665,11 @@ def run_experiment(
                         compiled=(
                             topo.compiled
                             if attr_summary is not None
+                            or tl_summary is not None
                             else None
                         ),
+                        timeline=tl_doc,
+                        timeline_summary=tl_summary,
                     )
                     results.append(result)
                     if out is not None:
@@ -636,6 +683,11 @@ def run_experiment(
                                 out / f"{label}.blame.json", "w"
                             ) as f:
                                 json.dump(blame_doc, f, indent=2)
+                        if tl_doc is not None:
+                            with open(
+                                out / f"{label}.timeline.json", "w"
+                            ) as f:
+                                json.dump(tl_doc, f, indent=2)
                         if attr_summary is not None:
                             from isotope_tpu.metrics.export import (
                                 write_flamegraph,

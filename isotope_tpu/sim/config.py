@@ -176,6 +176,19 @@ class SimParams:
     # the conditional-tail cut quantile estimated by the pilot pass in
     # ``--attribution=tail`` mode (p99 by default)
     attribution_tail_quantile: float = 0.99
+    # Simulation flight recorder (metrics/timeline.py): when True,
+    # ``Simulator.run_timeline`` bins every hop event into fixed
+    # sim-time windows inside the block scan and accumulates
+    # per-service x per-window series (O(S * W) carries, psum-merged
+    # across shards).  Off (default) leaves every summary path
+    # byte-identical — pinned like attribution.
+    timeline: bool = False
+    # window width in sim seconds — the scrape interval the reference's
+    # Prometheus collection used against the mock services
+    timeline_window_s: float = 10.0
+    # hard cap on the window count; the planner widens windows (with a
+    # warning) instead of letting the O(S * W) carries OOM the device
+    timeline_max_windows: int = 256
 
     def __post_init__(self):
         if self.service_time not in (
@@ -215,6 +228,10 @@ class SimParams:
             raise ValueError(
                 "attribution_tail_quantile must lie in (0, 1)"
             )
+        if self.timeline_window_s <= 0.0:
+            raise ValueError("timeline_window_s must be positive")
+        if self.timeline_max_windows < 1:
+            raise ValueError("timeline_max_windows must be >= 1")
         # (sibling_copula_r + retry_copula_r < 1 is required only for
         # hops inside a multi-attempt call; the Simulator enforces it
         # when such calls exist)

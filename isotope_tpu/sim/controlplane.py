@@ -55,6 +55,18 @@ class ConvergenceResult:
     def converged_fraction(self, t: float) -> float:
         return float((self.ack_times_s <= t).mean())
 
+    def window_series(self, window_s: float, num_windows: int) -> dict:
+        """Project the per-proxy ACK times onto a data-plane timeline's
+        window axis (metrics/timeline.py) — per-window ACK counts and
+        the cumulative converged fraction at each window end — so a
+        config-push timeline composes with the flight recorder's
+        series on one shared time grid."""
+        from isotope_tpu.metrics.timeline import controlplane_windows
+
+        return controlplane_windows(
+            self.ack_times_s, window_s, num_windows
+        )
+
 
 def push_convergence(
     model: PilotModel,

@@ -1892,6 +1892,98 @@ class Simulator:
                 self._windows_arg(offered, sat),
             )
 
+    def plan_timeline_windows(
+        self, total_requests: int, offered: float,
+        window_s: Optional[float] = None,
+    ) -> Tuple[int, float]:
+        """Resolve the static ``(num_windows, window_s)`` grid for a
+        run: the expected sim duration (requests / offered rate) cut
+        into ``timeline_window_s`` windows, clamped (with a warning)
+        by ``timeline_max_windows`` and the recorder's element budget
+        instead of OOMing (metrics/timeline.py plan_windows)."""
+        from isotope_tpu.metrics import timeline as timeline_mod
+
+        dt = (
+            float(window_s)
+            if window_s is not None
+            else self.params.timeline_window_s
+        )
+        expected = total_requests / max(float(offered), 1e-9)
+        w, dt_eff, clamped = timeline_mod.plan_windows(
+            expected, dt, self.params.timeline_max_windows,
+            self.compiled.num_services,
+        )
+        if clamped:
+            telemetry.counter_inc("timeline_window_clamps")
+        return w, dt_eff
+
+    def run_timeline(
+        self,
+        load: LoadModel,
+        num_requests: int,
+        key: jax.Array,
+        *,
+        block_size: int = 65_536,
+        collector=None,
+        fixed_point_iters: int = 3,
+        trim: bool = False,
+        window_s: Optional[float] = None,
+    ):
+        """Like :meth:`run_summary`, but the block scan ALSO reduces a
+        :class:`~isotope_tpu.metrics.timeline.TimelineSummary` — the
+        flight recorder's per-service x per-window series, binned on
+        device from each block's absolute sim-time clocks.
+
+        Identical keys/blocking to :meth:`run_summary`, so the
+        returned ``RunSummary`` matches an unrecorded run of the same
+        arguments.  Returns ``(RunSummary, TimelineSummary)``.
+        """
+        if not self.params.timeline:
+            raise ValueError(
+                "timeline runs need SimParams(timeline=True)"
+            )
+        if load.kind == OPEN_LOOP:
+            offered = float(load.qps)
+            pace = 0.0
+            nominal = 0.0
+            conns = 0
+            block = max(1, min(block_size, num_requests))
+        else:
+            conns = load.connections
+            offered = self.solve_closed_rate(load, num_requests, key,
+                                             fixed_point_iters)
+            pace = conns / load.qps if load.qps is not None else 0.0
+            nominal = conns / offered
+            per = max(1, min(block_size, num_requests) // conns)
+            block = per * conns
+        num_blocks = max(1, -(-num_requests // block))
+        if trim:
+            from isotope_tpu.metrics.fortio import trim_window_bounds
+
+            window = trim_window_bounds(num_blocks * block, offered)
+        else:
+            window = (0.0, np.inf)
+        sat = self._saturated(load)
+        tl_plan = self.plan_timeline_windows(
+            num_blocks * block, offered, window_s
+        )
+        fn = self._get_summary(
+            block, num_blocks, load.kind, conns, collector, trim,
+            sat=sat, timeline=tl_plan,
+        )
+        faults.check("engine.run")
+        telemetry.gauge_set("engine_block_requests", block)
+        telemetry.gauge_set("engine_num_blocks", num_blocks)
+        telemetry.counter_inc("timeline_runs")
+        with self._detail_ctx():
+            return fn(
+                key, jnp.float32(offered), jnp.float32(pace),
+                jnp.float32(offered), jnp.float32(nominal),
+                jnp.float32(window[0]), jnp.float32(window[1]),
+                self._vis_arg(offered),
+                self._windows_arg(offered, sat),
+            )
+
     def _attribution_tables(self):
         """Blame-sweep index tables (metrics/attribution.py), built
         lazily — a Simulator that never runs attributed pays nothing."""
@@ -2078,7 +2170,8 @@ class Simulator:
 
     def _get_summary(self, block: int, num_blocks: int, kind: str,
                      connections: int, collector, trim: bool = False,
-                     sat: bool = False, attr: Optional[str] = None):
+                     sat: bool = False, attr: Optional[str] = None,
+                     timeline: Optional[Tuple[int, float]] = None):
         """Jitted scan-over-blocks program producing a RunSummary (and,
         with ``attr`` set, an AttributionSummary alongside it).
 
@@ -2088,11 +2181,21 @@ class Simulator:
         reduction through the same block scan: per-block blame vectors
         stack and sum, the top-K exemplar state rides the carry, and
         ``"tail"`` additionally weights a second accumulator set by
-        ``client_latency >= tail_cut`` (a traced scalar argument)."""
+        ``client_latency >= tail_cut`` (a traced scalar argument).
+
+        ``timeline=(num_windows, window_s)`` threads the flight
+        recorder (metrics/timeline.py) through the same scan instead:
+        per-block O(S * W) windowed series stack and sum next to the
+        RunSummary — mutually exclusive with ``attr``."""
         from isotope_tpu.sim import summary as summary_mod
 
+        if attr is not None and timeline is not None:
+            raise ValueError(
+                "one scan reduces either blame or the timeline, "
+                "not both"
+            )
         cache_key = (block, num_blocks, kind, connections,
-                     collector is not None, trim, sat, attr)
+                     collector is not None, trim, sat, attr, timeline)
         if cache_key not in self._summary_fns:
             c = max(connections, 1)
             per = block // c
@@ -2101,8 +2204,67 @@ class Simulator:
 
                 tables = self._attribution_tables()
                 top_k = self.params.attribution_top_k
+            if timeline is not None:
+                from isotope_tpu.metrics import timeline as timeline_mod
 
-            if attr is None:
+                tspec = timeline_mod.build_spec(
+                    self.compiled, timeline[0], timeline[1]
+                )
+
+            if timeline is not None:
+                def scanfn(key, offered_qps, pace_gap, arrival_qps,
+                           nominal_gap, win_lo, win_hi, visits_pc,
+                           phase_windows):
+                    telemetry.record_trace(
+                        ("summary", self.signature[3]) + cache_key,
+                        tracing=isinstance(key, jax.core.Tracer),
+                        requests=block, hops=self.compiled.num_hops,
+                    )
+
+                    def body(carry, b):
+                        (t0, conn_t0, req_off), tl_acc = carry
+                        kb = jax.random.fold_in(key, 1_000_000 + b)
+                        res, t_end, conn_end = self._simulate_core(
+                            block, kind, connections, kb, offered_qps,
+                            pace_gap, arrival_qps, nominal_gap, t0,
+                            conn_t0, req_off,
+                            sat_conns=connections if sat else 0,
+                            visits_pc=visits_pc,
+                            phase_windows=phase_windows,
+                        )
+                        s = summary_mod.summarize(
+                            res, collector,
+                            window=(win_lo, win_hi) if trim else None,
+                        )
+                        # the recorder accumulates in the CARRY (not
+                        # stacked ys): device cost stays O(S * W) no
+                        # matter how many blocks the run scans
+                        tl_acc = timeline_mod.accumulate(
+                            tl_acc,
+                            timeline_mod.timeline_block(
+                                res, tspec,
+                                packed=self.params.packed_carries,
+                            ),
+                        )
+                        return (
+                            (t_end, conn_end, req_off + per), tl_acc
+                        ), s
+
+                    carry0 = (
+                        (
+                            jnp.float32(0.0),
+                            jnp.zeros((c,), jnp.float32),
+                            jnp.float32(0.0),
+                        ),
+                        timeline_mod.zeros_summary(
+                            tspec, packed=self.params.packed_carries
+                        ),
+                    )
+                    (_, tl_final), parts = jax.lax.scan(
+                        body, carry0, jnp.arange(num_blocks)
+                    )
+                    return summary_mod.reduce_stacked(parts), tl_final
+            elif attr is None:
                 def scanfn(key, offered_qps, pace_gap, arrival_qps,
                            nominal_gap, win_lo, win_hi, visits_pc,
                            phase_windows):
@@ -3301,10 +3463,14 @@ class Simulator:
             utilization=util_phase.max(axis=0),
             unstable=unstable_phase.any(axis=0),
             offered_qps=offered_qps,
-            # only materialized for attributed simulators: the dense
-            # run() path would otherwise pay a fifth (N, H) output
-            # buffer nothing reads
-            hop_wait=wait if self.params.attribution else None,
+            # only materialized for attributed / timeline simulators:
+            # the dense run() path would otherwise pay a fifth (N, H)
+            # output buffer nothing reads
+            hop_wait=(
+                wait
+                if self.params.attribution or self.params.timeline
+                else None
+            ),
         )
         t_end = conn_end.max() if kind == CLOSED_LOOP else arrivals[-1]
         return res, t_end, conn_end

@@ -381,3 +381,50 @@ def test_bench_rate_steady_state_detector(monkeypatch):
         sim, load, 256, 128, warm=0, iters=1, trials=2
     )
     assert warmup_capped == 2
+
+
+# -- timeline-overhead gate (metrics/timeline.py) ---------------------------
+
+
+def test_timeline_gate_off_by_default(tmp_path, monkeypatch):
+    base = capture(2.0e9, {"svc1000": 2.0e9, "svc1000_best": 2.1e9})
+    new = capture(2.0e9, {"svc1000": 2.0e9, "svc1000_best": 2.1e9,
+                          "svc1000_timeline_overhead": 0.40})
+    monkeypatch.delenv("BENCH_REGRESS_TIMELINE_THRESHOLD",
+                       raising=False)
+    assert run_gate(tmp_path, monkeypatch, new, base) == 0
+
+
+def test_timeline_gate_fails_past_bound(tmp_path, monkeypatch, capsys):
+    base = capture(2.0e9, {"svc1000": 2.0e9, "svc1000_best": 2.1e9})
+    new = capture(2.0e9, {"svc1000": 2.0e9, "svc1000_best": 2.1e9,
+                          "svc1000_timeline_overhead": 0.12})
+    monkeypatch.setenv("BENCH_REGRESS_TIMELINE_THRESHOLD", "0.05")
+    assert run_gate(tmp_path, monkeypatch, new, base) == 1
+    out = capsys.readouterr().out
+    assert "svc1000.timeline_overhead" in out and "REGRESSION" in out
+
+
+def test_timeline_gate_absolute_bound_passes_under(tmp_path,
+                                                   monkeypatch):
+    # absolute bound, not vs-baseline: a baseline with a worse
+    # overhead does NOT excuse the new capture, and under-threshold
+    # passes regardless of history
+    base = capture(2.0e9, {"svc1000": 2.0e9, "svc1000_best": 2.1e9,
+                           "svc1000_timeline_overhead": 0.50})
+    new = capture(2.0e9, {"svc1000": 2.0e9, "svc1000_best": 2.1e9,
+                          "svc1000_timeline_overhead": 0.03})
+    monkeypatch.setenv("BENCH_REGRESS_TIMELINE_THRESHOLD", "0.05")
+    assert run_gate(tmp_path, monkeypatch, new, base) == 0
+
+
+def test_timeline_overhead_not_a_rate_key(tmp_path, monkeypatch):
+    # the evidence key must not be compared as a hop-rate (a drop in
+    # measured overhead would otherwise read as a "regression")
+    base = capture(2.0e9, {"svc1000": 2.0e9, "svc1000_best": 2.1e9,
+                           "svc1000_timeline_overhead": 0.50})
+    new = capture(2.0e9, {"svc1000": 2.0e9, "svc1000_best": 2.1e9,
+                          "svc1000_timeline_overhead": 0.01})
+    monkeypatch.delenv("BENCH_REGRESS_TIMELINE_THRESHOLD",
+                       raising=False)
+    assert run_gate(tmp_path, monkeypatch, new, base) == 0

@@ -90,15 +90,34 @@ def test_window_summary_from_summary_matches_dense():
                                       replicas=reps)
 
     assert via.start_s == dense.start_s
-    # the summary window is placed from the EXPECTED duration; actual
-    # arrival noise at n=6000 is ~1/sqrt(n)
-    assert via.duration_s == pytest.approx(dense.duration_s, rel=0.05)
-    assert via.count == pytest.approx(dense.count, rel=0.05)
+    # The summary window is placed from the EXPECTED duration, the
+    # dense one from the ACTUAL duration; they differ by the arrival
+    # process's ~1/sqrt(n) noise AMPLIFIED ~4x through the fixed 92 s
+    # skip subtraction (a 2.6% duration deficit at this seed becomes
+    # an 11% window-length delta: (120-92) vs (116.8-92)).  Bound the
+    # placement gap on the run-duration scale, where the noise lives,
+    # not on the subtracted window length.
+    assert abs(via.duration_s - dense.duration_s) <= 0.05 * 120.0
+    assert abs(via.count - dense.count) <= 0.05 * 120.0 * dense.qps
     assert via.qps == pytest.approx(dense.qps, rel=0.1)
     assert via.discarded == dense.discarded is False
     assert via.error_percent == pytest.approx(dense.error_percent, abs=1.0)
-    for k, v in dense.percentiles_us.items():
-        assert via.percentiles_us[k] == pytest.approx(v, rel=0.03, abs=30)
+    # percentile fidelity is a SAME-POPULATION check: the two
+    # derivations window different request sets (expected- vs
+    # actual-duration placement), so compare the summary path against
+    # dense quantiles over ITS OWN accumulated window — any gap left
+    # is formatter error (histogram quantization), not placement noise
+    starts = np.asarray(res.client_start, np.float64)
+    lat = np.asarray(res.client_latency, np.float64)
+    mask = (starts >= lo) & (starts < hi)
+    from isotope_tpu.metrics.fortio import PERCENTILES
+
+    qs = np.quantile(lat[mask], [p / 100.0 for p in PERCENTILES])
+    for p, v in zip(PERCENTILES, qs):
+        k = "p" + str(p).replace(".", "")
+        assert via.percentiles_us[k] == pytest.approx(
+            v * 1e6, rel=0.03, abs=30
+        ), k
     assert via.cpu_cores == pytest.approx(dense.cpu_cores, rel=1e-5)
 
 

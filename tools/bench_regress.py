@@ -40,6 +40,10 @@ threshold (unset = not gated), compared per case over the
   from bench's attributed probe), e.g. ``0.1`` = 10 share points; a
   case's throughput can hold while its critical path migrates, which
   only this gate sees.
+- ``BENCH_REGRESS_TIMELINE_THRESHOLD``: ABSOLUTE bound on the
+  flight-recorder overhead (``<case>_timeline_overhead`` — bench's
+  timeline-on vs timeline-off steady-state delta), e.g. ``0.05`` =
+  the 5% svc1000 acceptance bar.
 
 Always armed (no env var): a case whose telemetry block carries
 ``degraded_to`` — the resilience supervisor served it from a
@@ -101,7 +105,8 @@ def _cases(doc: dict, prefer_best: bool = False) -> dict:
         if not isinstance(v, (int, float)):
             continue
         if k.endswith(("_inflight", "_spread", "_census", "_best",
-                       "_compile_s", "_warmup_windows")):
+                       "_compile_s", "_warmup_windows",
+                       "_timeline_overhead")):
             continue  # evidence / variance keys, not rates
         cases[k] = float(v)
     if prefer_best:
@@ -263,6 +268,36 @@ def blame_failures(prev_doc: dict, new_doc: dict) -> list:
     return failures
 
 
+def timeline_failures(new_doc: dict) -> list:
+    """Opt-in gate (``BENCH_REGRESS_TIMELINE_THRESHOLD=<max overhead>``):
+    a case whose measured flight-recorder overhead
+    (``<case>_timeline_overhead``, the timeline-on vs timeline-off
+    steady-state delta bench.py embeds) exceeds the threshold fails.
+
+    An ABSOLUTE bound, not a vs-baseline diff: the acceptance bar is
+    "timeline-on costs <= X of timeline-off" (5% on svc1000), which
+    holds or it doesn't — comparing drifting overheads against each
+    other would let the bound creep."""
+    raw = os.environ.get("BENCH_REGRESS_TIMELINE_THRESHOLD")
+    if raw is None or raw == "":
+        return []
+    thr = float(raw)
+    failures = []
+    for k, v in sorted(new_doc.get("extra", {}).items()):
+        if not k.endswith("_timeline_overhead") or not isinstance(
+            v, (int, float)
+        ):
+            continue
+        case = k[: -len("_timeline_overhead")]
+        bad = float(v) > thr
+        verdict = "REGRESSION" if bad else "OK"
+        print(f"bench_regress: {case}.timeline_overhead: "
+              f"{float(v):+.3f} (threshold {thr:.3f}) {verdict}")
+        if bad:
+            failures.append(f"{case}.timeline_overhead")
+    return failures
+
+
 def spread_failures(prev_doc: dict, new_doc: dict) -> list:
     """Opt-in gate (``BENCH_REGRESS_SPREAD_THRESHOLD=<ratio>``): a case
     whose window-to-window relative spread (``<case>_spread``) exceeds
@@ -396,6 +431,7 @@ def main() -> int:
     failures.extend(vet_failures(prev_doc, new_doc))
     failures.extend(blame_failures(prev_doc, new_doc))
     failures.extend(spread_failures(prev_doc, new_doc))
+    failures.extend(timeline_failures(new_doc))
     if failures:
         print(f"bench_regress: FAIL vs {prev_path}: "
               f"{', '.join(failures)} regressed >"
