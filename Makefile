@@ -7,9 +7,9 @@ QPS ?= 1000
 DURATION ?= 120s
 
 .PHONY: test lint vet-smoke bench telemetry-smoke resilience-smoke \
-	attribution-smoke sparse-smoke timeline-smoke examples canonical \
-	tree star multitier auxiliary-services star-auxiliary latency \
-	cpu_mem dot clean
+	attribution-smoke sparse-smoke timeline-smoke multihost-smoke \
+	examples canonical tree star multitier auxiliary-services \
+	star-auxiliary latency cpu_mem dot clean
 
 test:
 	$(PY) -m pytest tests/ -x -q
@@ -157,6 +157,15 @@ timeline-smoke:
 # counts must be equal, latency sums within f32 reduction noise.
 sparse-smoke:
 	$(PY) tools/sparse_smoke.py
+
+# multi-host end-to-end check: the 2 hosts x 8 devices EMULATED twin
+# (16 shards on one CPU device) reconciles, the (slice, data, svc)
+# shard_map program matches its emulated replay within 1 ULP,
+# collective/compute overlap matches the single-merge path, the
+# --mesh auto layout search scores <= the hand-picked {2,2,2} mesh,
+# and an injected sharded.dcn_collective transient is retried.
+multihost-smoke:
+	$(PY) tools/multihost_smoke.py
 
 examples:
 	$(PY) tools/gen_examples.py

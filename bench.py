@@ -25,6 +25,12 @@ holds one request block, counters/histograms accumulate on device:
   requests (numReplicas 192 keeps every station stable at rho ~ 0.71).
   The census evidence is reported as ``svc10k_cfg3_inflight``.
 
+The capture also embeds the ``--mesh auto`` layout verdict for this
+host (``_mesh_layout`` / ``_mesh_layout_score``, parallel/layout.py)
+so ``tools/bench_regress.py`` can gate the search
+(``BENCH_REGRESS_LAYOUT_GATE=1``) — bench cases themselves measure the
+single-chip path, so the mesh choice is evidence, not a knob.
+
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", "extra"}.
 ``value`` is the headline tree121 rate; vs_baseline measures it against
 the north-star per-chip rate from BASELINE.json (1e9 hop-events/s on a
@@ -338,6 +344,21 @@ def run_case(name: str) -> dict:
     if name == "tree121":
         sim = Simulator(_flagship())
         med, spread, best, first_s = measure(sim, open_load, blk * blocks, blk)
+        # auto-layout evidence: the factorization `--mesh auto` picks on
+        # THIS host plus its cost-model score, so bench_regress's
+        # opt-in BENCH_REGRESS_LAYOUT_GATE can fail a round whose
+        # search regressed to a worse-scoring mesh (a model-constant or
+        # search bug shows up here before any pod run does)
+        try:
+            from isotope_tpu.parallel import layout
+
+            chosen = layout.choose_layout(
+                jax.device_count(), sim.compiled.num_services
+            )
+            out["_mesh_layout"] = chosen.spec.describe()
+            out["_mesh_layout_score"] = float(chosen.score_s)
+        except Exception:  # pragma: no cover - capture survival
+            pass
     elif name == "closed64":
         sim = Simulator(_flagship())
         med, spread, best, first_s = measure(
@@ -586,7 +607,9 @@ def main() -> None:
 
     tree121 = extra.get("tree121") or 0.0
     extra_out = {
-        k: (round(v) if isinstance(v, float) and not k.endswith("_spread")
+        k: (round(v) if isinstance(v, float)
+            and not k.endswith(("_spread", "_timeline_overhead",
+                                "_mesh_layout_score"))
             else v)
         for k, v in extra.items()
     }

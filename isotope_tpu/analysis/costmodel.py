@@ -45,6 +45,20 @@ DEFAULT_TIMELINE_SHARE = 0.10
 #: perfectly
 CAPACITY_FILL = 0.85
 
+# -- collective cost constants (parallel/layout.py feeds on these) -------
+#
+# Per-link bandwidth/latency used by :func:`comm_table` to price the
+# summary-merge collectives.  ICI numbers are v5e-class per-link
+# figures; DCN is a 100 Gbps-class host NIC with millisecond-scale
+# all-reduce setup.  CPU-era GUESSES, like SEGMENT_OVERHEAD_ELEMS —
+# calibrating them against a real multi-slice capture is a ROADMAP
+# follow-up.  What matters for the layout SEARCH is the ordering
+# (DCN ~20x slower, ~100x higher latency), which is robust.
+ICI_BANDWIDTH_BYTES_S = 1.6e11
+DCN_BANDWIDTH_BYTES_S = 8.0e9
+ICI_LATENCY_S = 1e-6
+DCN_LATENCY_S = 1e-4
+
 #: elementwise-ish primitives costed at one flop per output element;
 #: anything unknown falls back to the same rate (a floor, not truth)
 _FREE_PRIMITIVES = frozenset({
@@ -311,6 +325,128 @@ def timeline_bytes(sim, num_windows: Optional[int] = None) -> float:
     )
     elems = 5 * s * w + 4 * w + w * NUM_BLAME_BUCKETS
     return 4.0 * elems
+
+
+def summary_bytes(num_services: int,
+                  num_edges: Optional[int] = None) -> dict:
+    """Byte sizes of one RunSummary's collective-merged leaf groups.
+
+    Split by how the sharded merge moves them (parallel/sharded.py):
+
+    - ``replicated``: scalars, the two fine latency histograms, and
+      the non-svc-sharded metric series — ``psum`` over every axis,
+      every shard ends with a full copy;
+    - ``scattered``: the per-service duration / response-size
+      histograms — ``psum`` over the request axes then ``psum_scatter``
+      over ``svc``, each shard keeps a 1/svc tile.
+
+    Shapes mirror metrics/prometheus.py (duration hist (S, 2, 33),
+    size hists (., len(SIZE_BUCKETS)+1)) and metrics/histogram.py
+    (NUM_BUCKETS fine buckets); ``num_edges`` defaults to
+    ``num_services`` (tree-ish graphs have ~1 inbound edge/service).
+    """
+    from isotope_tpu.metrics.histogram import NUM_BUCKETS
+    from isotope_tpu.metrics.prometheus import (
+        DURATION_BUCKETS,
+        SIZE_BUCKETS,
+    )
+
+    s = max(int(num_services), 1)
+    e = int(num_edges) if num_edges else s
+    nsb = len(SIZE_BUCKETS) + 1
+    nb = len(DURATION_BUCKETS) + 1  # prometheus duration axis (_NB)
+    replicated = 4.0 * (
+        14                      # RunSummary scalars
+        + 2 * NUM_BUCKETS       # latency_hist + win_latency_hist
+        + s                     # incoming_total
+        + e * (2 + nsb)         # outgoing_total/size_sum/size_hist
+        + s * 2 * 2             # duration_sum + response_size_sum
+        + 2 * s                 # utilization + unstable
+    )
+    scattered = 4.0 * (s * 2 * nb + s * 2 * nsb)
+    return {"replicated": replicated, "scattered": scattered}
+
+
+def _collective_s(bytes_: float, participants: int, link: str,
+                  scatter: bool = False) -> float:
+    """Ring-collective time: latency per step + wire bytes.
+
+    All-reduce moves ``2 (p-1)/p`` of the payload per link;
+    reduce-scatter half that.  ``p == 1`` is free.
+    """
+    p = max(int(participants), 1)
+    if p == 1:
+        return 0.0
+    lat, bw = (
+        (DCN_LATENCY_S, DCN_BANDWIDTH_BYTES_S)
+        if link == "dcn"
+        else (ICI_LATENCY_S, ICI_BANDWIDTH_BYTES_S)
+    )
+    factor = (p - 1) / p if scatter else 2.0 * (p - 1) / p
+    return lat * (p - 1) + factor * bytes_ / bw
+
+
+def comm_table(
+    num_services: int,
+    data: int,
+    svc: int,
+    slices: int = 1,
+    num_edges: Optional[int] = None,
+    num_merges: int = 1,
+) -> List[dict]:
+    """Per-collective cost rows for one mesh layout's summary merge.
+
+    One row per collective the sharded merge issues (parallel/
+    sharded.py ``_merge_summary_collective``): the replicated ``psum``
+    over the ICI axes, the per-service ``psum_scatter`` over ``svc``,
+    and — when the layout has a DCN axis — the cross-slice ``psum`` of
+    both groups (issued LAST, on the already-scattered tiles, so DCN
+    carries 1/svc of the per-service state).  ``num_merges`` scales the
+    whole table (1 = the post-scan merge; collective/compute overlap
+    issues one merge per block).
+
+    Bytes are per-shard payloads; ``time_s`` prices each row with the
+    ICI/DCN constants above.
+    """
+    sizes = summary_bytes(num_services, num_edges)
+    s = max(int(num_services), 1)
+    s_pad = -(-s // max(svc, 1)) * max(svc, 1)
+    scat = sizes["scattered"] * (s_pad / s)     # svc-padding rides the wire
+    tile = scat / max(svc, 1)
+    rows = [
+        {
+            "collective": "psum_replicated",
+            "link": "ici",
+            "participants": data * svc,
+            "bytes": sizes["replicated"],
+            "time_s": _collective_s(
+                sizes["replicated"], data * svc, "ici"
+            ),
+        },
+        {
+            "collective": "psum_scatter_svc",
+            "link": "ici",
+            "participants": svc,
+            "bytes": scat,
+            "time_s": (
+                _collective_s(scat, svc, "ici", scatter=True)
+                # the request-axis psum feeding the scatter
+                + _collective_s(scat, data, "ici")
+            ),
+        },
+    ]
+    if slices > 1:
+        dcn_bytes = sizes["replicated"] + tile
+        rows.append({
+            "collective": "psum_dcn",
+            "link": "dcn",
+            "participants": slices,
+            "bytes": dcn_bytes,
+            "time_s": _collective_s(dcn_bytes, slices, "dcn"),
+        })
+    for r in rows:
+        r["time_s"] *= max(int(num_merges), 1)
+    return rows
 
 
 @dataclasses.dataclass(frozen=True)

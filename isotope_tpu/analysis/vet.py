@@ -145,6 +145,23 @@ def vet_simulator(
         # the engine's chosen bucket schedule, ranked by per-segment
         # critical-path cost (``vet --json`` surfaces it verbatim)
         report.meta["bucket_schedule"] = costmodel.schedule_rows(sim)
+        # the comm-augmented layout verdict (parallel/layout.py): what
+        # ``--mesh auto`` would pick for this topology on this host,
+        # with the per-collective ICI/DCN cost rows — the cost model
+        # feeding BACK into the mesh choice instead of dead-ending in
+        # a report (ISSUE 8)
+        try:
+            import jax
+
+            from isotope_tpu.parallel import layout as mesh_layout
+
+            chosen = mesh_layout.choose_layout(
+                jax.device_count(), sim.compiled.num_services,
+                max_slices=getattr(jax, "process_count", lambda: 1)(),
+            )
+            report.meta["mesh_layout"] = chosen.to_dict()
+        except Exception:  # pragma: no cover - advisory only
+            pass
         # a suppressed memory finding must also suppress the verdict
         report.meta["start_rung"] = (
             start_rung if mem_findings and any(

@@ -78,6 +78,28 @@ def _timeline_window(args):
     return dur.parse_duration_seconds(args.timeline)
 
 
+def _add_mesh_args(parser) -> None:
+    """The mesh-layout knobs (parallel/mesh.py + parallel/layout.py),
+    shared by simulate and sweep."""
+    parser.add_argument(
+        "--mesh", default=None, metavar="SPEC",
+        help="device-mesh factorization for sharded runs: 'auto' "
+             "(cost-model layout search over {data, svc, slice}), "
+             "'DATAxSVC[xSLICE]' (e.g. 4x2 or 2x2x2 — the slice axis "
+             "crosses DCN), or 'data=4,svc=2,slice=1'.  Also env "
+             "$ISOTOPE_MESH; default: the TOML mesh_data/mesh_svc "
+             "keys, else all devices on the data axis")
+    parser.add_argument(
+        "--overlap", action="store_true",
+        help="overlap the sharded metric-merge collectives with the "
+             "next request block's compute (double-buffered carry; "
+             "hides DCN merge latency).  Identical results up to f32 "
+             "reduction order; off by default (byte-identical "
+             "single-merge path).  Applies to the main summary run — "
+             "the --attribution/--timeline diagnostic passes keep "
+             "their single post-scan merge")
+
+
 def _add_vet_arg(parser) -> None:
     """The static pre-flight gate (analysis/), shared by every
     run-executing subcommand."""
@@ -181,6 +203,7 @@ def register(sub) -> None:
                    help="write the timestamped Prometheus exposition "
                         "(one sample per window, like a scrape "
                         "sequence)")
+    _add_mesh_args(s)
     _add_resilience_args(s)
     _add_vet_arg(s)
     s.set_defaults(func=run_simulate)
@@ -233,6 +256,7 @@ def register(sub) -> None:
                         "segment fences — diagnosis, not benchmarking)")
     _add_attribution_args(w)
     _add_timeline_args(w)
+    _add_mesh_args(w)
     _add_resilience_args(w)
     _add_vet_arg(w)
     w.set_defaults(func=run_sweep)
@@ -313,6 +337,8 @@ def run_simulate(args) -> int:
         entry=args.entry,
         attribution=args.attribution is not None,
         timeline=tl_window is not None,
+        mesh_spec=args.mesh,
+        overlap=args.overlap,
         **extra,
     )
     (result,) = run_experiment(config, policy=_policy(args),
@@ -560,6 +586,10 @@ def run_sweep(args) -> int:
     config = load_toml(args.config)
     if args.attribution and not config.attribution:
         config = dataclasses.replace(config, attribution=True)
+    if args.mesh:
+        config = dataclasses.replace(config, mesh_spec=args.mesh)
+    if args.overlap and not config.overlap:
+        config = dataclasses.replace(config, overlap=True)
     tl_window = _timeline_window(args)
     if tl_window is None and config.timeline:
         # [sim] timeline = true in the TOML arms the pass without a

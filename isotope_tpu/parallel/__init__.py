@@ -3,21 +3,36 @@
 The reference scales horizontally by deploying more namespaces x replicas
 onto more nodes (perf/load/common.sh:68-90); the simulator scales by
 sharding the (request x hop) event tensor over a ``jax.sharding.Mesh`` and
-merging metrics with XLA collectives over ICI — psum for counters and
-histograms, psum_scatter to leave per-service histogram state sharded over
-the ``svc`` axis (SURVEY.md §2.5, §5.8).
+merging metrics with XLA collectives — psum for counters and histograms
+over ICI, psum_scatter to leave per-service histogram state sharded over
+the ``svc`` axis, and a final cross-``slice`` psum over DCN on multi-host
+meshes (SURVEY.md §2.5, §5.8).
+
+The mesh itself can be an explicit spec (``--mesh`` / ``$ISOTOPE_MESH``),
+an Automap-style cost-model search (``--mesh auto``, parallel/layout.py),
+or an :class:`EmulatedMesh` that replays any host count on one device.
 """
 from isotope_tpu.parallel.mesh import (
+    EmulatedMesh,
+    MeshSpec,
+    build_mesh,
     default_mesh,
     make_mesh,
     make_multislice_mesh,
+    mesh_spec_from_env,
+    parse_mesh_spec,
 )
 from isotope_tpu.parallel.sharded import ShardedSimulator, ShardedSummary
 
 __all__ = [
+    "EmulatedMesh",
+    "MeshSpec",
+    "build_mesh",
     "default_mesh",
     "make_mesh",
     "make_multislice_mesh",
+    "mesh_spec_from_env",
+    "parse_mesh_spec",
     "ShardedSimulator",
     "ShardedSummary",
 ]

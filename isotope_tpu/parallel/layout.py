@@ -1,0 +1,152 @@
+"""Automap-style mesh-layout search (``--mesh auto``).
+
+The PR 4 vet cost model estimates per-segment FLOPs/bytes but the mesh
+factorization itself was hardcoded (``{'slice': 2, 'data': 2,
+'svc': 2}`` in the multichip dryrun, ``mesh_data x mesh_svc`` in sweep
+configs).  Automap (PAPERS.md) argues the factorization should be
+*searched* from a cost model instead; this module does exactly that
+over the engine's tiny decision space:
+
+- every shard simulates a disjoint request slice, so COMPUTE is
+  embarrassingly parallel across the whole mesh regardless of the
+  factorization — what distinguishes layouts is the metric-merge
+  COMMUNICATION (costmodel.comm_table prices each collective with
+  ICI/DCN bandwidth constants) plus the ``svc``-padding waste;
+- a wider ``svc`` axis turns the big per-service histogram all-reduce
+  into a cheaper reduce-scatter and shrinks the payload any DCN axis
+  must carry (the DCN psum runs LAST, on already-scattered tiles), but
+  pads ``S`` up to a multiple of ``svc``;
+- a ``slice`` (DCN) axis is pure cost on a single host — the search
+  only proposes one when the caller says hosts exist (``max_slices``),
+  and then pins it to the host count (each host is one slice; any
+  other factor would put ICI axes across DCN).
+
+The search is exhaustive — the space is divisor-triples of the device
+count, a few dozen candidates — and deterministic (ties break toward
+fewer slices, then narrower ``svc``).
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional
+
+from isotope_tpu.parallel.mesh import MeshSpec
+
+
+@dataclasses.dataclass(frozen=True)
+class LayoutScore:
+    """One scored candidate factorization."""
+
+    spec: MeshSpec
+    score_s: float            # modeled merge time per run (lower = better)
+    comm_rows: tuple          # the costmodel.comm_table rows
+    pad_fraction: float       # svc-padding waste, (s_pad - S) / S
+
+    def to_dict(self) -> dict:
+        return {
+            "mesh": self.spec.describe(),
+            "score_s": self.score_s,
+            "pad_fraction": self.pad_fraction,
+            "comm": [dict(r) for r in self.comm_rows],
+        }
+
+
+def _divisors(n: int) -> List[int]:
+    return [d for d in range(1, n + 1) if n % d == 0]
+
+
+def enumerate_specs(
+    n_devices: int,
+    num_services: int,
+    max_slices: int = 1,
+) -> List[MeshSpec]:
+    """All valid ``{slice, data, svc}`` factorizations of the devices.
+
+    Constraints: the product must equal ``n_devices`` (the search
+    respects the device count — it never over- or under-subscribes),
+    the ``svc`` axis is never wider than the service count (a shard
+    owning only padding does no useful metric work), and with
+    ``max_slices > 1`` EVERY candidate uses exactly ``max_slices``
+    slices: hosts ARE slices, so a flat mesh spanning several hosts
+    would run its ``data``/``svc`` collectives across DCN while the
+    model priced them as ICI — the one mispricing the search must
+    never offer.
+    """
+    if n_devices < 1:
+        raise ValueError(f"n_devices must be >= 1, got {n_devices}")
+    if max_slices > 1 and n_devices % max_slices:
+        raise ValueError(
+            f"{n_devices} devices do not divide over {max_slices} "
+            f"hosts/slices (a slice must own whole hosts)"
+        )
+    specs = []
+    slice_options = [max_slices] if max_slices > 1 else [1]
+    for slices in slice_options:
+        per_slice = n_devices // slices
+        for svc in _divisors(per_slice):
+            if svc > max(num_services, 1):
+                continue
+            specs.append(
+                MeshSpec(data=per_slice // svc, svc=svc, slices=slices)
+            )
+    return specs
+
+
+def score_layout(
+    spec: MeshSpec,
+    num_services: int,
+    num_edges: Optional[int] = None,
+    num_merges: int = 1,
+) -> LayoutScore:
+    """Price one candidate with the comm-augmented vet cost model."""
+    from isotope_tpu.analysis import costmodel
+
+    rows = costmodel.comm_table(
+        num_services,
+        data=spec.data,
+        svc=spec.svc,
+        slices=spec.slices,
+        num_edges=num_edges,
+        num_merges=num_merges,
+    )
+    s = max(num_services, 1)
+    s_pad = -(-s // spec.svc) * spec.svc
+    pad = (s_pad - s) / s
+    # padding inflates every per-service device-side accumulation a
+    # run performs, not just the merge wire time: charge it as a
+    # fraction of the scattered payload at ICI speed per merge
+    pad_s = (
+        pad
+        * costmodel.summary_bytes(num_services, num_edges)["scattered"]
+        / costmodel.ICI_BANDWIDTH_BYTES_S
+        * max(num_merges, 1)
+    )
+    return LayoutScore(
+        spec=spec,
+        score_s=sum(r["time_s"] for r in rows) + pad_s,
+        comm_rows=tuple(tuple(r.items()) for r in rows),
+        pad_fraction=pad,
+    )
+
+
+def choose_layout(
+    n_devices: int,
+    num_services: int,
+    num_edges: Optional[int] = None,
+    max_slices: int = 1,
+    num_merges: int = 1,
+) -> LayoutScore:
+    """The best-scoring factorization for one topology.
+
+    Deterministic: among equal scores the tie breaks toward fewer
+    slices, then a narrower ``svc`` axis (closest to the historic
+    all-data default).
+    """
+    candidates = [
+        score_layout(spec, num_services, num_edges, num_merges)
+        for spec in enumerate_specs(n_devices, num_services, max_slices)
+    ]
+    return min(
+        candidates,
+        key=lambda c: (c.score_s, c.spec.slices, c.spec.svc),
+    )

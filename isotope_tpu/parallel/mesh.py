@@ -2,13 +2,13 @@
 
 Axis conventions:
 
-- ``slice`` (optional, outermost): multi-slice scale-out — collectives
-  crossing it ride DCN.  This is pure request-parallelism (more load
-  per wall-second); per-request work never crosses it and only the
-  O(buckets) summary reduction does, so the DCN traffic per run is a
-  few KB regardless of request count.  NOTE: it does NOT model the
-  reference's cluster1/cluster2 *topology* split — that is a property
-  of the simulated system, modeled by per-service ``cluster``
+- ``slice`` (optional, outermost): multi-slice / multi-host scale-out —
+  collectives crossing it ride DCN.  This is pure request-parallelism
+  (more load per wall-second); per-request work never crosses it and
+  only the O(buckets) summary reduction does, so the DCN traffic per
+  run is a few KB regardless of request count.  NOTE: it does NOT model
+  the reference's cluster1/cluster2 *topology* split — that is a
+  property of the simulated system, modeled by per-service ``cluster``
   placement plus the cross-cluster NetworkModel edge class
   (perf/load/templates/service-graph.gen.yaml:1-3; see
   tests/test_multicluster.py), independent of how the simulation
@@ -19,18 +19,227 @@ Axis conventions:
 - ``svc``: shards per-service metric state (the analogue of services
   living on different nodes/namespaces).  Compute for all hops is still
   data-parallel; cross-``svc`` traffic is the metrics reduce-scatter.
+
+DCN-awareness is purely positional: the ``slice`` axis is OUTERMOST, so
+on real multi-slice hardware (devices ordered slice-major, the order
+``jax.devices()`` already uses) the ``data``/``svc`` collectives stay
+on ICI and only the ``slice`` reduction crosses DCN.
+
+A mesh can come from three places, in priority order (runner/run.py):
+
+1. an explicit spec — CLI ``--mesh`` or env ``$ISOTOPE_MESH`` —
+   ``"auto"`` (cost-model search, parallel/layout.py), positional
+   ``"DATAxSVC[xSLICE]"`` (e.g. ``4x2`` or ``2x2x2``), or named
+   ``"data=4,svc=2,slice=1"``;
+2. the legacy ``[sim] mesh_data`` / ``mesh_svc`` TOML keys;
+3. the built-in all-devices-on-data factorization.
+
+:class:`EmulatedMesh` carries a mesh *shape* with no devices behind it:
+``ShardedSimulator`` accepts one and replays the full shard program
+shard-by-shard on a single device (``run_emulated``), so any host
+count — 2 hosts x 8 devices, 64 x 4, ... — is testable bit-for-bit on
+one CPU in CI before a pod exists.
 """
 from __future__ import annotations
 
-from typing import Optional, Sequence
+import dataclasses
+import os
+from typing import Optional, Sequence, Union
 
 import jax
 import numpy as np
 from jax.sharding import Mesh
 
+from isotope_tpu.models.errors import config_path
+
 SLICE_AXIS = "slice"
 DATA_AXIS = "data"
 SVC_AXIS = "svc"
+
+#: valid axis names for named ``--mesh`` specs, in mesh (outer->inner)
+#: order
+AXIS_ORDER = (SLICE_AXIS, DATA_AXIS, SVC_AXIS)
+
+ENV_MESH = "ISOTOPE_MESH"
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshSpec:
+    """An axis factorization — the logical mesh before devices exist.
+
+    ``slices`` is the DCN (multi-host / multi-slice) axis; ``data`` and
+    ``svc`` stay on ICI.  ``slices == 1`` collapses to the plain
+    ``(data, svc)`` mesh (no DCN axis is materialized).
+    """
+
+    data: int
+    svc: int = 1
+    slices: int = 1
+
+    def __post_init__(self):
+        for name, v in (("data", self.data), ("svc", self.svc),
+                        ("slice", self.slices)):
+            if int(v) < 1:
+                with config_path(f"mesh.{name}"):
+                    raise ValueError(
+                        f"axis size must be >= 1, got {v}"
+                    )
+
+    @property
+    def size(self) -> int:
+        return self.slices * self.data * self.svc
+
+    @property
+    def axis_names(self):
+        if self.slices > 1:
+            return (SLICE_AXIS, DATA_AXIS, SVC_AXIS)
+        return (DATA_AXIS, SVC_AXIS)
+
+    @property
+    def shape(self) -> dict:
+        if self.slices > 1:
+            return {SLICE_AXIS: self.slices, DATA_AXIS: self.data,
+                    SVC_AXIS: self.svc}
+        return {DATA_AXIS: self.data, SVC_AXIS: self.svc}
+
+    def describe(self) -> str:
+        """Canonical named form (``data=4,svc=2`` / ``+,slice=2``)."""
+        s = f"data={self.data},svc={self.svc}"
+        if self.slices > 1:
+            s += f",slice={self.slices}"
+        return s
+
+
+class EmulatedMesh:
+    """A mesh SHAPE with no devices — the multi-host emulation handle.
+
+    Mimics the slice of the ``jax.sharding.Mesh`` API the sharded
+    runner reads (``axis_names`` / ``shape`` / ``size``) so
+    ``ShardedSimulator`` can plan and replay an N-host program
+    shard-by-shard on one device (``run_emulated`` and friends); the
+    ``shard_map`` entry points raise — there is nothing to map over.
+    """
+
+    def __init__(self, spec: MeshSpec):
+        self.spec = spec
+        self.axis_names = spec.axis_names
+        self.shape = spec.shape
+        self.size = spec.size
+        self.devices = None
+
+    def __repr__(self) -> str:
+        return f"EmulatedMesh({self.spec.describe()})"
+
+
+MeshLike = Union[Mesh, EmulatedMesh]
+
+
+def parse_mesh_spec(text: str) -> Union[str, MeshSpec]:
+    """Parse a ``--mesh`` / ``$ISOTOPE_MESH`` value.
+
+    Returns the string ``"auto"`` (layout search, parallel/layout.py)
+    or a :class:`MeshSpec`.  Accepted forms::
+
+        auto
+        4x2          # data x svc
+        2x2x2        # data x svc x slice
+        data=4,svc=2,slice=1   # named, any subset, any order
+
+    Errors are key-pathed (``mesh.svc: ...``) like every other config
+    decode in the tree (models/errors.py).
+    """
+    text = text.strip()
+    if not text:
+        with config_path("mesh"):
+            raise ValueError("empty mesh spec")
+    if text.lower() == "auto":
+        return "auto"
+    if "=" in text:
+        sizes = {}
+        for part in text.split(","):
+            part = part.strip()
+            if not part:
+                continue
+            key, _, val = part.partition("=")
+            key = key.strip()
+            if key not in AXIS_ORDER:
+                with config_path("mesh"):
+                    raise ValueError(
+                        f"unknown mesh axis {key!r} (valid axes: "
+                        f"{', '.join(AXIS_ORDER)})"
+                    )
+            if key in sizes:
+                with config_path(f"mesh.{key}"):
+                    raise ValueError("axis given twice")
+            with config_path(f"mesh.{key}"):
+                try:
+                    sizes[key] = int(val.strip())
+                except ValueError:
+                    raise ValueError(
+                        f"axis size must be an integer, got "
+                        f"{val.strip()!r}"
+                    ) from None
+        if not sizes:
+            with config_path("mesh"):
+                raise ValueError("empty mesh spec")
+        return MeshSpec(
+            data=sizes.get(DATA_AXIS, 1),
+            svc=sizes.get(SVC_AXIS, 1),
+            slices=sizes.get(SLICE_AXIS, 1),
+        )
+    parts = [p.strip() for p in text.lower().split("x")]
+    if len(parts) not in (1, 2, 3):
+        with config_path("mesh"):
+            raise ValueError(
+                f"bad mesh spec {text!r} (want 'auto', 'DATAxSVC', "
+                f"'DATAxSVCxSLICE', or 'data=4,svc=2,slice=1')"
+            )
+    dims = []
+    for name, part in zip(("data", "svc", "slice"), parts):
+        with config_path(f"mesh.{name}"):
+            try:
+                dims.append(int(part))
+            except ValueError:
+                raise ValueError(
+                    f"axis size must be an integer, got {part!r}"
+                ) from None
+    while len(dims) < 3:
+        dims.append(1)
+    return MeshSpec(data=dims[0], svc=dims[1], slices=dims[2])
+
+
+def mesh_spec_from_env() -> Optional[Union[str, MeshSpec]]:
+    """The ``$ISOTOPE_MESH`` spec, or None when unset/empty."""
+    raw = os.environ.get(ENV_MESH, "").strip()
+    if not raw:
+        return None
+    with config_path(ENV_MESH):
+        return parse_mesh_spec(raw)
+
+
+def build_mesh(
+    spec: MeshSpec,
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> Mesh:
+    """Materialize a spec over real devices (DCN axis outermost).
+
+    Raises a key-pathed config error when the spec wants more devices
+    than exist — the same failure text whether the spec came from the
+    CLI, the env, or a TOML.
+    """
+    devices = list(devices) if devices is not None else jax.devices()
+    if spec.size > len(devices):
+        with config_path("mesh"):
+            raise ValueError(
+                f"mesh {spec.describe()} needs {spec.size} devices, "
+                f"have {len(devices)} (use an EmulatedMesh / "
+                f"run_emulated to replay more hosts than exist)"
+            )
+    if spec.slices > 1:
+        return make_multislice_mesh(
+            spec.slices, spec.data, spec.svc, devices
+        )
+    return make_mesh(spec.data, spec.svc, devices)
 
 
 def make_mesh(

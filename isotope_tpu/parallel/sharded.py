@@ -16,6 +16,14 @@ the hop program is replicated (topology tensors are tiny next to the event
 tensor) and requests are independent given the analytic queue model, so
 the only communication is the metric reduction — the design that makes
 >1e9 hop-events/s reachable on a v5e-8.
+
+Multi-host (DCN) awareness: a mesh with a ``slice`` axis reduces the
+ICI axes first and crosses DCN last, on already-scattered per-service
+tiles; ``SimParams.overlap=True`` additionally pipelines the merge
+collectives one block behind the compute (``_overlap_body``) so DCN
+latency hides behind the next block's event sweep.  An
+:class:`~isotope_tpu.parallel.mesh.EmulatedMesh` runs the whole thing
+shard-by-shard on one device — any host count, no pod required.
 """
 from __future__ import annotations
 
@@ -25,7 +33,7 @@ from typing import Dict, NamedTuple, Tuple
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax.sharding import Mesh, PartitionSpec as P
+from jax.sharding import PartitionSpec as P
 
 from isotope_tpu import telemetry
 from isotope_tpu.compiler.cache import (
@@ -35,10 +43,16 @@ from isotope_tpu.compiler.cache import (
 from isotope_tpu.resilience import faults
 from isotope_tpu.compiler.program import CompiledGraph
 from isotope_tpu.metrics.prometheus import MetricsCollector, ServiceMetrics
-from isotope_tpu.parallel.mesh import SVC_AXIS
+from isotope_tpu.parallel.mesh import SLICE_AXIS, SVC_AXIS, EmulatedMesh
 from isotope_tpu.sim.config import OPEN_LOOP, LoadModel, SimParams
 from isotope_tpu.sim.engine import Simulator
-from isotope_tpu.sim.summary import RunSummary, reduce_stacked, summarize
+from isotope_tpu.sim.summary import (
+    RunSummary,
+    reduce_stacked,
+    summarize,
+    summary_accumulate,
+    zeros_summary,
+)
 
 # back-compat alias: the sharded path now returns the same summary type
 # the single-device scan path produces
@@ -88,7 +102,7 @@ class ShardedSimulator:
     def __init__(
         self,
         compiled: CompiledGraph,
-        mesh: Mesh,
+        mesh,  # jax.sharding.Mesh | EmulatedMesh
         params: SimParams = SimParams(),
         chaos=(),
         churn=(),
@@ -96,6 +110,11 @@ class ShardedSimulator:
     ):
         self.compiled = compiled
         self.mesh = mesh
+        # an EmulatedMesh carries a mesh SHAPE with no devices: every
+        # run_*_emulated twin replays it shard-by-shard on one device
+        # (any host count on a laptop); the shard_map entry points
+        # raise a clear error instead of tracing
+        self.emulated = isinstance(mesh, EmulatedMesh)
         # persistent XLA cache (no-op unless $ISOTOPE_COMPILE_CACHE is
         # set): the sharded sweep programs are the most expensive
         # compiles in the system, so wire the disk cache here too
@@ -112,6 +131,19 @@ class ShardedSimulator:
         # summary reduction ever crosses the slice (DCN) axis
         self.request_axes = tuple(
             a for a in mesh.axis_names if a != SVC_AXIS
+        )
+        # DCN-aware merge order: ICI axes reduce first (inside every
+        # slice/host), the slice axis last — and on the per-service
+        # state only AFTER the svc reduce-scatter, so DCN carries
+        # 1/svc of the histogram payload once per merge
+        self.dcn_axes = tuple(
+            a for a in mesh.axis_names if a == SLICE_AXIS
+        )
+        self.ici_axes = tuple(
+            a for a in mesh.axis_names if a != SLICE_AXIS
+        )
+        self.ici_request_axes = tuple(
+            a for a in self.request_axes if a != SLICE_AXIS
         )
         self.n_svc = mesh.shape[SVC_AXIS]
         self.n_shards = mesh.size
@@ -138,6 +170,7 @@ class ShardedSimulator:
         ``trim=True`` accumulates the collector's steady-state window
         into the summary's ``win_*`` fields (see Simulator.run_summary).
         """
+        self._require_mesh("run")
         plan = self._plan_run(load, num_requests, key, offered_qps,
                               block_size, trim)
         # shard balance: the rows actually simulated are num_blocks *
@@ -155,6 +188,12 @@ class ShardedSimulator:
                        plan.conns_local, plan.trim, plan.sat_conns)
         vis, windows = self._args_put(plan)
         faults.check("sharded.compute")
+        if self.dcn_axes:
+            # the dropped-DCN-collective chaos site: a mesh with a
+            # slice axis is about to issue cross-host collectives;
+            # injected transients here exercise the supervisor's retry
+            # path without real hosts (resilience/faults.py)
+            faults.check("sharded.dcn_collective")
         out = fn(
             key, jnp.float32(plan.offered), jnp.float32(plan.gap),
             jnp.float32(plan.nominal_gap),
@@ -253,11 +292,26 @@ class ShardedSimulator:
 
     # ------------------------------------------------------------------
 
+    def _require_mesh(self, what: str) -> None:
+        """The shard_map entry points need real devices behind the mesh."""
+        if self.emulated:
+            raise ValueError(
+                f"{what} needs a device mesh; this ShardedSimulator "
+                f"was built over {self.mesh!r} (no devices) — use the "
+                f"*_emulated twin, which replays any host count on "
+                f"one device"
+            )
+
     def _get(self, block: int, num_blocks: int, kind: str,
              conns_local: int, trim: bool = False, sat_conns: int = 0):
         cache_key = (block, num_blocks, kind, conns_local, trim, sat_conns)
         if cache_key not in self._fns:
-            body = partial(self._body, block, num_blocks, kind, conns_local,
+            main = (
+                self._overlap_body
+                if self.sim.params.overlap
+                else self._body
+            )
+            body = partial(main, block, num_blocks, kind, conns_local,
                            trim, sat_conns)
             mapped = _shard_map(
                 body,
@@ -401,23 +455,122 @@ class ShardedSimulator:
         )
         return self._merge_summary_collective(local, both)
 
+    def _overlap_body(
+        self,
+        block: int,
+        num_blocks: int,
+        kind: str,
+        conns_local: int,
+        trim: bool,
+        sat_conns: int,
+        key: jax.Array,
+        offered_qps: jax.Array,
+        pace_gap: jax.Array,
+        nominal_gap: jax.Array,
+        win_lo: jax.Array,
+        win_hi: jax.Array,
+        visits_pc: jax.Array,
+        phase_windows: jax.Array,
+    ) -> RunSummary:
+        """``_body`` with the merge collectives pipelined into the scan.
+
+        Double-buffered carry: block *k*'s summary rides the carry as
+        ``pending`` and its psum/psum_scatter merge is issued at the
+        TOP of step *k+1*, before that step's event sweep — the
+        collective's result is only consumed by the cheap
+        ``summary_accumulate`` fold, so the scheduler has a full
+        block's compute to hide the (DCN) merge latency behind.  Step 0
+        merges a zero primer (one extra tiny collective round per run);
+        the last block's merge happens after the scan, un-overlapped.
+
+        Identical RNG streams and per-block summaries to ``_body`` —
+        only the reduction ORDER differs (per-block cross-shard merge,
+        then across blocks, instead of blocks-then-shards), so
+        integer-valued fields match exactly and float sums to
+        reduction-order f32 noise (pinned by tests/test_multihost.py).
+        """
+        both = tuple(self.mesh.axis_names)
+        shard = jnp.int32(0)
+        for a in self.mesh.axis_names:
+            shard = shard * self.mesh.shape[a] + jax.lax.axis_index(a)
+        local_key = jax.random.fold_in(key, 500_000 + shard)
+        c = max(conns_local, 1)
+        per = block // c
+        S = self.compiled.num_services
+
+        def block_body(carry, b):
+            (t0, conn_t0, req_off), pending, acc = carry
+            acc = summary_accumulate(
+                acc, self._merge_summary_collective(pending, both)
+            )
+            kb = jax.random.fold_in(local_key, 1_000_000 + b)
+            res, t_end, conn_end = self.sim._simulate_core(
+                block, kind, conns_local, kb, offered_qps, pace_gap,
+                offered_qps / self.n_shards, nominal_gap, t0, conn_t0,
+                req_off,
+                sat_conns=sat_conns,
+                visits_pc=visits_pc,
+                phase_windows=phase_windows,
+            )
+            s = summarize(
+                res, self.collector,
+                window=(win_lo, win_hi) if trim else None,
+            )
+            return ((t_end, conn_end, req_off + per), s, acc), None
+
+        carry0 = (
+            (
+                jnp.float32(0.0),
+                jnp.zeros((c,), jnp.float32),
+                jnp.float32(0.0),
+            ),
+            # the pre-merge primer carries full-S metric shapes; the
+            # accumulator holds the post-scatter 1/svc tiles
+            zeros_summary(self.collector, S),
+            zeros_summary(self.collector, S,
+                          svc_rows=self.s_pad // self.n_svc),
+        )
+        (_, pending, acc), _ = jax.lax.scan(
+            block_body, carry0, jnp.arange(num_blocks)
+        )
+        return summary_accumulate(
+            acc, self._merge_summary_collective(pending, both)
+        )
+
     def _merge_summary_collective(self, local: RunSummary,
                                   both) -> RunSummary:
         """The mesh metric reduction over one shard's RunSummary
-        (shared by the plain and the attributed bodies)."""
-        def allsum(x):
-            return jax.lax.psum(x, both)
+        (shared by the plain, overlap, and attributed bodies).
 
-        # per-service hists: reduce over the request axes (incl. the
-        # DCN slice axis, if any), stay sharded over svc
+        DCN-aware ordering: the ICI axes (``data``/``svc`` — inside one
+        slice/host) reduce first, the ``slice`` axis last; the
+        per-service histograms reduce-scatter over ``svc`` BEFORE the
+        cross-slice psum, so DCN carries a 1/svc tile of the
+        per-service state instead of the full (S, ...) tensors.
+        Without a slice axis this lowers to the exact same collectives
+        as before (single-host default stays byte-identical).
+        """
+        dcn = self.dcn_axes
+
+        def allsum(x):
+            x = jax.lax.psum(x, self.ici_axes)
+            return jax.lax.psum(x, dcn) if dcn else x
+
+        def pextreme(op, x):
+            x = op(x, self.ici_axes)
+            return op(x, dcn) if dcn else x
+
+        # per-service hists: reduce over the ICI request axes, scatter
+        # over svc, THEN cross the DCN axis on the scattered tiles
         def scatter_svc(x):
-            x = jax.lax.psum(x, self.request_axes)
+            x = jax.lax.psum(x, self.ici_request_axes)
             pad = self.s_pad - x.shape[0]
             if pad:
                 x = jnp.pad(x, [(0, pad)] + [(0, 0)] * (x.ndim - 1))
-            return jax.lax.psum_scatter(
+            x = jax.lax.psum_scatter(
                 x, SVC_AXIS, scatter_dimension=0, tiled=True
             )
+            return jax.lax.psum(x, dcn) if dcn else x
 
         m = local.metrics
         metrics = ServiceMetrics(
@@ -445,10 +598,10 @@ class ShardedSimulator:
             hop_events=allsum(local.hop_events),
             latency_sum=s_tot,
             latency_m2=m2_tot,
-            latency_min=jax.lax.pmin(local.latency_min, both),
-            latency_max=jax.lax.pmax(local.latency_max, both),
+            latency_min=pextreme(jax.lax.pmin, local.latency_min),
+            latency_max=pextreme(jax.lax.pmax, local.latency_max),
             latency_hist=allsum(local.latency_hist),
-            end_max=jax.lax.pmax(local.end_max, both),
+            end_max=pextreme(jax.lax.pmax, local.end_max),
             win_lo=local.win_lo,   # identical on every shard
             win_hi=local.win_hi,
             win_count=allsum(local.win_count),
@@ -483,6 +636,7 @@ class ShardedSimulator:
             raise ValueError(
                 "attributed runs need SimParams(attribution=True)"
             )
+        self._require_mesh("run_attributed")
         if tail and tail_cut is None:
             tail_cut = self.sim.estimate_tail_cut(
                 load, num_requests, key, block_size=block_size
@@ -770,6 +924,7 @@ class ShardedSimulator:
             raise ValueError(
                 "timeline runs need SimParams(timeline=True)"
             )
+        self._require_mesh("run_timeline")
         plan = self._plan_run(load, num_requests, key, offered_qps,
                               block_size, trim)
         tl_plan = self._timeline_plan(plan, window_s)
@@ -998,16 +1153,28 @@ class ShardedSimulator:
     ) -> RunSummary:
         """The sharded program replayed shard-by-shard on one device.
 
-        The OOM degradation ladder's ``single-device`` rung: when the
-        full mesh program exhausts HBM (or devices are lost), each
-        shard's block scan — bit-identical RNG streams, identical
-        blocking, via the shared ``_local_scan`` body — executes
-        serially on the default device, and the metric collectives are
-        replayed on host (sums in f64, Chan/Welford merge in the same
-        f32 steps the mesh reduction takes).  Peak live memory is one
-        shard's event tensors instead of the whole mesh's.  Results
-        match the shard_map path to f32 reduction-order precision
-        (<= 1 ULP on every field; pinned by tests/test_resilience.py).
+        Two jobs share this path:
+
+        - the OOM degradation ladder's ``single-device`` rung: when
+          the full mesh program exhausts HBM (or devices are lost),
+          each shard's block scan — bit-identical RNG streams,
+          identical blocking, via the shared ``_local_scan`` body —
+          executes serially on the default device, and the metric
+          collectives are replayed on host.  Peak live memory is one
+          shard's event tensors instead of the whole mesh's;
+        - the **emulated multi-host twin**: built over an
+          :class:`~isotope_tpu.parallel.mesh.EmulatedMesh`, the same
+          loop replays ANY host count (2 hosts x 8 devices, 64 x 4,
+          ...) on one CPU — the CI pin for multi-host programs before
+          a pod exists.
+
+        Results match the shard_map path to f32 reduction-order
+        precision (<= 1 ULP on every field, measured bit-equal on CPU;
+        pinned by tests/test_resilience.py and tests/test_multihost.py).
+        The host merge always replays the overlap=off reduction order
+        (blocks within a shard, then shards): with ``overlap=True`` the
+        device path's per-block collective order differs by f32
+        reduction order only.
         """
         plan = self._plan_run(load, num_requests, key, offered_qps,
                               block_size, trim)
@@ -1055,18 +1222,37 @@ class ShardedSimulator:
         merge, so the degraded path's results are indistinguishable
         from the mesh path's.
         """
+        # DCN-aware association replay: the device merge reduces the
+        # ICI axes first (one psum per slice) and the slice axis last,
+        # so the host sums each slice's shards sequentially, then the
+        # slice partials — the order XLA's CPU collectives take
+        # (measured bit-equal; a flat sum differs by 1 ULP on float
+        # sums once a slice axis exists)
+        n_slices = dict(self.mesh.shape).get(SLICE_AXIS, 1)
+        per_slice = len(shards) // max(n_slices, 1)
+
         def stack(get):
             return np.stack([np.asarray(get(s)) for s in shards])
 
-        def allsum(get):
-            acc = np.asarray(get(shards[0]))
-            for s in shards[1:]:
-                acc = acc + np.asarray(get(s))  # elementwise, own dtype
+        def _seq(vals):
+            acc = vals[0]
+            for v in vals[1:]:
+                acc = acc + v  # elementwise, own dtype
             return acc
+
+        def _hier(vals):
+            return _seq([
+                _seq(vals[i * per_slice:(i + 1) * per_slice])
+                for i in range(max(n_slices, 1))
+            ])
+
+        def allsum(get):
+            return _hier([np.asarray(get(s)) for s in shards])
 
         def scatter_svc(get):
             # psum over request axes + tiled psum_scatter over svc ==
             # the zero-padded total sum laid out over the svc axis
+            # (histogram counts: integer-valued, order-insensitive)
             x = allsum(get)
             pad = self.s_pad - x.shape[0]
             if pad:
@@ -1081,9 +1267,7 @@ class ShardedSimulator:
         mean_local = sums / np.maximum(counts, counts.dtype.type(1.0))
         mean_tot = s_tot / np.maximum(n_tot, n_tot.dtype.type(1.0))
         terms = m2s + counts * (mean_local - mean_tot) ** 2
-        m2_tot = terms[0]
-        for t in terms[1:]:
-            m2_tot = m2_tot + t
+        m2_tot = _hier(list(terms))
         m = shards[0].metrics
         metrics = None
         if m is not None:

@@ -24,7 +24,9 @@ comma-separated ``kind:site[:arg]`` entries:
 
 Sites are the supervisor's phase names: ``engine.build``,
 ``engine.run``, ``sharded.args_put``, ``sharded.compute``,
-``sharded.gather``, ``cache.load``.  ``check(site)`` is a dict lookup
+``sharded.dcn_collective`` (DCN-axis meshes only — the dropped
+cross-host collective), ``sharded.gather``, ``cache.load``.
+``check(site)`` is a dict lookup
 returning immediately when no plan is armed — the default no-fault
 path gains zero work and zero sync points.
 """
@@ -56,6 +58,10 @@ VALID_SITES = (
     "engine.run",
     "sharded.args_put",
     "sharded.compute",
+    # fires only when the mesh has a DCN (slice) axis — the
+    # dropped-cross-host-collective chaos site, so the transient
+    # retry path for jaxlib DCN errors is testable without real hosts
+    "sharded.dcn_collective",
     "sharded.gather",
     "cache.load",
 )

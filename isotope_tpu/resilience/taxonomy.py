@@ -46,7 +46,21 @@ _RESOURCE_RE = re.compile(
 _TRANSIENT_RE = re.compile(
     r"UNAVAILABLE|DEADLINE_EXCEEDED|\bABORTED\b|\bCANCELLED\b"
     r"|[Cc]onnection reset|[Ss]ocket closed|[Tt]emporarily unavailable"
-    r"|[Tt]ry again|[Pp]reempt",
+    r"|[Tt]ry again|[Pp]reempt"
+    # jaxlib DCN / multi-host collective failures (the PR 3 follow-up,
+    # armed now that multi-host runs exist): cross-slice transfers and
+    # the coordination service fail transiently when a peer host
+    # stalls, restarts, or a DCN flow drops — a retry against healthy
+    # hosts is expected to succeed.  Signatures collected from
+    # jaxlib/XLA status text: MegaScale/DCN transfer engine errors,
+    # collective/barrier timeouts, coordination-service heartbeat
+    # loss, and gRPC's connect-failure phrasing.
+    r"|[Mm]ega[Ss]cale|\bDCN\b"
+    r"|[Cc]ollective (?:operation|permute)? ?timed out"
+    r"|[Bb]arrier timed out|[Hh]eartbeat timeout"
+    r"|[Cc]oordination service (?:agent|error|is unavailable)"
+    r"|failed to connect to all addresses"
+    r"|[Tt]ransfer server|[Pp]eer task .* (?:failed|disconnected)",
 )
 _CORRUPT_RE = re.compile(
     r"unpickl|[Cc]orrupt|[Dd]igest mismatch|deserial|[Bb]ad cache entry"

@@ -132,6 +132,14 @@ class ExperimentConfig:
     service_time_param: float = SimParams().service_time_param
     mesh_data: int = 0                   # 0 => all devices
     mesh_svc: int = 1
+    # explicit mesh spec (CLI --mesh / TOML [sim] mesh / $ISOTOPE_MESH):
+    # "auto" (cost-model layout search, parallel/layout.py),
+    # "DATAxSVC[xSLICE]", or "data=4,svc=2,slice=1".  Overrides the
+    # legacy mesh_data/mesh_svc pair when set.
+    mesh_spec: Optional[str] = None
+    # collective/compute overlap on sharded runs (SimParams.overlap):
+    # merge collectives pipeline one block behind the event sweeps
+    overlap: bool = False
     labels: str = ""
     chaos: Tuple[ChaosEvent, ...] = ()
     churn: Tuple[TrafficSplit, ...] = ()
@@ -157,6 +165,7 @@ class ExperimentConfig:
             attribution=self.attribution,
             timeline=self.timeline,
             timeline_window_s=self.timeline_window_s,
+            overlap=self.overlap,
         )
 
     def load_models(self):
@@ -365,6 +374,8 @@ def load_toml(path) -> ExperimentConfig:
         ),
         mesh_data=int(sim.get("mesh_data", 0)),
         mesh_svc=int(sim.get("mesh_svc", 1)),
+        mesh_spec=sim.get("mesh"),
+        overlap=bool(sim.get("overlap", False)),
         labels=doc.get("labels", ""),
         chaos=tuple(chaos),
         churn=tuple(churn),

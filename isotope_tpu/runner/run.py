@@ -40,7 +40,13 @@ from isotope_tpu.metrics.fortio import (
 )
 from isotope_tpu.metrics.prometheus import MetricsCollector
 from isotope_tpu.models.graph import ServiceGraph
-from isotope_tpu.parallel import ShardedSimulator, make_mesh
+from isotope_tpu.parallel import (
+    MeshSpec,
+    ShardedSimulator,
+    build_mesh,
+    mesh_spec_from_env,
+    parse_mesh_spec,
+)
 from isotope_tpu.resilience import (
     ResiliencePolicy,
     call_with_retries,
@@ -106,16 +112,34 @@ def _num_requests(load: LoadModel, capacity: float, cap: int) -> int:
     return max(1, min(int(rate * load.duration_s), cap))
 
 
+def resolve_mesh_request(config: ExperimentConfig):
+    """The mesh request for a sweep: ``"auto"``, a :class:`MeshSpec`,
+    or ``None`` (legacy ``mesh_data``/``mesh_svc`` sizing).
+
+    Priority: explicit config spec (CLI ``--mesh`` / TOML ``[sim]
+    mesh``) > ``$ISOTOPE_MESH`` > legacy keys.  Spec errors are
+    key-pathed config errors raised here, before any simulation.
+    """
+    if config.mesh_spec:
+        return parse_mesh_spec(str(config.mesh_spec))
+    env = mesh_spec_from_env()
+    if env is not None:
+        return env
+    return None
+
+
 class _LazyTopology:
     """Compile a topology (and build its simulators) only if some run of
     it actually executes — a fully-resumed topology costs nothing."""
 
     def __init__(self, topo_path: str, config: ExperimentConfig,
-                 mesh_data: int, mesh_svc: int):
+                 mesh_req):
         self.path = topo_path
         self.config = config
-        self.mesh_data = mesh_data
-        self.mesh_svc = mesh_svc
+        self.mesh_req = mesh_req          # "auto" | MeshSpec | None
+        self.mesh_layout: Optional[str] = None   # describe() once built
+        self.mesh_layout_score: Optional[float] = None
+        self._spec = None
         self._compiled = None
         self._collector = None
         self._entry_resp = 0.0
@@ -151,23 +175,58 @@ class _LazyTopology:
         self.compiled
         return self._entry_resp
 
+    def mesh_spec(self) -> MeshSpec:
+        """The resolved factorization for this topology (``"auto"``
+        runs the layout search against the compiled service count)."""
+        if self._spec is None:
+            if self.mesh_req == "auto":
+                from isotope_tpu.parallel import layout
+
+                n_hosts = getattr(jax, "process_count", lambda: 1)()
+                chosen = layout.choose_layout(
+                    jax.device_count(),
+                    self.compiled.num_services,
+                    max_slices=max(n_hosts, 1),
+                )
+                self._spec = chosen.spec
+                self.mesh_layout_score = chosen.score_s
+                print(
+                    f"mesh auto: {self.path} -> "
+                    f"{chosen.spec.describe()} "
+                    f"(score {chosen.score_s:.3g}s/merge)",
+                    file=sys.stderr,
+                )
+            elif isinstance(self.mesh_req, MeshSpec):
+                self._spec = self.mesh_req
+            else:
+                # legacy sizing: mesh_data x mesh_svc (0 => all devices)
+                svc = max(self.config.mesh_svc, 1)
+                data = (
+                    self.config.mesh_data
+                    if self.config.mesh_data > 0
+                    else max(jax.device_count() // svc, 1)
+                )
+                self._spec = MeshSpec(data=data, svc=svc)
+            self.mesh_layout = self._spec.describe()
+        return self._spec
+
     def sims(self, env):
         """(Simulator, ShardedSimulator | None) for an environment."""
         if env.name not in self._sims:
             params = env.apply(self.config.sim_params())
             sim = Simulator(self.compiled, params, self.config.chaos,
                             self.config.churn, mtls=self.config.mtls)
-            use_mesh = self.mesh_data * self.mesh_svc > 1
+            spec = self.mesh_spec()
             sharded = (
                 ShardedSimulator(
                     self.compiled,
-                    make_mesh(self.mesh_data, self.mesh_svc),
+                    build_mesh(spec),
                     params,
                     self.config.chaos,
                     self.config.churn,
                     mtls=self.config.mtls,
                 )
-                if use_mesh
+                if spec.size > 1
                 else None
             )
             self._sims[env.name] = (sim, sharded)
@@ -428,12 +487,10 @@ def run_experiment(
         policy = ResiliencePolicy.from_env()
     results: List[RunResult] = []
     key = jax.random.PRNGKey(config.seed)
-    mesh_svc = max(config.mesh_svc, 1)
-    mesh_data = (
-        config.mesh_data
-        if config.mesh_data > 0
-        else max(jax.device_count() // mesh_svc, 1)
-    )
+    # "auto" | MeshSpec | None — parse/env errors surface here, before
+    # anything simulates; "auto" resolves per topology (the layout
+    # search needs the compiled service count)
+    mesh_req = resolve_mesh_request(config)
 
     # Labels are the identity of a run everywhere downstream — the
     # artifact filenames, the checkpoint restore key, the CSV rows.  A
@@ -484,7 +541,7 @@ def run_experiment(
     try:
         run_index = 0
         for topo_path in config.topology_paths:
-            topo = _LazyTopology(topo_path, config, mesh_data, mesh_svc)
+            topo = _LazyTopology(topo_path, config, mesh_req)
             for env in config.environments:
                 for load in config.load_models():
                     label = _label(topo_path, env.name, load, config.labels)
@@ -625,6 +682,15 @@ def run_experiment(
                         replicas=topo.compiled.services.replicas,
                     )
                     flat["windowDiscarded"] = window.discarded
+                    if use_sharded and topo.mesh_layout:
+                        # the factorization that served the case is run
+                        # METADATA (like degraded_to): a record produced
+                        # by a different mesh layout is a different
+                        # measurement, and bench gates key on it
+                        flat["_mesh_layout"] = topo.mesh_layout
+                        telemetry.set_meta(
+                            "mesh_layout", topo.mesh_layout
+                        )
                     if degraded_to is not None:
                         # degradation is run METADATA: a sweep row that
                         # came off a fallback rung must say so (and

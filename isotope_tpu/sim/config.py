@@ -189,6 +189,20 @@ class SimParams:
     # hard cap on the window count; the planner widens windows (with a
     # warning) instead of letting the O(S * W) carries OOM the device
     timeline_max_windows: int = 256
+    # Collective/compute overlap (parallel/sharded.py): when True, the
+    # sharded runner issues each block's summary-merge collectives
+    # INSIDE the scan, one block late behind a double-buffered carry —
+    # block k's psum/psum_scatter results are consumed while block k+1
+    # computes, so DCN merge latency hides behind the next block's
+    # event sweep.  Off (default) keeps the historical single
+    # post-scan merge byte-identical; on matches off exactly on
+    # integer-valued fields and to reduction-order f32 noise on float
+    # sums (tests/test_multihost.py).  SCOPE: the plain summary path
+    # (ShardedSimulator.run) only — the attributed/timeline diagnostic
+    # passes keep their single post-scan merge (their O(K*H)/O(S*W)
+    # leaves merge once), and single-device Simulator runs ignore it
+    # (there is no collective to overlap).
+    overlap: bool = False
 
     def __post_init__(self):
         if self.service_time not in (

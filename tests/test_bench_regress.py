@@ -428,3 +428,57 @@ def test_timeline_overhead_not_a_rate_key(tmp_path, monkeypatch):
     monkeypatch.delenv("BENCH_REGRESS_TIMELINE_THRESHOLD",
                        raising=False)
     assert run_gate(tmp_path, monkeypatch, new, base) == 0
+
+
+def test_layout_gate_off_by_default(tmp_path, monkeypatch):
+    base = capture(2.0e9, {"svc1000": 2.0e9, "svc1000_best": 2.1e9,
+                           "_mesh_layout": "data=2,svc=4",
+                           "_mesh_layout_score": 1.0e-5})
+    new = capture(2.0e9, {"svc1000": 2.0e9, "svc1000_best": 2.1e9,
+                          "_mesh_layout": "data=8,svc=1",
+                          "_mesh_layout_score": 5.0e-5})
+    monkeypatch.delenv("BENCH_REGRESS_LAYOUT_GATE", raising=False)
+    assert run_gate(tmp_path, monkeypatch, new, base) == 0
+
+
+def test_layout_gate_fails_on_worse_score(tmp_path, monkeypatch,
+                                          capsys):
+    base = capture(2.0e9, {"svc1000": 2.0e9, "svc1000_best": 2.1e9,
+                           "_mesh_layout": "data=2,svc=4",
+                           "_mesh_layout_score": 1.0e-5})
+    new = capture(2.0e9, {"svc1000": 2.0e9, "svc1000_best": 2.1e9,
+                          "_mesh_layout": "data=8,svc=1",
+                          "_mesh_layout_score": 5.0e-5})
+    monkeypatch.setenv("BENCH_REGRESS_LAYOUT_GATE", "1")
+    assert run_gate(tmp_path, monkeypatch, new, base) == 1
+    assert "_mesh_layout" in capsys.readouterr().out
+
+
+def test_layout_gate_passes_on_equal_or_better(tmp_path, monkeypatch):
+    base = capture(2.0e9, {"svc1000": 2.0e9, "svc1000_best": 2.1e9,
+                           "_mesh_layout": "data=2,svc=4",
+                           "_mesh_layout_score": 1.0e-5})
+    new = capture(2.0e9, {"svc1000": 2.0e9, "svc1000_best": 2.1e9,
+                          "_mesh_layout": "data=2,svc=4",
+                          "_mesh_layout_score": 1.0e-5})
+    monkeypatch.setenv("BENCH_REGRESS_LAYOUT_GATE", "1")
+    assert run_gate(tmp_path, monkeypatch, new, base) == 0
+
+
+def test_layout_gate_skips_pre_layout_baseline(tmp_path, monkeypatch):
+    base = capture(2.0e9, {"svc1000": 2.0e9, "svc1000_best": 2.1e9})
+    new = capture(2.0e9, {"svc1000": 2.0e9, "svc1000_best": 2.1e9,
+                          "_mesh_layout": "data=2,svc=4",
+                          "_mesh_layout_score": 1.0e-5})
+    monkeypatch.setenv("BENCH_REGRESS_LAYOUT_GATE", "1")
+    assert run_gate(tmp_path, monkeypatch, new, base) == 0
+
+
+def test_layout_score_not_a_rate_key(tmp_path, monkeypatch):
+    # a score IMPROVEMENT (smaller) must not read as a rate regression
+    base = capture(2.0e9, {"svc1000": 2.0e9, "svc1000_best": 2.1e9,
+                           "_mesh_layout_score": 1.0e-5})
+    new = capture(2.0e9, {"svc1000": 2.0e9, "svc1000_best": 2.1e9,
+                          "_mesh_layout_score": 1.0e-7})
+    monkeypatch.delenv("BENCH_REGRESS_LAYOUT_GATE", raising=False)
+    assert run_gate(tmp_path, monkeypatch, new, base) == 0

@@ -1,0 +1,201 @@
+"""Mesh specs (parallel/mesh.py) and the Automap-style layout search
+(parallel/layout.py + analysis/costmodel.comm_table)."""
+import pytest
+
+from isotope_tpu.analysis import costmodel
+from isotope_tpu.parallel import (
+    MeshSpec,
+    build_mesh,
+    mesh_spec_from_env,
+    parse_mesh_spec,
+)
+from isotope_tpu.parallel import layout
+from isotope_tpu.parallel.mesh import ENV_MESH
+
+
+# -- spec parsing ----------------------------------------------------------
+
+
+def test_parse_positional_two_axes():
+    assert parse_mesh_spec("4x2") == MeshSpec(data=4, svc=2)
+
+
+def test_parse_positional_three_axes():
+    assert parse_mesh_spec("2x2x2") == MeshSpec(data=2, svc=2, slices=2)
+
+
+def test_parse_named_any_order_any_subset():
+    assert parse_mesh_spec("svc=2,data=4") == MeshSpec(data=4, svc=2)
+    assert parse_mesh_spec("slice=2,data=2,svc=2") == MeshSpec(
+        data=2, svc=2, slices=2
+    )
+    assert parse_mesh_spec("data=8") == MeshSpec(data=8)
+
+
+def test_parse_auto():
+    assert parse_mesh_spec("auto") == "auto"
+    assert parse_mesh_spec(" AUTO ") == "auto"
+
+
+def test_parse_unknown_axis_is_key_pathed():
+    with pytest.raises(ValueError, match=r"mesh: unknown mesh axis"):
+        parse_mesh_spec("foo=3")
+
+
+def test_parse_bad_size_is_key_pathed():
+    with pytest.raises(ValueError, match=r"mesh\.svc"):
+        parse_mesh_spec("data=2,svc=x")
+    with pytest.raises(ValueError, match=r"mesh\.data"):
+        parse_mesh_spec("bogus")
+
+
+def test_parse_duplicate_axis_rejected():
+    with pytest.raises(ValueError, match=r"mesh\.data: axis given"):
+        parse_mesh_spec("data=2,data=4")
+
+
+def test_parse_too_many_dims_rejected():
+    with pytest.raises(ValueError, match="bad mesh spec"):
+        parse_mesh_spec("2x2x2x2")
+
+
+def test_spec_validates_axis_sizes():
+    with pytest.raises(ValueError, match=r"mesh\.svc"):
+        MeshSpec(data=2, svc=0)
+
+
+def test_spec_describe_round_trips():
+    for spec in (MeshSpec(4, 2), MeshSpec(2, 2, 2), MeshSpec(8)):
+        assert parse_mesh_spec(spec.describe()) == spec
+
+
+def test_spec_axis_names_collapse_without_slices():
+    assert MeshSpec(4, 2).axis_names == ("data", "svc")
+    assert MeshSpec(2, 2, 2).axis_names == ("slice", "data", "svc")
+    assert MeshSpec(2, 2, 2).size == 8
+
+
+def test_env_spec(monkeypatch):
+    monkeypatch.delenv(ENV_MESH, raising=False)
+    assert mesh_spec_from_env() is None
+    monkeypatch.setenv(ENV_MESH, "4x2")
+    assert mesh_spec_from_env() == MeshSpec(data=4, svc=2)
+    monkeypatch.setenv(ENV_MESH, "wat=1")
+    with pytest.raises(ValueError, match=ENV_MESH):
+        mesh_spec_from_env()
+
+
+def test_build_mesh_device_count_key_pathed():
+    # the 8-device virtual CPU mesh (conftest) cannot host 16 shards
+    with pytest.raises(ValueError, match=r"mesh: .*needs 16 devices"):
+        build_mesh(MeshSpec(data=8, svc=2))
+
+
+def test_build_mesh_multislice_axis_order():
+    mesh = build_mesh(MeshSpec(data=2, svc=2, slices=2))
+    assert mesh.axis_names == ("slice", "data", "svc")  # DCN outermost
+
+
+# -- comm table ------------------------------------------------------------
+
+
+def test_comm_table_single_slice_has_no_dcn_row():
+    rows = costmodel.comm_table(100, data=4, svc=2)
+    assert [r["collective"] for r in rows] == [
+        "psum_replicated", "psum_scatter_svc",
+    ]
+    assert all(r["link"] == "ici" for r in rows)
+
+
+def test_comm_table_dcn_row_carries_scattered_tile():
+    rows = costmodel.comm_table(1024, data=2, svc=2, slices=2)
+    by = {r["collective"]: r for r in rows}
+    assert by["psum_dcn"]["link"] == "dcn"
+    # DCN crosses AFTER the svc scatter: its payload is the replicated
+    # group plus a 1/svc tile, strictly less than the full per-service
+    # state
+    full = by["psum_replicated"]["bytes"] + by["psum_scatter_svc"]["bytes"]
+    assert by["psum_dcn"]["bytes"] < full
+
+
+def test_comm_table_dcn_slower_than_ici_for_same_bytes():
+    ici = costmodel._collective_s(1e6, 2, "ici")
+    dcn = costmodel._collective_s(1e6, 2, "dcn")
+    assert dcn > ici
+    assert costmodel._collective_s(1e6, 1, "dcn") == 0.0
+
+
+def test_comm_table_num_merges_scales_time():
+    one = costmodel.comm_table(64, data=4, svc=2, num_merges=1)
+    ten = costmodel.comm_table(64, data=4, svc=2, num_merges=10)
+    for a, b in zip(one, ten):
+        assert b["time_s"] == pytest.approx(10 * a["time_s"])
+
+
+# -- layout search ---------------------------------------------------------
+
+
+def test_enumerate_respects_device_count():
+    for spec in layout.enumerate_specs(8, 1024, max_slices=2):
+        assert spec.size == 8
+
+
+def test_enumerate_never_pads_only_svc_shards():
+    # svc axis never wider than the service count (=> never wider than
+    # the padded service count either: s_pad >= svc always)
+    for spec in layout.enumerate_specs(8, 3):
+        assert spec.svc <= 3
+    assert all(s.svc == 1 for s in layout.enumerate_specs(8, 1))
+
+
+def test_enumerate_slices_pinned_to_host_count():
+    # hosts ARE slices: with 2 hosts every candidate carries exactly
+    # 2 slices — a flat mesh spanning hosts would run ICI-priced
+    # collectives across DCN, the one mispricing the search must
+    # never offer
+    with_slices = layout.enumerate_specs(8, 100, max_slices=2)
+    assert {s.slices for s in with_slices} == {2}
+    # a host count that does not divide the devices cannot factor
+    with pytest.raises(ValueError, match="divide"):
+        layout.enumerate_specs(8, 100, max_slices=3)
+
+
+def test_choose_respects_padded_service_width():
+    best = layout.choose_layout(8, 1024)
+    s_pad = -(-1024 // best.spec.svc) * best.spec.svc
+    assert best.spec.svc <= s_pad
+    assert best.spec.size == 8
+
+
+def test_choose_beats_hardcoded_multichip_mesh():
+    """ISSUE acceptance: --mesh auto scores <= the hand-picked
+    {'slice': 2, 'data': 2, 'svc': 2} on the multichip dryrun shape
+    (1024 services, 8 devices)."""
+    auto = layout.choose_layout(8, 1024, max_slices=2)
+    hand = layout.score_layout(MeshSpec(data=2, svc=2, slices=2), 1024)
+    assert auto.score_s <= hand.score_s
+
+
+def test_choose_slices_match_host_count():
+    # single host: no DCN axis, ever
+    assert layout.choose_layout(8, 1024, max_slices=1).spec.slices == 1
+    # two hosts: the slice axis is mandatory (one slice per host)
+    assert layout.choose_layout(8, 1024, max_slices=2).spec.slices == 2
+
+
+def test_choose_tiny_service_count_narrow_svc():
+    best = layout.choose_layout(8, 1)
+    assert best.spec == MeshSpec(data=8, svc=1)
+
+
+def test_choose_deterministic():
+    a = layout.choose_layout(8, 200, max_slices=2)
+    b = layout.choose_layout(8, 200, max_slices=2)
+    assert a.spec == b.spec and a.score_s == b.score_s
+
+
+def test_score_to_dict_shape():
+    d = layout.choose_layout(4, 64).to_dict()
+    assert set(d) == {"mesh", "score_s", "pad_fraction", "comm"}
+    assert all({"collective", "link", "bytes", "time_s",
+                "participants"} <= set(r) for r in d["comm"])

@@ -44,6 +44,10 @@ threshold (unset = not gated), compared per case over the
   flight-recorder overhead (``<case>_timeline_overhead`` — bench's
   timeline-on vs timeline-off steady-state delta), e.g. ``0.05`` =
   the 5% svc1000 acceptance bar.
+- ``BENCH_REGRESS_LAYOUT_GATE=1``: fail a capture whose automatic
+  mesh-layout search picked a WORSE-scoring factorization than the
+  baseline's (``_mesh_layout`` / ``_mesh_layout_score`` — bench
+  embeds the ``--mesh auto`` choice and its comm-cost-model score).
 
 Always armed (no env var): a case whose telemetry block carries
 ``degraded_to`` — the resilience supervisor served it from a
@@ -106,7 +110,7 @@ def _cases(doc: dict, prefer_best: bool = False) -> dict:
             continue
         if k.endswith(("_inflight", "_spread", "_census", "_best",
                        "_compile_s", "_warmup_windows",
-                       "_timeline_overhead")):
+                       "_timeline_overhead", "_mesh_layout_score")):
             continue  # evidence / variance keys, not rates
         cases[k] = float(v)
     if prefer_best:
@@ -298,6 +302,38 @@ def timeline_failures(new_doc: dict) -> list:
     return failures
 
 
+def layout_failures(prev_doc: dict, new_doc: dict) -> list:
+    """Opt-in gate (``BENCH_REGRESS_LAYOUT_GATE=1``): the automatic
+    mesh-layout search (parallel/layout.py — bench embeds the chosen
+    factorization and its cost-model score as ``_mesh_layout`` /
+    ``_mesh_layout_score``) must never pick a WORSE-scoring mesh than
+    the recorded baseline's.  A higher score means a search or
+    cost-model change regressed the chosen layout — visible here
+    before any multi-host run pays for it.  Captures without layout
+    data on either side are skipped (pre-gate baselines)."""
+    if os.environ.get("BENCH_REGRESS_LAYOUT_GATE", "") not in (
+        "1", "true", "on", "yes",
+    ):
+        return []
+    prev_extra = prev_doc.get("extra", {})
+    new_extra = new_doc.get("extra", {})
+    old = prev_extra.get("_mesh_layout_score")
+    new = new_extra.get("_mesh_layout_score")
+    if not isinstance(old, (int, float)) or not isinstance(
+        new, (int, float)
+    ):
+        print("bench_regress: layout gate: no _mesh_layout_score on "
+              "one side — skipped")
+        return []
+    bad = float(new) > float(old) * (1.0 + 1e-9)
+    verdict = "REGRESSION" if bad else "OK"
+    print(f"bench_regress: _mesh_layout: "
+          f"{prev_extra.get('_mesh_layout')!r} ({float(old):.3g}s) -> "
+          f"{new_extra.get('_mesh_layout')!r} ({float(new):.3g}s) "
+          f"{verdict}")
+    return ["_mesh_layout"] if bad else []
+
+
 def spread_failures(prev_doc: dict, new_doc: dict) -> list:
     """Opt-in gate (``BENCH_REGRESS_SPREAD_THRESHOLD=<ratio>``): a case
     whose window-to-window relative spread (``<case>_spread``) exceeds
@@ -432,6 +468,7 @@ def main() -> int:
     failures.extend(blame_failures(prev_doc, new_doc))
     failures.extend(spread_failures(prev_doc, new_doc))
     failures.extend(timeline_failures(new_doc))
+    failures.extend(layout_failures(prev_doc, new_doc))
     if failures:
         print(f"bench_regress: FAIL vs {prev_path}: "
               f"{', '.join(failures)} regressed >"
