@@ -8,8 +8,8 @@ DURATION ?= 120s
 
 .PHONY: test lint vet-smoke bench telemetry-smoke resilience-smoke \
 	attribution-smoke sparse-smoke timeline-smoke multihost-smoke \
-	examples canonical tree star multitier auxiliary-services \
-	star-auxiliary latency cpu_mem dot clean
+	policies-smoke examples canonical tree star multitier \
+	auxiliary-services star-auxiliary latency cpu_mem dot clean
 
 test:
 	$(PY) -m pytest tests/ -x -q
@@ -166,6 +166,15 @@ sparse-smoke:
 # and an injected sharded.dcn_collective transient is retried.
 multihost-smoke:
 	$(PY) tools/multihost_smoke.py
+
+# resilience-policy end-to-end check: a chaos kill phase on a retry
+# chain runs unprotected vs. with breaker + retry budget + autoscaler;
+# the protected run's retry-amplified hop events and error share must
+# be STRICTLY lower, the breaker trip/recovery must land as sim-time
+# onsets on the timeline window axis, and the autoscaler's replica
+# series must recover the killed capacity.
+policies-smoke:
+	$(PY) tools/policies_smoke.py
 
 examples:
 	$(PY) tools/gen_examples.py

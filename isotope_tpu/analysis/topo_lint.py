@@ -181,6 +181,93 @@ def lint_graph(
                         path=f"services[{i}].script[{j}]",
                     ))
 
+    findings.extend(_lint_policies(graph, params))
+    return findings
+
+
+def _lint_policies(graph: ServiceGraph, params) -> List[Finding]:
+    """Resilience-policy misconfiguration rules (VET-T010..T013) over
+    the topology's ``policies:`` block (sim/policies.py).
+
+    VET-T010 (the steady-state breaker-capacity rule) needs an offered
+    rate, so it lives in :func:`lint_config`; the load-free rules here
+    are: VET-T011 autoscaler ``min_replicas > max_replicas``,
+    VET-T012 a zero retry budget on a retried call target,
+    VET-T013 an autoscaler sync period shorter than the timeline
+    window (the control loop cannot observe faster than the recorder
+    samples), and VET-T014 a policies block that does not decode at
+    all (typo'd keys, malformed values).
+    """
+    if not getattr(graph, "policies", None):
+        return []
+    # lazy: keeps the no-policies lint path jax-free
+    from isotope_tpu.sim import policies as policies_mod
+
+    findings: List[Finding] = []
+    names = [s.name for s in graph.services]
+    pset, problems = policies_mod.lint_policies(graph.policies, names)
+    for _, msg in problems:
+        findings.append(Finding(
+            "VET-T014", SEV_ERROR,
+            f"policies block does not decode: {msg}",
+            path="policies",
+        ))
+    if pset is None:
+        return findings
+    if params is None:
+        from isotope_tpu.sim.config import SimParams
+
+        params = SimParams()
+    # which services are the target of a call with retries > 0
+    retried = set()
+    for svc in graph.services:
+        for cmd in svc.script:
+            calls = (
+                [c for c in cmd if isinstance(c, RequestCommand)]
+                if isinstance(cmd, ConcurrentCommand)
+                else [cmd] if isinstance(cmd, RequestCommand) else []
+            )
+            for call in calls:
+                if call.retries > 0:
+                    retried.add(call.service_name)
+    for name in names:
+        p = pset.for_service(name)
+        if p.autoscaler is not None:
+            a = p.autoscaler
+            if a.min_replicas > a.max_replicas:
+                findings.append(Finding(
+                    "VET-T011", SEV_ERROR,
+                    f"autoscaler min_replicas={a.min_replicas} > "
+                    f"max_replicas={a.max_replicas}: the desired-count "
+                    "clamp is empty (the controller could never "
+                    "actuate a legal count)",
+                    path=f"policies.{name}.autoscaler",
+                ))
+            if a.sync_period_s < params.timeline_window_s:
+                findings.append(Finding(
+                    "VET-T013", SEV_WARN,
+                    f"autoscaler sync_period {a.sync_period_s:g}s is "
+                    "shorter than the timeline window "
+                    f"{params.timeline_window_s:g}s: the control loop "
+                    "cannot observe faster than the flight recorder "
+                    "samples, so syncs between window boundaries see "
+                    "stale signals (widen sync_period or narrow "
+                    "--timeline)",
+                    path=f"policies.{name}.autoscaler.sync_period",
+                ))
+        if (
+            p.retry_budget is not None
+            and p.retry_budget.budget_percent <= 0.0
+            and p.retry_budget.min_retries_concurrent <= 0.0
+            and name in retried
+        ):
+            findings.append(Finding(
+                "VET-T012", SEV_WARN,
+                f"retry_budget of 0 on {name!r}, but calls to it set "
+                "retries > 0: every retry will be suppressed once any "
+                "are observed (drop the retries or raise the budget)",
+                path=f"policies.{name}.retry_budget",
+            ))
     return findings
 
 
@@ -410,6 +497,7 @@ def lint_config(config) -> Tuple[List[Finding], Dict[str, object]]:
         ))
 
     # VET-C005: open-loop offered rate vs static capacity
+    # VET-T010: breaker caps vs steady-state expected queue/concurrency
     if config.load_kind == "open":
         params = config.sim_params()
         for p, g in graphs.items():
@@ -429,4 +517,67 @@ def lint_config(config) -> Tuple[List[Finding], Dict[str, object]]:
                         f"{cap:.1f} of {stem}: queues are unstable "
                         "(waits grow without bound over the run)",
                     ))
+            findings.extend(
+                _lint_breaker_capacity(g, compiled, params, config.qps)
+            )
     return findings, graphs
+
+
+def _lint_breaker_capacity(
+    graph, compiled, params, qps_grid
+) -> List[Finding]:
+    """VET-T010: a circuit breaker whose ``max_pending`` /
+    ``max_connections`` sit below the M/M/k STEADY-STATE expected
+    queue depth / in-flight concurrency at a configured offered rate
+    sheds healthy traffic permanently — a misconfiguration, not a
+    protection."""
+    if not getattr(graph, "policies", None):
+        return []
+    import numpy as np
+
+    from isotope_tpu.sim import policies as policies_mod
+    from isotope_tpu.sim.feedback import np_mmk
+
+    pset, _ = policies_mod.lint_policies(
+        graph.policies, [s.name for s in graph.services]
+    )
+    if pset is None:
+        return []
+    findings: List[Finding] = []
+    visits = compiled.expected_visits()
+    mu = 1.0 / params.cpu_time_s
+    reps = compiled.services.replicas.astype(np.float64)
+    names = compiled.services.names
+    for q in qps_grid:
+        if q is None:
+            continue
+        p_wait, wait_rate, rho = np_mmk(q * visits, mu, reps)
+        rho_c = np.minimum(rho, 0.9999)
+        lq = p_wait * rho_c / np.maximum(1.0 - rho_c, 1e-9)
+        inflight = lq + rho_c * reps
+        for s, name in enumerate(names):
+            pol = pset.for_service(name)
+            if pol.breaker is None:
+                continue
+            b = pol.breaker
+            if b.max_pending is not None and b.max_pending < lq[s]:
+                findings.append(Finding(
+                    "VET-T010", SEV_WARN,
+                    f"breaker max_pending={b.max_pending:g} on "
+                    f"{name!r} is below the steady-state expected "
+                    f"queue depth {lq[s]:.1f} at {q:g} qps: the "
+                    "breaker sheds HEALTHY traffic permanently",
+                    path=f"policies.{name}.breaker.max_pending",
+                ))
+            if (
+                b.max_connections is not None
+                and b.max_connections < inflight[s]
+            ):
+                findings.append(Finding(
+                    "VET-T010", SEV_WARN,
+                    f"breaker max_connections={b.max_connections:g} "
+                    f"on {name!r} is below the steady-state expected "
+                    f"concurrency {inflight[s]:.1f} at {q:g} qps",
+                    path=f"policies.{name}.breaker.max_connections",
+                ))
+    return findings

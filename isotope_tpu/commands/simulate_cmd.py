@@ -78,6 +78,19 @@ def _timeline_window(args):
     return dur.parse_duration_seconds(args.timeline)
 
 
+def _add_policies_args(parser) -> None:
+    """The resilience-policy co-sim knobs (sim/policies.py), shared by
+    simulate and sweep."""
+    parser.add_argument(
+        "--policies", action="store_true",
+        help="co-simulate the topology's `policies:` block (circuit "
+             "breakers, retry budgets, outlier ejection, HPA "
+             "autoscalers) inside the block scan: the MAIN run becomes "
+             "the PROTECTED system, reacting window-by-window to the "
+             "flight-recorder signals (implies --timeline; the policy "
+             "actuation series lands next to the windowed series)")
+
+
 def _add_mesh_args(parser) -> None:
     """The mesh-layout knobs (parallel/mesh.py + parallel/layout.py),
     shared by simulate and sweep."""
@@ -192,6 +205,10 @@ def register(sub) -> None:
     s.add_argument("--exemplar-format", choices=["chrome", "jaeger"],
                    default="jaeger")
     _add_timeline_args(s)
+    _add_policies_args(s)
+    s.add_argument("--policies-out", metavar="FILE", default=None,
+                   help="write the policy actuation series as JSON "
+                        "(isotope-policies/v1)")
     s.add_argument("--timeline-out", metavar="FILE", default=None,
                    help="write the windowed series as JSON "
                         "(isotope-timeline/v1)")
@@ -256,6 +273,7 @@ def register(sub) -> None:
                         "segment fences — diagnosis, not benchmarking)")
     _add_attribution_args(w)
     _add_timeline_args(w)
+    _add_policies_args(w)
     _add_mesh_args(w)
     _add_resilience_args(w)
     _add_vet_arg(w)
@@ -337,6 +355,7 @@ def run_simulate(args) -> int:
         entry=args.entry,
         attribution=args.attribution is not None,
         timeline=tl_window is not None,
+        policies=args.policies,
         mesh_spec=args.mesh,
         overlap=args.overlap,
         **extra,
@@ -363,7 +382,23 @@ def run_simulate(args) -> int:
             "warning: attribution pass produced no blame document",
             file=sys.stderr,
         )
-    if tl_window is not None and result.timeline is not None:
+    if args.policies and result.policies is not None:
+        from isotope_tpu.sim import policies as policies_mod
+
+        print(policies_mod.format_table(result.policies),
+              file=sys.stderr)
+        if args.policies_out:
+            with open(args.policies_out, "w") as f:
+                json.dump(result.policies, f, indent=2)
+            print(f"policies -> {args.policies_out}", file=sys.stderr)
+    elif args.policies:
+        print(
+            "warning: --policies set but the topology declares no "
+            "policies block (unprotected run)",
+            file=sys.stderr,
+        )
+    if (tl_window is not None or args.policies) \
+            and result.timeline is not None:
         _write_timeline_artifacts(args, result)
     elif tl_window is not None:
         print(
@@ -590,6 +625,8 @@ def run_sweep(args) -> int:
         config = dataclasses.replace(config, mesh_spec=args.mesh)
     if args.overlap and not config.overlap:
         config = dataclasses.replace(config, overlap=True)
+    if args.policies and not config.policies:
+        config = dataclasses.replace(config, policies=True)
     tl_window = _timeline_window(args)
     if tl_window is None and config.timeline:
         # [sim] timeline = true in the TOML arms the pass without a

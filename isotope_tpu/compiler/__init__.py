@@ -30,6 +30,7 @@ from isotope_tpu.compiler.compile import (
     HopBudgetExceededError,
     NoEntrypointError,
     compile_graph,
+    compile_policies,
 )
 
 __all__ = [
@@ -43,6 +44,7 @@ __all__ = [
     "HopBudgetExceededError",
     "NoEntrypointError",
     "compile_graph",
+    "compile_policies",
     "enable_persistent_cache",
     "executable_cache",
     "persistent_cache_dir",

@@ -123,6 +123,29 @@ def _check_acyclic(entry: int, programs, names) -> None:
     visit(entry)
 
 
+def compile_policies(graph: ServiceGraph, compiled: CompiledGraph):
+    """Lower a topology's ``policies:`` block to dense per-service
+    tables in COMPILED service order (sim/policies.PolicyTables) — the
+    device-constant form the engine's in-scan control loop consumes.
+
+    Returns ``None`` when the graph declares no policies (the engine's
+    byte-identical default path).  Decode errors carry key paths
+    (``policies.worker.breaker.max_pending: ...``).
+    """
+    if not graph.policies:
+        return None
+    from isotope_tpu.sim import policies as policies_mod
+
+    pols = policies_mod.PolicySet.decode(
+        graph.policies, compiled.services.names
+    )
+    if pols.empty:
+        return None
+    tables = policies_mod.build_tables(pols, compiled.services)
+    telemetry.counter_inc("policies_compiled")
+    return tables
+
+
 def compile_graph(
     graph: ServiceGraph,
     entry: Optional[str] = None,

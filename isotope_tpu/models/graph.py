@@ -58,6 +58,13 @@ class ServiceGraph:
     services: List[Service] = dataclasses.field(default_factory=list)
     # Retained so encode() can round-trip the defaults block.
     defaults: dict = dataclasses.field(default_factory=dict)
+    # Raw ``policies:`` block (in-graph resilience policies — circuit
+    # breakers, retry budgets, HPA autoscalers; sim/policies.py).  Kept
+    # raw here so host-only consumers (converters, encode round-trip)
+    # never pay the decode; the compiler lowers it to dense per-service
+    # tables (compiler/compile.py compile_policies) with key-pathed
+    # validation errors.
+    policies: dict = dataclasses.field(default_factory=dict)
 
     # -- decode ------------------------------------------------------------
 
@@ -76,7 +83,17 @@ class ServiceGraph:
                 services.append(
                     Service.decode(s, default_service, default_request)
                 )
-        graph = cls(services=services, defaults=dict(raw_defaults))
+        raw_policies = doc.get("policies") or {}
+        if not isinstance(raw_policies, dict):
+            with config_path("policies"):
+                raise ValueError(
+                    f"policies must be a mapping: {raw_policies!r}"
+                )
+        graph = cls(
+            services=services,
+            defaults=dict(raw_defaults),
+            policies=dict(raw_policies),
+        )
         graph.validate()
         return graph
 
@@ -97,6 +114,8 @@ class ServiceGraph:
             out["defaults"] = dict(self.defaults)
         default_service, _ = _effective_defaults(self.defaults)
         out["services"] = [s.encode(default_service) for s in self.services]
+        if self.policies:
+            out["policies"] = dict(self.policies)
         return out
 
     def to_yaml(self) -> str:

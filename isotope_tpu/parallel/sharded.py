@@ -107,6 +107,7 @@ class ShardedSimulator:
         chaos=(),
         churn=(),
         mtls=None,
+        policies=None,  # Optional[sim.policies.PolicyTables]
     ):
         self.compiled = compiled
         self.mesh = mesh
@@ -119,7 +120,8 @@ class ShardedSimulator:
         # set): the sharded sweep programs are the most expensive
         # compiles in the system, so wire the disk cache here too
         enable_persistent_cache()
-        self.sim = Simulator(compiled, params, chaos, churn, mtls=mtls)
+        self.sim = Simulator(compiled, params, chaos, churn, mtls=mtls,
+                             policies=policies)
         self.collector = MetricsCollector(compiled)
         if SVC_AXIS not in mesh.axis_names:
             raise ValueError(
@@ -1136,6 +1138,423 @@ class ShardedSimulator:
             full_key,
             lambda: telemetry.time_first_call(
                 jax.jit(partial(self._local_scan_tl, *cache_key)),
+                "compile.jit_first_call",
+            ),
+        )
+
+    # -- resilience-policy co-sim (sim/policies.py) ---------------------
+
+    def _require_policies(self, load: LoadModel) -> None:
+        if self.sim._policies is None:
+            raise ValueError(
+                "policy runs need compiled policy tables "
+                "(ShardedSimulator(..., policies=...))"
+            )
+        if not self.sim.params.timeline:
+            raise ValueError(
+                "policy runs need SimParams(timeline=True)"
+            )
+        if self.sim._saturated(load):
+            raise ValueError(
+                "policy runs do not support saturated -qps max loads "
+                "(static finite-population tables; see "
+                "Simulator.run_policies)"
+            )
+        if self.n_svc != 1:
+            raise ValueError(
+                "policy runs need a mesh with svc=1: the per-service "
+                "control state is replicated across shards (every "
+                "shard advances the identical trajectory from the "
+                "psum-merged window signals), which a svc-sharded "
+                "metric layout would split"
+            )
+
+    def run_policies(
+        self,
+        load: LoadModel,
+        num_requests: int,
+        key: jax.Array,
+        offered_qps=None,
+        block_size: int = 65_536,
+        trim: bool = False,
+        window_s=None,
+    ):
+        """Sharded twin of :meth:`Simulator.run_policies`: every shard
+        scans its blocks under the SHARED policy state — each block's
+        flight-recorder contribution (and the retry-observation
+        channel) is psum-merged ACROSS the mesh inside the scan, so
+        the control law advances from global window signals and every
+        shard actuates the identical trajectory.  Returns
+        ``(RunSummary, TimelineSummary, PolicySummary)``; the
+        timeline/policy outputs are replicated (already globally
+        merged) and bit-equal to :meth:`run_policies_emulated`."""
+        self._require_policies(load)
+        self._require_mesh("run_policies")
+        plan = self._plan_run(load, num_requests, key, offered_qps,
+                              block_size, trim)
+        tl_plan = self._timeline_plan(plan, window_s)
+        telemetry.counter_inc("sharded_policy_runs")
+        faults.check("policies.stuck_breaker")
+        faults.check("policies.autoscaler_lag")
+        fn = self._get_pol(plan, tl_plan)
+        vis, windows = self._args_put(plan)
+        faults.check("sharded.compute")
+        out = fn(
+            key, jnp.float32(plan.offered), jnp.float32(plan.gap),
+            jnp.float32(plan.nominal_gap),
+            jnp.float32(plan.window[0]), jnp.float32(plan.window[1]),
+            vis, windows,
+        )
+        faults.check("sharded.gather")
+        return out
+
+    def run_policies_emulated(
+        self,
+        load: LoadModel,
+        num_requests: int,
+        key: jax.Array,
+        offered_qps=None,
+        block_size: int = 65_536,
+        trim: bool = False,
+        window_s=None,
+    ):
+        """The policy mesh program replayed on one device: unlike the
+        other ``*_emulated`` twins (whole-scan per shard), the policy
+        control loop couples shards PER BLOCK — every shard's block
+        feeds the psum the state advance consumes — so the twin runs
+        one scan whose body sweeps ALL shards' blocks in shard order,
+        merges their recorder contributions sequentially (the CPU
+        psum's association order — ICI shards within a slice first,
+        slices last), and advances the shared state once.  Bit-equal
+        to :meth:`run_policies` on CPU (pinned)."""
+        self._require_policies(load)
+        plan = self._plan_run(load, num_requests, key, offered_qps,
+                              block_size, trim)
+        tl_plan = self._timeline_plan(plan, window_s)
+        telemetry.counter_inc("sharded_policy_emulated_runs")
+        faults.check("policies.stuck_breaker")
+        faults.check("policies.autoscaler_lag")
+        fn = self._get_local_pol_fn(plan, tl_plan)
+        vis, windows = self._args_put(plan)
+        with telemetry.phase("sharded.emulated"):
+            shard_summaries, tl, pol = fn(
+                key, jnp.float32(plan.offered), jnp.float32(plan.gap),
+                jnp.float32(plan.nominal_gap),
+                jnp.float32(plan.window[0]),
+                jnp.float32(plan.window[1]),
+                vis, windows,
+            )
+            jax.block_until_ready(tl.count)
+        return (
+            self._merge_shard_summaries(list(shard_summaries)),
+            tl,
+            pol,
+        )
+
+    def _policy_block_ctx(self, tl_plan: Tuple[int, float]):
+        """Static policy-scan context shared by the shard_map body and
+        the emulated twin (identical traced control program)."""
+        from isotope_tpu.metrics import timeline as timeline_mod
+        from isotope_tpu.sim import policies as policies_mod
+
+        spec = timeline_mod.build_spec(
+            self.compiled, tl_plan[0], tl_plan[1]
+        )
+        return dict(
+            spec=spec,
+            dtab=policies_mod.device_tables(self.sim._policies),
+            downed_w=self.sim._policy_downed_windows(spec),
+            stuck=faults.stuck_breaker(),
+            lag=faults.autoscaler_lag(),
+            retry_mask=jnp.asarray(self.compiled.hop_attempt > 0),
+            packed=self.sim.params.packed_carries,
+            pol_mod=policies_mod,
+            tl_mod=timeline_mod,
+        )
+
+    def _pol_body(
+        self,
+        block: int,
+        num_blocks: int,
+        kind: str,
+        conns_local: int,
+        trim: bool,
+        tl_plan: Tuple[int, float],
+        key: jax.Array,
+        offered_qps: jax.Array,
+        pace_gap: jax.Array,
+        nominal_gap: jax.Array,
+        win_lo: jax.Array,
+        win_hi: jax.Array,
+        visits_pc: jax.Array,
+        phase_windows: jax.Array,
+    ):
+        ctx = self._policy_block_ctx(tl_plan)
+        spec, pol_mod, tl_mod = ctx["spec"], ctx["pol_mod"], ctx["tl_mod"]
+        both = tuple(self.mesh.axis_names)
+        shard = jnp.int32(0)
+        for a in self.mesh.axis_names:
+            shard = shard * self.mesh.shape[a] + jax.lax.axis_index(a)
+        local_key = jax.random.fold_in(key, 500_000 + shard)
+        c = max(conns_local, 1)
+        per = block // c
+        S = self.compiled.num_services
+        W = spec.num_windows
+
+        def block_body(carry, b):
+            ((t0, conn_t0, req_off), tl_acc, obs_acc,
+             pstate, pol_acc) = carry
+            fx = pol_mod.effects(pstate)
+            kb = jax.random.fold_in(local_key, 1_000_000 + b)
+            res, t_end, conn_end = self.sim._simulate_core(
+                block, kind, conns_local, kb, offered_qps, pace_gap,
+                offered_qps / self.n_shards, nominal_gap, t0, conn_t0,
+                req_off,
+                visits_pc=visits_pc,
+                phase_windows=phase_windows,
+                policy_fx=fx,
+            )
+            s = summarize(
+                res, self.collector,
+                window=(win_lo, win_hi) if trim else None,
+            )
+            # the control loop consumes GLOBAL window signals: each
+            # block's recorder contribution psums across the mesh
+            # before the (replicated) state advance — the collective
+            # the emulated twin replays in shard order
+            tl_blk = tl_mod.timeline_block(res, spec,
+                                           packed=ctx["packed"])
+            tl_blk = jax.tree.map(
+                lambda x: jax.lax.psum(x, both),
+                tl_blk._replace(window_s=jnp.float32(0.0)),
+            )._replace(window_s=jnp.float32(spec.window_s))
+            obs_blk = jax.lax.psum(
+                pol_mod.observe_block(res, spec, ctx["retry_mask"]),
+                both,
+            )
+            tl_acc = tl_mod.accumulate(tl_acc, tl_blk)
+            obs_acc = obs_acc + obs_blk
+            # a window is final once EVERY shard's SLOWEST clock
+            # passed it (closed loop: the slowest connection, not
+            # conn_end.max() — faster connections' later blocks still
+            # write into earlier windows)
+            t_local = (
+                jnp.min(conn_end)
+                if kind != OPEN_LOOP
+                else t_end
+            )
+            t_done = jax.lax.pmin(t_local, both)
+            pstate, delta = pol_mod.advance(
+                pstate, ctx["dtab"], tl_acc, obs_acc, t_done, spec,
+                stuck_breaker=ctx["stuck"], downed_w=ctx["downed_w"],
+            )
+            pol_acc = pol_mod.accumulate_summary(pol_acc, delta)
+            return (
+                (t_end, conn_end, req_off + per),
+                tl_acc, obs_acc, pstate, pol_acc,
+            ), s
+
+        carry0 = (
+            (
+                jnp.float32(0.0),
+                jnp.zeros((c,), jnp.float32),
+                jnp.float32(0.0),
+            ),
+            tl_mod.zeros_summary(spec, packed=ctx["packed"]),
+            jnp.zeros((S, W)),
+            pol_mod.init_state(ctx["dtab"], lag_periods=ctx["lag"]),
+            pol_mod.zeros_summary(spec, S),
+        )
+        (_, tl_final, _, _, pol_final), parts = jax.lax.scan(
+            block_body, carry0, jnp.arange(num_blocks)
+        )
+        merged_summary = self._merge_summary_collective(
+            reduce_stacked(parts), both
+        )
+        # tl_final / pol_final are already global (per-block psums) and
+        # replicated across shards
+        return merged_summary, tl_final, pol_final
+
+    def _local_policy_scan_all(
+        self,
+        block: int,
+        num_blocks: int,
+        kind: str,
+        conns_local: int,
+        trim: bool,
+        tl_plan: Tuple[int, float],
+        key: jax.Array,
+        offered_qps: jax.Array,
+        pace_gap: jax.Array,
+        nominal_gap: jax.Array,
+        win_lo: jax.Array,
+        win_hi: jax.Array,
+        visits_pc: jax.Array,
+        phase_windows: jax.Array,
+    ):
+        """The emulated twin's whole-mesh scan: one traced program
+        whose block body sweeps every shard (unrolled, shard order)
+        and replays the per-block psum as sequential sums in the
+        device merge's association order (ICI shards within each
+        slice first, slice partials last)."""
+        ctx = self._policy_block_ctx(tl_plan)
+        spec, pol_mod, tl_mod = ctx["spec"], ctx["pol_mod"], ctx["tl_mod"]
+        R = self.n_shards
+        c = max(conns_local, 1)
+        per = block // c
+        S = self.compiled.num_services
+        W = spec.num_windows
+        n_slices = dict(self.mesh.shape).get(SLICE_AXIS, 1)
+        per_slice = R // max(n_slices, 1)
+
+        def _hier_sum(vals):
+            def _seq(vs):
+                acc = vs[0]
+                for v in vs[1:]:
+                    acc = jax.tree.map(jnp.add, acc, v)
+                return acc
+
+            return _seq([
+                _seq(vals[i * per_slice:(i + 1) * per_slice])
+                for i in range(max(n_slices, 1))
+            ])
+
+        def block_body(carry, b):
+            (t0s, conn_t0s, req_offs), tl_acc, obs_acc, pstate, \
+                pol_acc = carry
+            fx = pol_mod.effects(pstate)
+            sums = []
+            tl_parts = []
+            obs_parts = []
+            t_ends = []
+            conn_ends = []
+            for s_i in range(R):
+                kb = jax.random.fold_in(
+                    jax.random.fold_in(key, 500_000 + s_i),
+                    1_000_000 + b,
+                )
+                res, t_end, conn_end = self.sim._simulate_core(
+                    block, kind, conns_local, kb, offered_qps,
+                    pace_gap, offered_qps / R, nominal_gap,
+                    t0s[s_i], conn_t0s[s_i], req_offs[s_i],
+                    visits_pc=visits_pc,
+                    phase_windows=phase_windows,
+                    policy_fx=fx,
+                )
+                sums.append(summarize(
+                    res, self.collector,
+                    window=(win_lo, win_hi) if trim else None,
+                ))
+                tl_parts.append(
+                    tl_mod.timeline_block(res, spec,
+                                          packed=ctx["packed"])
+                )
+                obs_parts.append(
+                    pol_mod.observe_block(res, spec,
+                                          ctx["retry_mask"])
+                )
+                t_ends.append(t_end)
+                conn_ends.append(conn_end)
+            tl_blk = _hier_sum([
+                p._replace(window_s=jnp.float32(0.0))
+                for p in tl_parts
+            ])._replace(window_s=jnp.float32(spec.window_s))
+            obs_blk = _hier_sum(obs_parts)
+            tl_acc = tl_mod.accumulate(tl_acc, tl_blk)
+            obs_acc = obs_acc + obs_blk
+            locals_ = [
+                jnp.min(ce) if kind != OPEN_LOOP else te
+                for te, ce in zip(t_ends, conn_ends)
+            ]
+            t_done = locals_[0]
+            for t in locals_[1:]:
+                t_done = jnp.minimum(t_done, t)
+            pstate, delta = pol_mod.advance(
+                pstate, ctx["dtab"], tl_acc, obs_acc, t_done, spec,
+                stuck_breaker=ctx["stuck"], downed_w=ctx["downed_w"],
+            )
+            pol_acc = pol_mod.accumulate_summary(pol_acc, delta)
+            carry_out = (
+                (
+                    jnp.stack(t_ends),
+                    jnp.stack(conn_ends),
+                    req_offs + per,
+                ),
+                tl_acc, obs_acc, pstate, pol_acc,
+            )
+            return carry_out, tuple(sums)
+
+        carry0 = (
+            (
+                jnp.zeros((R,), jnp.float32),
+                jnp.zeros((R, c), jnp.float32),
+                jnp.zeros((R,), jnp.float32),
+            ),
+            tl_mod.zeros_summary(spec, packed=ctx["packed"]),
+            jnp.zeros((S, W)),
+            pol_mod.init_state(ctx["dtab"], lag_periods=ctx["lag"]),
+            pol_mod.zeros_summary(spec, S),
+        )
+        (_, tl_final, _, _, pol_final), parts = jax.lax.scan(
+            block_body, carry0, jnp.arange(num_blocks)
+        )
+        return (
+            tuple(reduce_stacked(p) for p in parts),
+            tl_final,
+            pol_final,
+        )
+
+    def _pol_cache_key(self, plan: _RunPlan, tl_plan):
+        return (plan.block, plan.num_blocks, plan.kind,
+                plan.conns_local, plan.trim, tl_plan)
+
+    def _get_pol(self, plan: _RunPlan, tl_plan: Tuple[int, float]):
+        cache_key = self._pol_cache_key(plan, tl_plan)
+        key = ("sharded-pol",) + cache_key
+        if key not in self._fns:
+            from isotope_tpu.metrics import timeline as timeline_mod
+            from isotope_tpu.sim import policies as policies_mod
+
+            body = partial(self._pol_body, *cache_key)
+            tl_spec = timeline_mod.TimelineSummary(
+                *([P()] * len(timeline_mod.TimelineSummary._fields))
+            )
+            pol_spec = policies_mod.PolicySummary(
+                *([P()] * len(policies_mod.PolicySummary._fields))
+            )
+            mapped = _shard_map(
+                body,
+                mesh=self.mesh,
+                in_specs=tuple(P() for _ in range(8)),
+                out_specs=(
+                    self._summary_out_specs(), tl_spec, pol_spec,
+                ),
+            )
+            mesh_sig = (
+                tuple(self.mesh.axis_names),
+                tuple(int(self.mesh.shape[a])
+                      for a in self.mesh.axis_names),
+                tuple(d.id for d in self.mesh.devices.flat),
+            )
+            self._fns[key] = executable_cache.get_or_build(
+                ("sharded-pol", self.sim.signature, mesh_sig)
+                + cache_key,
+                lambda: telemetry.time_first_call(
+                    jax.jit(mapped), "compile.jit_first_call"
+                ),
+            )
+        return self._fns[key]
+
+    def _get_local_pol_fn(self, plan: _RunPlan,
+                          tl_plan: Tuple[int, float]):
+        cache_key = self._pol_cache_key(plan, tl_plan)
+        full_key = ("sharded-pol-local", self.sim.signature,
+                    self.n_shards) + cache_key
+        return executable_cache.get_or_build(
+            full_key,
+            lambda: telemetry.time_first_call(
+                jax.jit(partial(self._local_policy_scan_all,
+                                *cache_key)),
                 "compile.jit_first_call",
             ),
         )

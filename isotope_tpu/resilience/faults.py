@@ -20,13 +20,23 @@ comma-separated ``kind:site[:arg]`` entries:
 - ``nan:segment:<index>`` — poison the output of tensor-program
   segment ``<index>`` with NaN at trace time (``arg`` is the segment
   index, not a count; exercises the numeric sentinels and detail-mode
-  localization).
+  localization);
+- ``stuck:policies.stuck_breaker`` — BEHAVIORAL chaos against the
+  policy co-sim (sim/policies.py): a tripped circuit breaker never
+  closes (its shed fraction only ratchets up);
+- ``lag:policies.autoscaler_lag[:N]`` — the autoscaler control loop
+  misses its first ``N`` sync periods (default 1) — the
+  HPA-controller-restart failure mode.
 
 Sites are the supervisor's phase names: ``engine.build``,
 ``engine.run``, ``sharded.args_put``, ``sharded.compute``,
 ``sharded.dcn_collective`` (DCN-axis meshes only — the dropped
-cross-host collective), ``sharded.gather``, ``cache.load``.
-``check(site)`` is a dict lookup
+cross-host collective), ``sharded.gather``, ``cache.load``, plus the
+policy-layer sites ``policies.stuck_breaker`` /
+``policies.autoscaler_lag`` — the standard kinds (oom / transient /
+corrupt) may target those too, raising a taxonomy-classified fault at
+the policy run's entry so the supervisor's retry path covers the
+policy layer.  ``check(site)`` is a dict lookup
 returning immediately when no plan is armed — the default no-fault
 path gains zero work and zero sync points.
 """
@@ -46,7 +56,7 @@ from isotope_tpu.resilience.taxonomy import (
 
 ENV_FAULT_INJECT = "ISOTOPE_FAULT_INJECT"
 
-KINDS = ("oom", "transient", "corrupt", "nan")
+KINDS = ("oom", "transient", "corrupt", "nan", "stuck", "lag")
 
 #: every instrumented ``check(site)`` call site in the engine — the
 #: closed universe a spec may target.  A typo'd site used to parse
@@ -64,6 +74,12 @@ VALID_SITES = (
     "sharded.dcn_collective",
     "sharded.gather",
     "cache.load",
+    # the policy co-sim's own chaos sites (sim/policies.py): the
+    # standard kinds raise classified faults at the policy run's
+    # entry; the behavioral kinds ("stuck"/"lag") alter the traced
+    # control program instead of raising
+    "policies.stuck_breaker",
+    "policies.autoscaler_lag",
 )
 
 #: fault kind -> (message template, taxonomy class).  Messages imitate
@@ -102,7 +118,7 @@ class FaultPlan:
         self.entries = entries
         self._by_site: Dict[str, List[_Entry]] = {}
         for e in entries:
-            if e.kind != "nan":
+            if e.kind not in ("nan", "stuck", "lag"):
                 self._by_site.setdefault(e.site, []).append(e)
 
     @classmethod
@@ -130,15 +146,28 @@ class FaultPlan:
                     f"nan faults target segments (nan:segment:<idx>), "
                     f"got site {site!r}"
                 )
+            if kind == "stuck" and site != "policies.stuck_breaker":
+                raise ValueError(
+                    "stuck faults target the breaker "
+                    "(stuck:policies.stuck_breaker), got site "
+                    f"{site!r}"
+                )
+            if kind == "lag" and site != "policies.autoscaler_lag":
+                raise ValueError(
+                    "lag faults target the autoscaler "
+                    "(lag:policies.autoscaler_lag[:N]), got site "
+                    f"{site!r}"
+                )
             if kind != "nan" and site not in VALID_SITES:
                 raise ValueError(
                     f"unknown fault site {site!r} — the plan would "
                     f"never fire (valid sites: "
                     f"{', '.join(VALID_SITES)})"
                 )
+            behavioral = kind in ("nan", "stuck", "lag")
             entries.append(
                 _Entry(kind=kind, site=site, arg=arg,
-                       remaining=0 if kind == "nan" else arg)
+                       remaining=0 if behavioral else arg)
             )
         return cls(entries)
 
@@ -156,16 +185,34 @@ class FaultPlan:
                 return e.arg
         return None
 
+    def stuck_breaker(self) -> bool:
+        return any(e.kind == "stuck" for e in self.entries)
+
+    def autoscaler_lag(self) -> int:
+        for e in self.entries:
+            if e.kind == "lag":
+                return max(e.arg, 1)
+        return 0
+
     def signature(self) -> str:
         """Stable identity of the TRACE-AFFECTING part of the plan.
 
-        Only NaN poisoning changes the traced program (it bakes a NaN
-        constant into a segment), so only it participates — the
-        executable caches must not share a poisoned program with a
-        clean one, while pure host-side faults keep full cache reuse.
+        The BEHAVIORAL kinds change the traced program — NaN poisoning
+        bakes a poisoned constant into a segment, stuck/lag alter the
+        policy control trace — so they participate; the executable
+        caches must not share an altered program with a clean one,
+        while pure host-side faults keep full cache reuse.
         """
+        parts = []
         seg = self.nan_segment()
-        return "" if seg is None else f"nan:segment:{seg}"
+        if seg is not None:
+            parts.append(f"nan:segment:{seg}")
+        if self.stuck_breaker():
+            parts.append("stuck:policies.stuck_breaker")
+        lag = self.autoscaler_lag()
+        if lag:
+            parts.append(f"lag:policies.autoscaler_lag:{lag}")
+        return ",".join(parts)
 
 
 _plan: Optional[FaultPlan] = None
@@ -226,6 +273,22 @@ def nan_segment() -> Optional[int]:
     if not _env_loaded:
         _load_env()
     return None if _plan is None else _plan.nan_segment()
+
+
+def stuck_breaker() -> bool:
+    """Behavioral policy chaos: tripped breakers never close
+    (trace-time hook for sim/policies.advance)."""
+    if not _env_loaded:
+        _load_env()
+    return False if _plan is None else _plan.stuck_breaker()
+
+
+def autoscaler_lag() -> int:
+    """Behavioral policy chaos: sync periods the autoscaler misses at
+    startup (0 = chaos off; trace-time hook for policies.init_state)."""
+    if not _env_loaded:
+        _load_env()
+    return 0 if _plan is None else _plan.autoscaler_lag()
 
 
 def signature() -> str:

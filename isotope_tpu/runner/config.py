@@ -156,6 +156,13 @@ class ExperimentConfig:
     # windowed series on device (--timeline[=<window>])
     timeline: bool = False
     timeline_window_s: float = SimParams().timeline_window_s
+    # in-graph resilience policies (sim/policies.py): when True, the
+    # topology's `policies:` block compiles to per-service tables and
+    # the MAIN run co-simulates the breaker / retry-budget /
+    # autoscaler control loop inside the block scan (--policies /
+    # TOML [sim] policies = true).  Implies the timeline recorder (the
+    # control loop's observation side).
+    policies: bool = False
 
     def sim_params(self) -> SimParams:
         return SimParams(
@@ -163,7 +170,8 @@ class ExperimentConfig:
             service_time=self.service_time,
             service_time_param=self.service_time_param,
             attribution=self.attribution,
-            timeline=self.timeline,
+            # the policy co-sim observes through the flight recorder
+            timeline=self.timeline or self.policies,
             timeline_window_s=self.timeline_window_s,
             overlap=self.overlap,
         )
@@ -387,4 +395,5 @@ def load_toml(path) -> ExperimentConfig:
             if "timeline_window" in sim
             else SimParams().timeline_window_s
         ),
+        policies=bool(sim.get("policies", False)),
     )

@@ -88,6 +88,11 @@ class RunResult:
     # timeline pass was off or failed
     timeline: Optional[dict] = None
     timeline_summary: Optional[object] = None
+    # in-graph resilience policies (sim/policies.py): the
+    # policies.json doc and the raw PolicySummary of the PROTECTED
+    # main run; None when the policy co-sim was off
+    policies: Optional[dict] = None
+    policies_summary: Optional[object] = None
 
 
 def _failed_window(reason: str) -> WindowSummary:
@@ -145,6 +150,8 @@ class _LazyTopology:
         self._entry_resp = 0.0
         self._graph = None
         self._sims = {}
+        self._policy_tables = None
+        self._policy_tables_built = False
 
     @property
     def compiled(self):
@@ -174,6 +181,21 @@ class _LazyTopology:
     def entry_response_size(self) -> float:
         self.compiled
         return self._entry_resp
+
+    @property
+    def policy_tables(self):
+        """Compiled resilience-policy tables (sim/policies.py), or
+        None when the topology declares none or the config leaves the
+        co-sim off."""
+        if not self._policy_tables_built:
+            self._policy_tables_built = True
+            if self.config.policies:
+                from isotope_tpu.compiler import compile_policies
+
+                self._policy_tables = compile_policies(
+                    self.graph, self.compiled
+                )
+        return self._policy_tables
 
     def mesh_spec(self) -> MeshSpec:
         """The resolved factorization for this topology (``"auto"``
@@ -214,8 +236,10 @@ class _LazyTopology:
         """(Simulator, ShardedSimulator | None) for an environment."""
         if env.name not in self._sims:
             params = env.apply(self.config.sim_params())
+            policies = self.policy_tables
             sim = Simulator(self.compiled, params, self.config.chaos,
-                            self.config.churn, mtls=self.config.mtls)
+                            self.config.churn, mtls=self.config.mtls,
+                            policies=policies)
             spec = self.mesh_spec()
             sharded = (
                 ShardedSimulator(
@@ -225,6 +249,7 @@ class _LazyTopology:
                     self.config.chaos,
                     self.config.churn,
                     mtls=self.config.mtls,
+                    policies=policies,
                 )
                 if spec.size > 1
                 else None
@@ -405,6 +430,98 @@ def _timeline_pass(sim, sharded, use_sharded, topo, load, n, key,
         telemetry.counter_inc("timeline_pass_failures")
         print(f"warning: timeline pass failed: {e}", file=sys.stderr)
         return None, None
+
+
+def _policy_run(sim, sharded, use_sharded, load, n, key, block,
+                config, collector, policy, timeline,
+                attribution=None):
+    """The policy co-sim main run for one case (sim/policies.py):
+    the PROTECTED physics is the measurement, so this replaces the
+    ladder run.  Supervised retries apply (``call_with_retries``);
+    the OOM degradation ladder for policy runs is a follow-up.
+
+    The block size is capped near ONE recorder window of requests:
+    the control loop actuates at block boundaries, so the default
+    HBM-sized block would give a whole-run actuation lag.
+
+    ``attribution`` additionally runs the blame pass OVER THE
+    PROTECTED physics (identical streams/blocking/trajectory to the
+    main run) when the case ran single-device; a mesh-served case
+    skips it with a warning (the sharded policy program does not
+    reduce blame yet).  Returns ``(summary, timeline, policies,
+    blame_doc | None, attr_summary | None)``."""
+    # svc-sharded meshes split the per-service metric layout the
+    # replicated control state needs; fall back to the single-device
+    # scan for those rather than failing the case
+    runner = (
+        sharded
+        if use_sharded and sharded is not None and sharded.n_svc == 1
+        else sim
+    )
+    if use_sharded and sharded is not None and runner is sim:
+        # the fallback is a different execution shape — say so
+        # instead of silently serving a mesh-sized case on one device
+        print(
+            "warning: --policies falls back to the single-device "
+            "scan (the svc-sharded mesh splits the per-service "
+            "metric layout the replicated control state needs; use "
+            "svc=1)",
+            file=sys.stderr,
+        )
+    if timeline is not None:
+        win = float(timeline)
+    else:
+        # a window that never completes is a control loop that never
+        # observes: without an explicit --timeline width, size the
+        # default so a run spans >= ~8 windows
+        win = min(
+            config.timeline_window_s,
+            max(load.duration_s / 8.0, 1e-3),
+        )
+    rate = load.qps if load.qps is not None else sim.capacity_qps()
+    shards = getattr(runner, "n_shards", 1)
+    block = max(256, min(block, int(max(rate * win / shards, 1.0))))
+    kwargs = dict(block_size=block, trim=True, window_s=win)
+    if runner is sim:
+        # the sharded runner summarizes with its own collector
+        kwargs["collector"] = collector
+    with telemetry.phase("policies.run"):
+        out = call_with_retries(
+            lambda: runner.run_policies(load, n, key, **kwargs),
+            site="engine.run", policy=policy,
+        )
+    telemetry.counter_inc("policy_main_runs")
+    blame_doc = attr_summary = None
+    if attribution is not None:
+        if runner is not sim:
+            print(
+                "warning: --attribution under --policies is skipped "
+                "for mesh-served cases (the sharded policy program "
+                "does not reduce blame yet)",
+                file=sys.stderr,
+            )
+        else:
+            from isotope_tpu.metrics import attribution as attr_mod
+
+            try:
+                with telemetry.phase("attribution.pass"):
+                    _, _, _, attr_summary = sim.run_policies(
+                        load, n, key, attribution=True,
+                        tail=attribution == "tail", **kwargs,
+                    )
+                    jax.block_until_ready(attr_summary.count)
+                blame_doc = attr_mod.to_doc(
+                    sim.compiled, attr_summary
+                )
+                telemetry.counter_inc("attribution_passes")
+            except Exception as e:  # pragma: no cover - best effort
+                telemetry.counter_inc("attribution_pass_failures")
+                print(
+                    f"warning: protected attribution pass failed: {e}",
+                    file=sys.stderr,
+                )
+                attr_summary = None
+    return out + (blame_doc, attr_summary)
 
 
 def _record_vet_memory_ratio() -> None:
@@ -598,11 +715,30 @@ def run_experiment(
                                     vet, sim, topo, config, load,
                                     block, rungs, policy,
                                 )
-                            summary, degraded_to = run_ladder(
-                                rungs[start_rung:], policy,
-                                site_prefix="engine",
-                            )
-                            if start_rung and degraded_to is None:
+                            tl_main = pol_main = None
+                            pol_blame = pol_attr = None
+                            if topo.policy_tables is not None:
+                                # policy co-sim: the PROTECTED run IS
+                                # the measurement (policies change the
+                                # physics), so it replaces the ladder
+                                # run; supervised retries still apply
+                                # (degradation rungs are a follow-up)
+                                (summary, tl_main, pol_main,
+                                 pol_blame, pol_attr) = _policy_run(
+                                    sim, sharded, use_sharded,
+                                    load, n, run_key, block,
+                                    config, topo.collector,
+                                    policy, timeline,
+                                    attribution=attribution,
+                                )
+                                degraded_to = None
+                            else:
+                                summary, degraded_to = run_ladder(
+                                    rungs[start_rung:], policy,
+                                    site_prefix="engine",
+                                )
+                            if start_rung and degraded_to is None \
+                                    and pol_main is None:
                                 # the pre-selected rung IS a
                                 # degradation: record it exactly as a
                                 # ladder descent would have (bench
@@ -655,7 +791,12 @@ def run_experiment(
                         run_index += 1
                         continue
                     blame_doc = attr_summary = None
-                    if attribution is not None:
+                    if pol_main is not None:
+                        # the protected attributed pass (if requested)
+                        # already ran inside _policy_run with the same
+                        # streams/trajectory as the main measurement
+                        blame_doc, attr_summary = pol_blame, pol_attr
+                    elif attribution is not None:
                         # identical executor/key/blocking to the main
                         # run, so the attributed pass replays the same
                         # request streams the reported metrics came
@@ -666,7 +807,28 @@ def run_experiment(
                             tail=attribution == "tail",
                         )
                     tl_doc = tl_summary = None
-                    if timeline is not None:
+                    pol_doc = pol_summary_out = None
+                    if pol_main is not None:
+                        # the protected run already reduced the
+                        # timeline next to the policy series — no
+                        # separate recorder pass needed
+                        from isotope_tpu.metrics import (
+                            timeline as timeline_mod,
+                        )
+                        from isotope_tpu.sim import (
+                            policies as policies_mod,
+                        )
+
+                        tl_summary = tl_main
+                        tl_doc = timeline_mod.to_doc(
+                            topo.compiled, tl_main
+                        )
+                        pol_summary_out = pol_main
+                        pol_doc = policies_mod.to_doc(
+                            topo.compiled, pol_main,
+                            topo.policy_tables,
+                        )
+                    elif timeline is not None:
                         tl_doc, tl_summary = _timeline_pass(
                             sim, sharded, use_sharded, topo, load, n,
                             run_key, block, window_s=timeline,
@@ -697,6 +859,12 @@ def run_experiment(
                         # bench_regress fails a capture that degrades a
                         # previously-clean case)
                         flat["degraded_to"] = degraded_to
+                    if pol_doc is not None:
+                        # the row came from PROTECTED physics — a
+                        # different measurement than an unprotected
+                        # run of the same grid cell
+                        flat["_policies"] = True
+                        telemetry.set_meta("policies", "on")
                     flat.update(
                         {
                             "cpu_cores_" + name: round(v, 4)
@@ -736,6 +904,8 @@ def run_experiment(
                         ),
                         timeline=tl_doc,
                         timeline_summary=tl_summary,
+                        policies=pol_doc,
+                        policies_summary=pol_summary_out,
                     )
                     results.append(result)
                     if out is not None:
@@ -754,6 +924,11 @@ def run_experiment(
                                 out / f"{label}.timeline.json", "w"
                             ) as f:
                                 json.dump(tl_doc, f, indent=2)
+                        if pol_doc is not None:
+                            with open(
+                                out / f"{label}.policies.json", "w"
+                            ) as f:
+                                json.dump(pol_doc, f, indent=2)
                         if attr_summary is not None:
                             from isotope_tpu.metrics.export import (
                                 write_flamegraph,

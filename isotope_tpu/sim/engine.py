@@ -554,6 +554,7 @@ class Simulator:
         chaos: Sequence[ChaosEvent] = (),
         churn: Sequence[TrafficSplit] = (),
         mtls: Optional[MtlsSchedule] = None,
+        policies=None,  # Optional[policies.PolicyTables]
     ):
         # engine.build covers everything below: device-constant upload,
         # bucket planning, copula tables — the host-side cost a compile
@@ -584,7 +585,20 @@ class Simulator:
         t = compiled.services
         net = params.network
 
+        # -- in-graph resilience policies (sim/policies.py) ----------------
+        # Compiled per-service tables for the breaker / retry-budget /
+        # autoscaler co-sim.  ``None`` (the default) leaves EVERY traced
+        # program byte-identical — all policy effects below gate on it.
+        self._policies = policies
+        self._has_retries = any(
+            lvl.att_child.shape[0] > 1 for lvl in compiled.levels
+        )
         self._k_max = int(t.replicas.max())
+        if policies is not None:
+            # the autoscaler can grow stations past the static replica
+            # max; the Erlang recursion length must cover the widest
+            # station the dynamic wait law can reach
+            self._k_max = max(self._k_max, policies.k_max)
         self._mu = 1.0 / params.cpu_time_s
 
         # -- traffic splits (config churner): per-hop schedule ids ---------
@@ -710,6 +724,14 @@ class Simulator:
                     eff[p, s] -= down
         eff = np.maximum(eff, 0)
         svc_down_np = eff == 0                               # (P, S)
+        if policies is not None:
+            # chaos kills compose with the autoscaler's dynamic count:
+            # the per-phase DOWN delta (static replicas minus the
+            # phase's effective count) subtracts from whatever count
+            # the policy state actuated (floored at one server)
+            self._downed_p_np = (
+                t.replicas.astype(np.float64)[None, :] - eff
+            )
         self._phase_starts = jnp.asarray(cuts, jnp.float32)  # (P,)
         self._svc_down = jnp.asarray(svc_down_np)            # (P, S) bool
         self._eff_replicas = jnp.asarray(np.maximum(eff, 1), jnp.int32)
@@ -827,6 +849,10 @@ class Simulator:
         self._visits_pc = jnp.asarray(visits_pc, jnp.float32)
         self._eff_replicas_pc = jnp.repeat(self._eff_replicas, Cc, axis=0)
         self._svc_down_pc = jnp.repeat(self._svc_down, Cc, axis=0)
+        if policies is not None:
+            self._downed_pc = jnp.asarray(
+                np.repeat(self._downed_p_np, Cc, axis=0), jnp.float32
+            )
 
         # -- retry-storm feedback (load-dependent visits) ------------------
         # With finite call timeouts the retry/truncation probabilities are
@@ -849,6 +875,18 @@ class Simulator:
                 own_combo_np,
                 visits_pc,
                 mtls=mtls,
+                # retry budgets (sim/policies.py) cap the attempt fan;
+                # the static visit estimates must respect the same cap
+                # or the wait tables overstate storm amplification
+                retry_budget=(
+                    (
+                        policies.has_budget,
+                        policies.budget_frac,
+                        policies.budget_min,
+                    )
+                    if policies is not None and policies.any_budget
+                    else None
+                ),
             )
             if not self._feedback.active:  # pragma: no cover - guard match
                 self._feedback = None
@@ -1099,6 +1137,8 @@ class Simulator:
                 bool(np.isfinite(l.call_timeout).any())
                 for l in compiled.levels
             )
+            # breaker sheds take the 500 error path (sim/policies.py)
+            or (policies is not None and policies.any_breaker)
         )
         shapes = [
             buckets.LevelShape(
@@ -1112,7 +1152,11 @@ class Simulator:
         plan = buckets.plan_segments(
             shapes,
             waste=params.level_bucket_waste,
-            enabled=params.bucketed_scan,
+            # the policy co-sim's retry-budget gate lives in the
+            # UNROLLED attempt loop only; a policies Simulator keeps
+            # the specialized per-level trace (bit-identical results,
+            # sim/levelscan.py — scan-bucket support is a follow-up)
+            enabled=params.bucketed_scan and policies is None,
             schedule=params.bucket_schedule,
         )
         self._segments = tuple(
@@ -1155,6 +1199,9 @@ class Simulator:
                 faults.signature(),
                 repr(params), repr(tuple(chaos)), repr(self._churn),
                 repr(mtls), repr(t.names),
+                # policy tables bake into the traced control program;
+                # absent tables contribute the historical empty digest
+                policies.signature() if policies is not None else "",
                 compiled.hop_service, compiled.hop_parent,
                 compiled.hop_step, compiled.hop_attempt,
                 compiled.hop_send_prob, compiled.hop_request_size,
@@ -1984,6 +2031,311 @@ class Simulator:
                 self._windows_arg(offered, sat),
             )
 
+    def run_policies(
+        self,
+        load: LoadModel,
+        num_requests: int,
+        key: jax.Array,
+        *,
+        block_size: int = 65_536,
+        collector=None,
+        fixed_point_iters: int = 3,
+        trim: bool = False,
+        window_s: Optional[float] = None,
+        attribution: bool = False,
+        tail: bool = False,
+        tail_cut: Optional[float] = None,
+    ):
+        """Co-simulate the per-service resilience policies
+        (sim/policies.py) inside the block scan: the scan carry holds
+        the policy state next to the flight-recorder accumulator, each
+        block runs under the CURRENT policy effects (breaker sheds,
+        budgeted retries, autoscaled capacity in the wait law), and the
+        control law advances through every window the block completed
+        — observation at window granularity, actuation at block
+        granularity (one-block lag, the scrape-interval lag a real
+        HPA/Envoy stack has).
+
+        Returns ``(RunSummary, TimelineSummary, PolicySummary)`` — the
+        summary/timeline reflect the PROTECTED physics.  Requires
+        policy tables (``Simulator(..., policies=...)``) and
+        ``SimParams.timeline=True`` (the recorder is the observation
+        side of every control loop).  Saturated ``-qps max`` loads are
+        rejected: the finite-population tables are host-built from
+        static replica counts the policy state cannot reach.
+
+        ``attribution=True`` (needs ``SimParams.attribution``) ALSO
+        reduces the PR-5 critical-path blame over the protected
+        physics inside the same scan — identical streams and policy
+        trajectory — returning a 4-tuple ``(..., AttributionSummary)``
+        so a protected run's blame shift is measurable against the
+        unprotected twin's.  ``tail=True`` arms the conditional-tail
+        accumulators at ``tail_cut`` (estimated from an UNPROTECTED
+        pilot histogram when not given — conservative: the protected
+        run's latencies sit below it, so the cut selects its deepest
+        tail).
+        """
+        if self._policies is None:
+            raise ValueError(
+                "policy runs need compiled policy tables "
+                "(Simulator(..., policies=compile_policies(graph, "
+                "compiled)))"
+            )
+        if not self.params.timeline:
+            raise ValueError(
+                "policy runs need SimParams(timeline=True) — the "
+                "flight recorder is the control loop's observation side"
+            )
+        if self._saturated(load):
+            raise ValueError(
+                "policy runs do not support saturated -qps max loads: "
+                "the finite-population wait tables are host-built from "
+                "static replica counts the policy state cannot change; "
+                "use a paced closed loop or open loop"
+            )
+        if attribution and not self.params.attribution:
+            raise ValueError(
+                "attributed policy runs need SimParams(attribution="
+                "True) alongside the policy tables"
+            )
+        # the policy layer's own chaos sites: standard fault kinds
+        # (oom/transient/corrupt) raise classified faults here so the
+        # supervisor's retry path covers the policy runner too; the
+        # behavioral kinds (stuck/lag) alter the traced control program
+        # below instead
+        faults.check("policies.stuck_breaker")
+        faults.check("policies.autoscaler_lag")
+        if attribution and tail and tail_cut is None:
+            tail_cut = self.estimate_tail_cut(
+                load, num_requests, key, block_size=block_size
+            )
+        if load.kind == OPEN_LOOP:
+            offered = float(load.qps)
+            pace = 0.0
+            nominal = 0.0
+            conns = 0
+            block = max(1, min(block_size, num_requests))
+        else:
+            conns = load.connections
+            offered = self.solve_closed_rate(load, num_requests, key,
+                                             fixed_point_iters)
+            pace = conns / load.qps if load.qps is not None else 0.0
+            nominal = conns / offered
+            per = max(1, min(block_size, num_requests) // conns)
+            block = per * conns
+        num_blocks = max(1, -(-num_requests // block))
+        if trim:
+            from isotope_tpu.metrics.fortio import trim_window_bounds
+
+            window = trim_window_bounds(num_blocks * block, offered)
+        else:
+            window = (0.0, np.inf)
+        tl_plan = self.plan_timeline_windows(
+            num_blocks * block, offered, window_s
+        )
+        fn = self._get_policies(
+            block, num_blocks, load.kind, conns, collector, trim,
+            tl_plan,
+            attr=("tail" if tail else "mean") if attribution else None,
+        )
+        faults.check("engine.run")
+        telemetry.gauge_set("engine_block_requests", block)
+        telemetry.gauge_set("engine_num_blocks", num_blocks)
+        telemetry.counter_inc("policy_runs")
+        with self._detail_ctx():
+            return fn(
+                key, jnp.float32(offered), jnp.float32(pace),
+                jnp.float32(offered), jnp.float32(nominal),
+                jnp.float32(window[0]), jnp.float32(window[1]),
+                jnp.float32(
+                    tail_cut
+                    if (attribution and tail_cut is not None)
+                    else np.inf
+                ),
+                self._vis_arg(offered),
+                self._windows_arg(offered, False),
+            )
+
+    def _policy_downed_windows(self, spec):
+        """(S, W) chaos-downed replica counts per recorder window (the
+        nominal phase covering each window's END), or None without
+        chaos — the autoscaler's alive-capacity denominator must see
+        the kill or a dead service reads as idle and scales DOWN."""
+        if self._policies is None or not self.has_chaos:
+            return None
+        cuts = np.asarray(self._phase_starts, np.float64)
+        w_end = (
+            np.arange(spec.num_windows, dtype=np.float64) + 1.0
+        ) * spec.window_s
+        p_idx = np.clip(
+            np.searchsorted(cuts, w_end, side="right") - 1,
+            0, len(cuts) - 1,
+        )
+        return jnp.asarray(self._downed_p_np[p_idx].T, jnp.float32)
+
+    def _get_policies(self, block: int, num_blocks: int, kind: str,
+                      connections: int, collector, trim: bool,
+                      tl_plan: Tuple[int, float],
+                      attr: Optional[str] = None):
+        """Jitted scan-over-blocks program co-simulating the policy
+        control loop: carry = (clocks, timeline accumulator, retry
+        observation accumulator, policy state, policy series) — the
+        stateful-lattice-in-a-scan idiom, policy dynamics as pure
+        carry arithmetic.
+
+        ``attr`` additionally reduces the PR-5 blame decomposition
+        over the SAME protected blocks (per-block blame vectors stack,
+        the top-K exemplar state rides the carry next to the policy
+        state); the traced ``tail_cut`` argument is ignored (inf) by
+        the plain variant."""
+        from isotope_tpu.metrics import timeline as timeline_mod
+        from isotope_tpu.sim import policies as policies_mod
+        from isotope_tpu.sim import summary as summary_mod
+
+        cache_key = (block, num_blocks, kind, connections,
+                     collector is not None, trim, tl_plan, attr,
+                     "policies")
+        if cache_key not in self._summary_fns:
+            c = max(connections, 1)
+            per = block // c
+            tspec = timeline_mod.build_spec(
+                self.compiled, tl_plan[0], tl_plan[1]
+            )
+            dtab = policies_mod.device_tables(self._policies)
+            S = self.compiled.num_services
+            W = tspec.num_windows
+            downed_w = self._policy_downed_windows(tspec)
+            stuck = faults.stuck_breaker()
+            lag = faults.autoscaler_lag()
+            retry_mask = jnp.asarray(self.compiled.hop_attempt > 0)
+            packed = self.params.packed_carries
+            if attr is not None:
+                from isotope_tpu.metrics import attribution
+
+                atables = self._attribution_tables()
+                top_k = self.params.attribution_top_k
+
+            def scanfn(key, offered_qps, pace_gap, arrival_qps,
+                       nominal_gap, win_lo, win_hi, tail_cut,
+                       visits_pc, phase_windows):
+                telemetry.record_trace(
+                    ("policies", self.signature[3]) + cache_key,
+                    tracing=isinstance(key, jax.core.Tracer),
+                    requests=block, hops=self.compiled.num_hops,
+                )
+
+                def body(carry, b):
+                    ((t0, conn_t0, req_off), tl_acc, obs_acc,
+                     pstate, pol_acc, ex) = carry
+                    fx = policies_mod.effects(pstate)
+                    kb = jax.random.fold_in(key, 1_000_000 + b)
+                    res, t_end, conn_end = self._simulate_core(
+                        block, kind, connections, kb, offered_qps,
+                        pace_gap, arrival_qps, nominal_gap, t0,
+                        conn_t0, req_off,
+                        visits_pc=visits_pc,
+                        phase_windows=phase_windows,
+                        policy_fx=fx,
+                    )
+                    s = summary_mod.summarize(
+                        res, collector,
+                        window=(win_lo, win_hi) if trim else None,
+                    )
+                    tl_acc = timeline_mod.accumulate(
+                        tl_acc,
+                        timeline_mod.timeline_block(
+                            res, tspec, packed=packed
+                        ),
+                    )
+                    obs_acc = obs_acc + policies_mod.observe_block(
+                        res, tspec, retry_mask
+                    )
+                    # closed loop: a window is final only once the
+                    # SLOWEST connection passed it — later blocks on
+                    # faster connections still write into windows
+                    # before conn_end.max()
+                    t_done = (
+                        jnp.min(conn_end)
+                        if kind == CLOSED_LOOP
+                        else t_end
+                    )
+                    pstate, delta = policies_mod.advance(
+                        pstate, dtab, tl_acc, obs_acc, t_done, tspec,
+                        stuck_breaker=stuck, downed_w=downed_w,
+                    )
+                    pol_acc = policies_mod.accumulate_summary(
+                        pol_acc, delta
+                    )
+                    ys = s
+                    if attr is not None:
+                        a, ex = attribution.attribute_block(
+                            res, atables,
+                            tail_cut=(
+                                tail_cut if attr == "tail" else None
+                            ),
+                            top_k=top_k, ex_state=ex,
+                            packed=packed,
+                        )
+                        ys = (s, a)
+                    return (
+                        (t_end, conn_end, req_off + per),
+                        tl_acc, obs_acc, pstate, pol_acc, ex,
+                    ), ys
+
+                ex0 = None
+                if attr is not None:
+                    k0 = min(top_k, block) if top_k > 0 else 0
+                    H = self.compiled.num_hops
+                    ex0 = (
+                        attribution.ExemplarBatch(
+                            latency=jnp.full((k0,), -jnp.inf),
+                            start=jnp.zeros((k0,)),
+                            error=jnp.zeros((k0,), bool),
+                            hop_sent=jnp.zeros((k0, H), bool),
+                            hop_error=jnp.zeros((k0, H), bool),
+                            hop_latency=jnp.zeros((k0, H)),
+                            hop_start=jnp.zeros((k0, H)),
+                        )
+                        if k0 > 0
+                        else None
+                    )
+                carry0 = (
+                    (
+                        jnp.float32(0.0),
+                        jnp.zeros((c,), jnp.float32),
+                        jnp.float32(0.0),
+                    ),
+                    timeline_mod.zeros_summary(tspec, packed=packed),
+                    jnp.zeros((S, W)),
+                    policies_mod.init_state(dtab, lag_periods=lag),
+                    policies_mod.zeros_summary(tspec, S),
+                    ex0,
+                )
+                (_, tl_final, _, _, pol_final, ex_final), ys = (
+                    jax.lax.scan(body, carry0, jnp.arange(num_blocks))
+                )
+                if attr is not None:
+                    parts, aparts = ys
+                    return (
+                        summary_mod.reduce_stacked(parts),
+                        tl_final,
+                        pol_final,
+                        attribution.reduce_stacked(aparts, ex_final),
+                    )
+                return (
+                    summary_mod.reduce_stacked(ys),
+                    tl_final,
+                    pol_final,
+                )
+
+            self._summary_fns[cache_key] = executable_cache.get_or_build(
+                ("policies", self.signature) + cache_key,
+                lambda: telemetry.time_first_call(
+                    jax.jit(scanfn), "compile.jit_first_call"
+                ),
+            )
+        return self._summary_fns[cache_key]
+
     def _attribution_tables(self):
         """Blame-sweep index tables (metrics/attribution.py), built
         lazily — a Simulator that never runs attributed pays nothing."""
@@ -2459,6 +2811,7 @@ class Simulator:
         sat_override: Optional[Tuple[jax.Array, jax.Array]] = None,
         visits_pc: Optional[jax.Array] = None,
         phase_windows: Optional[jax.Array] = None,
+        policy_fx=None,  # Optional[policies.PolicyFx]
     ) -> Tuple[SimResults, jax.Array, jax.Array]:
         """``offered_qps`` drives the queueing model (the rate the whole
         fleet of services sees); ``arrival_qps`` paces this batch's
@@ -2492,6 +2845,29 @@ class Simulator:
         u_err = (
             jax.random.uniform(k_err, (n, H)) if self._need_err else None
         )
+        # -- policy coins (sim/policies.py) --------------------------------
+        # Drawn from a FOLDED key so every existing stream keeps its
+        # layout: a protected run differs from the unprotected twin only
+        # by the policy effects themselves, not by RNG re-shuffling —
+        # the low-variance comparison tools/policies_smoke.py relies on.
+        shed_coin = None
+        retry_coin = None
+        if policy_fx is not None:
+            pol = self._policies
+            k_shed, k_retry = jax.random.split(
+                jax.random.fold_in(key, 770_001)
+            )
+            if pol.any_breaker:
+                shed_h = policy_fx.shed[self._hop_service]
+                shed_coin = (
+                    jax.random.uniform(k_shed, (n, H)) < shed_h[None, :]
+                )
+            if pol.any_budget and self._has_retries:
+                allow_h = policy_fx.retry_allow[self._hop_service]
+                retry_coin = (
+                    jax.random.uniform(k_retry, (n, H))
+                    < allow_h[None, :]
+                )
         # Wait draws: the saturated path (sat_conns > 0) consumes unit
         # NORMALS (its copulas compose in normal space); the open-loop
         # law consumes uniforms.  Either way the copulas — exact U(0,1)
@@ -2672,10 +3048,26 @@ class Simulator:
         Cc = self._num_combos
         if visits_pc is None:
             visits_pc = self._visits_pc
+        lam_pc = offered_qps * visits_pc
+        eff_replicas_pc = self._eff_replicas_pc
+        if policy_fx is not None:
+            pol = self._policies
+            if pol.any_breaker:
+                # shed requests never enter the queue: the wait law
+                # sees the ADMITTED load (downstream reach coupling of
+                # sheds is a stated approximation — a shed hop's
+                # subtree load still counts statically)
+                lam_pc = lam_pc * (1.0 - policy_fx.shed)[None, :]
+            if pol.any_hpa or pol.any_ejection:
+                # autoscaled/ejected capacity composes with the chaos
+                # phases' down deltas; every station keeps >= 1 server
+                eff_replicas_pc = jnp.maximum(
+                    policy_fx.replicas[None, :] - self._downed_pc, 1.0
+                ).astype(jnp.int32)
         qp = queueing.mmk_params(
-            offered_qps * visits_pc,
+            lam_pc,
             self._mu,
-            self._eff_replicas_pc,
+            eff_replicas_pc,
             self._k_max,
         )
         svc_down_pc = self._svc_down_pc
@@ -2810,6 +3202,11 @@ class Simulator:
             wait = queueing.sample_wait_conditional(
                 p_wait_nh, wait_rate_nh, u_wait
             )  # (N, H)
+        if shed_coin is not None:
+            # a shed request fast-fails at admission: it takes the
+            # error path below, NOT the queue (Envoy overflow 503s
+            # before the connection pool)
+            wait = jnp.where(shed_coin, 0.0, wait)
         # a fully-down service does no work: report zero utilization for
         # those phases instead of the clamped-to-1-replica saturation
         util_phase = jnp.where(svc_down_pc, 0.0, qp.utilization)
@@ -2821,6 +3218,13 @@ class Simulator:
         err_coin = (
             u_err < self._hop_err_rate if u_err is not None else None
         )  # (N, H) or None
+        if shed_coin is not None:
+            # breaker sheds ride the errorRate path exactly: fast 500,
+            # script skipped, nothing sent downstream, and — matching
+            # executable.go:132-143 — the caller does NOT fail
+            err_coin = (
+                shed_coin if err_coin is None else err_coin | shed_coin
+            )
 
         # ---- upward pass: outcomes + server-side durations ---------------
         # Processed deepest-first so every call site sees its callees'
@@ -2962,6 +3366,16 @@ class Simulator:
                     transportable = (
                         down_child is not None or lvl.finite_timeout
                     )
+                    # retry-budget gate (sim/policies.py): attempt >= 1
+                    # runs only when its budget coin admits it — a
+                    # suppressed retry surfaces the PREVIOUS attempt's
+                    # failure to the caller (Envoy budget semantics)
+                    retry_gate = None
+                    if retry_coin is not None and lvl.max_attempts > 1:
+                        retry_gate = (
+                            pad(retry_coin[:, csl].astype(jnp.float32))
+                            > 0
+                        )  # (N, C + 1); pad col False is dead (invalid)
                     dur_call = jnp.zeros((n, lvl.num_calls))
                     final_transport = (
                         jnp.zeros((n, lvl.num_calls), bool)
@@ -2975,6 +3389,8 @@ class Simulator:
                         idx = lvl.att_child[a]       # (K,) in [0, C]
                         valid = lvl.att_valid[a]     # (K,) static
                         use = used_a & valid
+                        if retry_gate is not None and a > 0:
+                            use = use & retry_gate[:, idx]
                         t = rtt_child[idx] + lat_child[:, idx]
                         if tax is not None:
                             t = t + 2.0 * tax[:, None]

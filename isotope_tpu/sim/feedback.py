@@ -132,6 +132,7 @@ class RetryFeedback:
         own_combo: np.ndarray,         # (Cc, H) churn-combo hop multipliers
         static_visits_pc: np.ndarray,  # (PC, S)
         mtls=None,                     # Optional[MtlsSchedule]
+        retry_budget=None,             # (has (S,), frac (S,), min (S,))
     ):
         self.compiled = compiled
         self.params = params
@@ -141,6 +142,21 @@ class RetryFeedback:
         self.own = np.asarray(own_combo, np.float64)
         self.static = np.asarray(static_visits_pc, np.float64)
         self.n_combos = self.own.shape[0]
+        # Envoy retry budgets (sim/policies.py): the static visit
+        # estimates must respect the budget cap or the wait tables
+        # overstate storm amplification the in-graph budget truncates.
+        # ``min_retries_concurrent`` enters the rate law as a
+        # per-second floor (stated approximation: the static estimate
+        # has no concurrency axis).
+        self.budget = None
+        if retry_budget is not None:
+            has, frac, floor = retry_budget
+            self.budget = (
+                np.asarray(has, bool),
+                np.asarray(frac, np.float64),
+                np.asarray(floor, np.float64),
+            )
+        self._retry_hop = compiled.hop_attempt > 0
 
         t = compiled.services
         self._err = t.error_rate.astype(np.float64)
@@ -278,6 +294,9 @@ class RetryFeedback:
         if down[compiled.hop_service[0]]:
             return visits  # down entry: nothing flows; the init is exact
 
+        # per-service retry admission probability (the static image of
+        # the engine's budget gate); 1 everywhere without budgets
+        allow = np.ones(S)
         for _ in range(iters):
             p_wait, wait_rate, _ = np_mmk(offered * visits, self.mu, eff)
             ew = np.where(down, 0.0, p_wait / wait_rate)
@@ -315,7 +334,22 @@ class RetryFeedback:
                     # transport-fails; otherwise a 500 (fast) never times
                     # out, so transport == timeout on the non-500 branch
                     p_transport = np.where(down[t], 1.0, (1.0 - pe) * pt)
-                    trunc = pf ** np.maximum(lc.attempts - 1, 0) * p_transport
+                    # budgeted continuation: attempt n+1 runs iff
+                    # attempt n failed AND the budget admits the retry
+                    # (q = pf * allow); a suppressed retry surfaces the
+                    # prior attempt's transport failure
+                    al = allow[t]
+                    q = pf * al
+                    a_m1 = np.maximum(lc.attempts - 1, 0)
+                    with np.errstate(divide="ignore", invalid="ignore"):
+                        geo_m1 = np.where(
+                            q >= 1.0 - 1e-12,
+                            a_m1.astype(np.float64),
+                            (1.0 - q**a_m1) / (1.0 - q),
+                        )
+                    trunc = p_transport * (
+                        (1.0 - al) * geo_m1 + q**a_m1
+                    )
                     send_eff = lc.send_prob * own[lc.first_child]
                     # expected call duration over serial attempts
                     d_ok = lc.rtt + m_child
@@ -326,9 +360,9 @@ class RetryFeedback:
                     d_att = np.where(down[t], 0.0, d_att)
                     with np.errstate(divide="ignore", invalid="ignore"):
                         geo = np.where(
-                            pf >= 1.0 - 1e-12,
+                            q >= 1.0 - 1e-12,
                             lc.attempts.astype(np.float64),
-                            (1.0 - pf ** lc.attempts) / (1.0 - pf),
+                            (1.0 - q ** lc.attempts) / (1.0 - q),
                         )
                     dur_call = send_eff * geo * d_att
                     seg = lc.parent_local * P + lc.step
@@ -343,7 +377,9 @@ class RetryFeedback:
                         ),
                         axis=1,
                     )
-                    lvl_pf[d] = pf
+                    # the reach recursion continues attempts at the
+                    # BUDGETED rate q, not raw pf
+                    lvl_pf[d] = q
                     lvl_surv[d], lvl_send[d] = surv, send_eff
                     step_dur = np.maximum(
                         lc.step_base, slot_max.reshape(L, P)
@@ -385,6 +421,25 @@ class RetryFeedback:
             new = np.bincount(
                 compiled.hop_service, weights=reach, minlength=S
             )
+            if self.budget is not None:
+                # close the budget loop: unsuppressed retry demand
+                # (observed / current allow) vs the budgeted headroom
+                # (budget% of active visits + the per-second floor)
+                has, frac, floor = self.budget
+                retry_v = np.bincount(
+                    compiled.hop_service,
+                    weights=reach * self._retry_hop,
+                    minlength=S,
+                )
+                demand = offered * retry_v / np.maximum(allow, 1e-9)
+                headroom = frac * offered * new + floor
+                allow_new = np.where(
+                    has & (demand > headroom),
+                    np.clip(headroom / np.maximum(demand, 1e-9),
+                            0.0, 1.0),
+                    1.0,
+                )
+                allow = 0.5 * allow + 0.5 * allow_new
             delta = np.abs(new - visits).max() / max(new.max(), 1e-12)
             visits = 0.5 * visits + 0.5 * new
             if delta < tol:

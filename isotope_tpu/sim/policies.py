@@ -1,0 +1,1096 @@
+"""In-graph resilience policies: the mesh-policy control plane co-sim.
+
+The reference system existed to benchmark *mesh resilience policy* —
+Envoy circuit breakers, retry policies, and autoscaled deployments under
+load — but the engine so far only simulated the unprotected failure
+modes (chaos kills, timeout cascades, the retry-storm fixed point of
+``sim/feedback.py``).  This module adds the in-graph mechanism that
+*reacts*: per-service policy state lives in the block ``lax.scan``
+carry, observes the PR-7 flight-recorder windows (metrics/timeline.py)
+held in the same carry, and actuates on the next block's physics:
+
+- **circuit breakers** (Envoy ``max_pending_requests`` /
+  ``max_connections``): when a service's observed mean queue depth or
+  in-flight concurrency overflows its caps, the overflow fraction of
+  arriving requests is SHED — a shed request takes the error path (fast
+  500, skips the script, sends nothing downstream) and *not the queue*
+  (zero wait draw), and the wait law's offered load is scaled by the
+  admitted fraction;
+- **outlier ejection**: a run of erroring windows totaling the
+  ``consecutive_errors`` threshold ejects one replica's capacity for a
+  baseline interval (``base_ejection_s``), shrinking the effective ``k``
+  of the M/M/k wait law, bounded by ``max_ejection_fraction``;
+- **retry budgets** (Envoy ``retry_budget``): observed retry arrivals
+  beyond ``budget_percent`` of active requests (plus
+  ``min_retries_concurrent``) truncate the attempt fan — attempts past
+  the first run only with the budgeted probability.  The same budget is
+  threaded into the ``sim/feedback.py`` offered-load fixed point so the
+  *static* visit estimates respect it too;
+- an **HPA-style autoscaler**: per-service replica counts react to the
+  per-window busy-share occupancy integral (busy seconds / (window x
+  replicas)) with a configurable sync period, scale-down stabilization
+  window, and per-sync scale-up/down step limits — capacity itself
+  becomes scan-carry state that composes with the chaos kill/timeout
+  phases (a kill trips breakers, trips budget caps, and the autoscaler
+  recovers the capacity).
+
+Control-loop discretization (stated envelope): the recorder OBSERVES at
+window granularity and the loop ACTUATES at block granularity — the
+state advanced through the windows completed by block ``b`` shapes
+block ``b+1``'s physics (one-block actuation lag, exactly the
+scrape-interval lag a real HPA/Envoy stack has).  All policy math is
+pure scan-carry arithmetic — elementwise f32 over (S,) state vectors —
+so the policy dynamics stay on the differentiable-planner path (DrJAX
+idiom, PAPERS.md) and shards merge bit-equal to the emulated twin.
+
+Everything is off by default: a Simulator built without policy tables
+traces byte-identical programs (pinned, like ``timeline=off``).
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, NamedTuple, Optional, Tuple
+
+import numpy as np
+
+from isotope_tpu.models.errors import config_path
+from isotope_tpu.models.pct import Percentage
+from isotope_tpu.utils import duration as dur
+
+
+# -- policy configuration (the topology YAML `policies:` block) -----------
+
+
+def _dur(value) -> float:
+    if isinstance(value, str):
+        return dur.parse_duration_seconds(value)
+    if isinstance(value, bool) or not isinstance(value, (int, float)):
+        raise ValueError(f"expected a duration: {value!r}")
+    return float(value)
+
+
+def _frac(value) -> float:
+    """A fraction in [0, 1]: a number, or a percent string ("60%")."""
+    return float(Percentage.decode(value))
+
+
+def _num(value) -> float:
+    if isinstance(value, bool) or not isinstance(value, (int, float)):
+        raise ValueError(f"expected a number: {value!r}")
+    return float(value)
+
+
+def _int(value) -> int:
+    if isinstance(value, bool) or not isinstance(value, int):
+        raise ValueError(f"expected an integer: {value!r}")
+    return value
+
+
+@dataclasses.dataclass(frozen=True)
+class CircuitBreakerPolicy:
+    """Envoy-style connection-pool caps + outlier ejection.
+
+    ``max_pending`` caps the observed mean QUEUED requests,
+    ``max_connections`` the observed mean in-flight concurrency; either
+    overflowing sheds the overflow fraction.  ``consecutive_errors``
+    (errors accumulated over a run of erroring windows) ejects one
+    replica for ``base_ejection_s`` seconds, up to
+    ``max_ejection_fraction`` of the current replicas.  ``None`` /
+    ``0`` disables the respective mechanism.
+    """
+
+    max_pending: Optional[float] = None
+    max_connections: Optional[float] = None
+    consecutive_errors: int = 0
+    base_ejection_s: float = 30.0
+    max_ejection_fraction: float = 0.5
+
+    _FIELDS = {
+        "max_pending", "max_connections", "consecutive_errors",
+        "base_ejection", "max_ejection_fraction",
+    }
+
+    @classmethod
+    def decode(cls, value: dict) -> "CircuitBreakerPolicy":
+        if not isinstance(value, dict):
+            raise ValueError(f"breaker must be a mapping: {value!r}")
+        unknown = set(value) - cls._FIELDS
+        if unknown:
+            raise ValueError(f"unknown breaker fields: {sorted(unknown)}")
+
+        def field(key, decode, fallback):
+            if key not in value or value[key] is None:
+                return fallback
+            with config_path(key):
+                return decode(value[key])
+
+        out = cls(
+            max_pending=field("max_pending", _num, None),
+            max_connections=field("max_connections", _num, None),
+            consecutive_errors=field("consecutive_errors", _int, 0),
+            base_ejection_s=field("base_ejection", _dur, 30.0),
+            max_ejection_fraction=field(
+                "max_ejection_fraction", _frac, 0.5
+            ),
+        )
+        for name in ("max_pending", "max_connections"):
+            v = getattr(out, name)
+            if v is not None and v <= 0:
+                with config_path(name):
+                    raise ValueError(f"{name} must be positive: {v!r}")
+        if out.consecutive_errors < 0:
+            with config_path("consecutive_errors"):
+                raise ValueError("consecutive_errors must be >= 0")
+        if out.base_ejection_s <= 0:
+            with config_path("base_ejection"):
+                raise ValueError("base_ejection must be positive")
+        return out
+
+
+@dataclasses.dataclass(frozen=True)
+class RetryBudgetPolicy:
+    """Envoy ``retry_budget``: concurrent retries may not exceed
+    ``budget_percent`` of active requests, with a
+    ``min_retries_concurrent`` floor so quiet services can still retry.
+    A budget of 0 suppresses all retries once any are observed."""
+
+    budget_percent: float = 0.2      # stored as a fraction in [0, 1]
+    min_retries_concurrent: float = 3.0
+
+    _FIELDS = {"budget_percent", "min_retries_concurrent"}
+
+    @classmethod
+    def decode(cls, value: dict) -> "RetryBudgetPolicy":
+        if not isinstance(value, dict):
+            raise ValueError(f"retry_budget must be a mapping: {value!r}")
+        unknown = set(value) - cls._FIELDS
+        if unknown:
+            raise ValueError(
+                f"unknown retry_budget fields: {sorted(unknown)}"
+            )
+
+        def field(key, decode, fallback):
+            if key not in value or value[key] is None:
+                return fallback
+            with config_path(key):
+                return decode(value[key])
+
+        out = cls(
+            budget_percent=field("budget_percent", _frac, 0.2),
+            min_retries_concurrent=field(
+                "min_retries_concurrent", _num, 3.0
+            ),
+        )
+        if out.min_retries_concurrent < 0:
+            with config_path("min_retries_concurrent"):
+                raise ValueError("min_retries_concurrent must be >= 0")
+        return out
+
+
+@dataclasses.dataclass(frozen=True)
+class AutoscalerPolicy:
+    """HPA-style per-service replica controller.
+
+    At each sync (every ``sync_period_s`` of sim time) the desired
+    count is ``ceil(current * utilization / target_utilization)``
+    (the HPA formula), clamped to ``[min_replicas, max_replicas]`` and
+    to at most ``scale_up_step`` up / ``scale_down_step`` down per
+    sync; a scale-DOWN additionally requires the desired count to have
+    sat below current continuously for ``stabilization_window_s``.
+    """
+
+    min_replicas: int = 1
+    max_replicas: int = 8
+    target_utilization: float = 0.6
+    sync_period_s: float = 15.0
+    stabilization_window_s: float = 60.0
+    scale_up_step: int = 4
+    scale_down_step: int = 1
+
+    _FIELDS = {
+        "min_replicas", "max_replicas", "target_utilization",
+        "sync_period", "stabilization_window", "scale_up_step",
+        "scale_down_step",
+    }
+
+    @classmethod
+    def decode(cls, value: dict) -> "AutoscalerPolicy":
+        if not isinstance(value, dict):
+            raise ValueError(f"autoscaler must be a mapping: {value!r}")
+        unknown = set(value) - cls._FIELDS
+        if unknown:
+            raise ValueError(
+                f"unknown autoscaler fields: {sorted(unknown)}"
+            )
+
+        def field(key, decode, fallback):
+            if key not in value or value[key] is None:
+                return fallback
+            with config_path(key):
+                return decode(value[key])
+
+        out = cls(
+            min_replicas=field("min_replicas", _int, 1),
+            max_replicas=field("max_replicas", _int, 8),
+            target_utilization=field("target_utilization", _frac, 0.6),
+            sync_period_s=field("sync_period", _dur, 15.0),
+            stabilization_window_s=field(
+                "stabilization_window", _dur, 60.0
+            ),
+            scale_up_step=field("scale_up_step", _int, 4),
+            scale_down_step=field("scale_down_step", _int, 1),
+        )
+        if out.min_replicas < 1:
+            with config_path("min_replicas"):
+                raise ValueError("min_replicas must be >= 1")
+        if out.target_utilization <= 0:
+            with config_path("target_utilization"):
+                raise ValueError("target_utilization must be positive")
+        if out.sync_period_s <= 0:
+            with config_path("sync_period"):
+                raise ValueError("sync_period must be positive")
+        if out.scale_up_step < 1 or out.scale_down_step < 1:
+            raise ValueError("scale steps must be >= 1")
+        return out
+
+
+@dataclasses.dataclass(frozen=True)
+class ServicePolicy:
+    """The resilience policies attached to one service (any subset)."""
+
+    breaker: Optional[CircuitBreakerPolicy] = None
+    retry_budget: Optional[RetryBudgetPolicy] = None
+    autoscaler: Optional[AutoscalerPolicy] = None
+
+    _FIELDS = {"breaker", "retry_budget", "autoscaler"}
+
+    @classmethod
+    def decode(
+        cls, value: dict, default: "ServicePolicy"
+    ) -> "ServicePolicy":
+        """Decode one service's entry; ``default`` seeds each policy
+        block, an explicit ``null`` disables it for this service."""
+        if value is None:
+            value = {}
+        if not isinstance(value, dict):
+            raise ValueError(f"service policy must be a mapping: {value!r}")
+        unknown = set(value) - cls._FIELDS
+        if unknown:
+            raise ValueError(f"unknown policy fields: {sorted(unknown)}")
+
+        def block(key, decode, fallback):
+            if key not in value:
+                return fallback
+            if value[key] is None:
+                return None  # explicit null disables the default
+            with config_path(key):
+                return decode(value[key])
+
+        return cls(
+            breaker=block(
+                "breaker", CircuitBreakerPolicy.decode, default.breaker
+            ),
+            retry_budget=block(
+                "retry_budget", RetryBudgetPolicy.decode,
+                default.retry_budget,
+            ),
+            autoscaler=block(
+                "autoscaler", AutoscalerPolicy.decode, default.autoscaler
+            ),
+        )
+
+
+@dataclasses.dataclass(frozen=True)
+class PolicySet:
+    """The decoded ``policies:`` block of a topology YAML.
+
+    Schema::
+
+        policies:
+          defaults:               # applies to EVERY service
+            retry_budget: {budget_percent: 20%}
+          worker:                 # per-service overrides (block-wise)
+            breaker: {max_pending: 8, consecutive_errors: 5}
+            autoscaler: {min_replicas: 2, max_replicas: 16}
+          frontend:
+            retry_budget: null    # explicit null disables the default
+
+    ``defaults`` seeds every service; a per-service entry replaces the
+    named policy blocks wholesale (an explicit ``null`` disables one).
+    """
+
+    per_service: Dict[str, ServicePolicy]
+    defaults: ServicePolicy
+
+    @classmethod
+    def decode(cls, raw: dict, service_names) -> "PolicySet":
+        if not isinstance(raw, dict):
+            raise ValueError(f"policies must be a mapping: {raw!r}")
+        names = list(service_names)
+        with config_path("policies"):
+            with config_path("defaults"):
+                default = ServicePolicy.decode(
+                    raw.get("defaults") or {}, ServicePolicy()
+                )
+            per: Dict[str, ServicePolicy] = {}
+            for key, value in raw.items():
+                if key == "defaults":
+                    continue
+                if key not in names:
+                    raise ValueError(
+                        f"policies target unknown service {key!r}"
+                    )
+                with config_path(key):
+                    per[key] = ServicePolicy.decode(value, default)
+        return cls(per_service=per, defaults=default)
+
+    def for_service(self, name: str) -> ServicePolicy:
+        return self.per_service.get(name, self.defaults)
+
+    @property
+    def empty(self) -> bool:
+        pols = list(self.per_service.values()) + [self.defaults]
+        return all(
+            p.breaker is None
+            and p.retry_budget is None
+            and p.autoscaler is None
+            for p in pols
+        )
+
+
+# -- dense per-service tables (compiled by compiler/compile.py) -----------
+
+
+_INF = float("inf")
+
+
+@dataclasses.dataclass(frozen=True)
+class PolicyTables:
+    """The ``policies:`` block lowered to dense per-service arrays in
+    compiled service order — the device-constant form the engine's
+    policy scan consumes.  Sentinels: ``inf`` caps / thresholds disable
+    the respective mechanism for a service."""
+
+    names: Tuple[str, ...]
+    static_replicas: np.ndarray       # (S,) i64 — topology numReplicas
+    # breaker
+    max_pending: np.ndarray           # (S,) f64, inf = uncapped
+    max_connections: np.ndarray       # (S,) f64, inf = uncapped
+    consecutive_errors: np.ndarray    # (S,) f64, inf = ejection off
+    base_ejection_s: np.ndarray       # (S,) f64
+    max_eject_frac: np.ndarray        # (S,) f64
+    # retry budget
+    has_budget: np.ndarray            # (S,) bool
+    budget_frac: np.ndarray           # (S,) f64
+    budget_min: np.ndarray            # (S,) f64
+    # autoscaler
+    has_hpa: np.ndarray               # (S,) bool
+    min_replicas: np.ndarray          # (S,) f64
+    max_replicas: np.ndarray          # (S,) f64
+    target_util: np.ndarray           # (S,) f64
+    sync_period_s: np.ndarray         # (S,) f64
+    stabilization_s: np.ndarray       # (S,) f64
+    up_step: np.ndarray               # (S,) f64
+    down_step: np.ndarray             # (S,) f64
+
+    @property
+    def num_services(self) -> int:
+        return len(self.names)
+
+    @property
+    def any_breaker(self) -> bool:
+        return bool(
+            np.isfinite(self.max_pending).any()
+            or np.isfinite(self.max_connections).any()
+        )
+
+    @property
+    def any_ejection(self) -> bool:
+        return bool(np.isfinite(self.consecutive_errors).any())
+
+    @property
+    def any_budget(self) -> bool:
+        return bool(self.has_budget.any())
+
+    @property
+    def any_hpa(self) -> bool:
+        return bool(self.has_hpa.any())
+
+    @property
+    def k_max(self) -> int:
+        """The widest station the dynamic wait law can reach (sets the
+        Erlang recursion length next to the static replica max)."""
+        k = int(self.static_replicas.max(initial=1))
+        if self.any_hpa:
+            k = max(k, int(self.max_replicas[self.has_hpa].max()))
+        return k
+
+    def signature(self) -> str:
+        """Stable identity for executable-cache keys."""
+        fields = dataclasses.fields(self)
+        parts = [f"{self.names!r}"]
+        for f in fields[1:]:
+            parts.append(np.asarray(getattr(self, f.name)).tobytes().hex())
+        return "policies:" + "|".join(parts)
+
+
+def build_tables(pols: PolicySet, services) -> PolicyTables:
+    """Lower a decoded PolicySet against a compiled ServiceTable."""
+    names = tuple(services.names)
+    S = len(names)
+
+    def arr(fill):
+        return np.full(S, fill, np.float64)
+
+    static = np.asarray(services.replicas, np.int64)
+    max_pending = arr(_INF)
+    max_conns = arr(_INF)
+    consec = arr(_INF)
+    eject_s = arr(30.0)
+    eject_frac = arr(0.5)
+    has_budget = np.zeros(S, bool)
+    budget = arr(0.0)
+    budget_min = arr(0.0)
+    has_hpa = np.zeros(S, bool)
+    min_r = static.astype(np.float64)
+    max_r = static.astype(np.float64)
+    target = arr(0.6)
+    sync_s = arr(15.0)
+    stab_s = arr(60.0)
+    up_step = arr(1.0)
+    down_step = arr(1.0)
+    for s, name in enumerate(names):
+        p = pols.for_service(name)
+        if p.autoscaler is not None and (
+            p.autoscaler.min_replicas > p.autoscaler.max_replicas
+        ):
+            # vet reports this as VET-T011; compiling without vet must
+            # still fail loudly instead of clipping into an empty range
+            raise ValueError(
+                f"policies.{name}.autoscaler: min_replicas="
+                f"{p.autoscaler.min_replicas} > max_replicas="
+                f"{p.autoscaler.max_replicas}"
+            )
+        if p.breaker is not None:
+            b = p.breaker
+            if b.max_pending is not None:
+                max_pending[s] = b.max_pending
+            if b.max_connections is not None:
+                max_conns[s] = b.max_connections
+            if b.consecutive_errors > 0:
+                consec[s] = float(b.consecutive_errors)
+            eject_s[s] = b.base_ejection_s
+            eject_frac[s] = b.max_ejection_fraction
+        if p.retry_budget is not None:
+            has_budget[s] = True
+            budget[s] = p.retry_budget.budget_percent
+            budget_min[s] = p.retry_budget.min_retries_concurrent
+        if p.autoscaler is not None:
+            a = p.autoscaler
+            has_hpa[s] = True
+            min_r[s] = float(a.min_replicas)
+            max_r[s] = float(a.max_replicas)
+            target[s] = a.target_utilization
+            sync_s[s] = a.sync_period_s
+            stab_s[s] = a.stabilization_window_s
+            up_step[s] = float(a.scale_up_step)
+            down_step[s] = float(a.scale_down_step)
+    return PolicyTables(
+        names=names,
+        static_replicas=static,
+        max_pending=max_pending,
+        max_connections=max_conns,
+        consecutive_errors=consec,
+        base_ejection_s=eject_s,
+        max_eject_frac=eject_frac,
+        has_budget=has_budget,
+        budget_frac=budget,
+        budget_min=budget_min,
+        has_hpa=has_hpa,
+        min_replicas=min_r,
+        max_replicas=max_r,
+        target_util=target,
+        sync_period_s=sync_s,
+        stabilization_s=stab_s,
+        up_step=up_step,
+        down_step=down_step,
+    )
+
+
+def lint_policies(
+    raw: dict, service_names
+) -> Tuple[Optional[PolicySet], List[Tuple[str, str]]]:
+    """Decode a raw ``policies:`` block tolerantly for the vet linter.
+
+    Returns ``(PolicySet | None, [(rule_hint, message), ...])`` — decode
+    errors become findings instead of crashes (``rule_hint`` is
+    ``"decode"``; semantic rules are checked by the caller against the
+    decoded set).
+    """
+    try:
+        return PolicySet.decode(raw, service_names), []
+    except ValueError as e:
+        return None, [("decode", str(e))]
+
+
+# -- device-side state / control law --------------------------------------
+#
+# Everything below is jax-traced inside the engine's block scan; imports
+# stay lazy-free because policies.py is imported by host-only paths
+# (topo_lint) — jax imports live inside the functions' module-level
+# import below, which every engine caller already has.
+
+import jax  # noqa: E402  (host-only callers above never trace)
+import jax.numpy as jnp  # noqa: E402
+
+
+class DeviceTables(NamedTuple):
+    """PolicyTables uploaded as f32 device constants."""
+
+    static_replicas: jax.Array    # (S,)
+    max_pending: jax.Array        # (S,) inf = uncapped
+    max_connections: jax.Array    # (S,)
+    consecutive_errors: jax.Array  # (S,) inf = off
+    base_ejection_s: jax.Array
+    max_eject_frac: jax.Array
+    has_budget: jax.Array         # (S,) bool
+    budget_frac: jax.Array
+    budget_min: jax.Array
+    has_hpa: jax.Array            # (S,) bool
+    min_replicas: jax.Array
+    max_replicas: jax.Array
+    target_util: jax.Array
+    sync_period_s: jax.Array
+    stabilization_s: jax.Array
+    up_step: jax.Array
+    down_step: jax.Array
+
+
+def device_tables(t: PolicyTables) -> DeviceTables:
+    f = lambda x: jnp.asarray(x, jnp.float32)  # noqa: E731
+    return DeviceTables(
+        static_replicas=f(t.static_replicas),
+        max_pending=f(t.max_pending),
+        max_connections=f(t.max_connections),
+        consecutive_errors=f(t.consecutive_errors),
+        base_ejection_s=f(t.base_ejection_s),
+        max_eject_frac=f(t.max_eject_frac),
+        has_budget=jnp.asarray(t.has_budget),
+        budget_frac=f(t.budget_frac),
+        budget_min=f(t.budget_min),
+        has_hpa=jnp.asarray(t.has_hpa),
+        min_replicas=f(t.min_replicas),
+        max_replicas=f(t.max_replicas),
+        target_util=f(t.target_util),
+        sync_period_s=f(t.sync_period_s),
+        stabilization_s=f(t.stabilization_s),
+        up_step=f(t.up_step),
+        down_step=f(t.down_step),
+    )
+
+
+class PolicyState(NamedTuple):
+    """Per-service control state riding the block-scan carry."""
+
+    replicas: jax.Array       # (S,) f32 — autoscaler's actuated count
+    ejected: jax.Array        # (S,) f32 — replicas currently ejected
+    eject_timer_s: jax.Array  # (S,) f32 — sim seconds until return
+    err_streak: jax.Array     # (S,) f32 — errors over consecutive
+    #                           erroring windows (ejection trigger)
+    shed: jax.Array           # (S,) f32 in [0,1] — breaker shed frac
+    was_open: jax.Array       # (S,) bool — breaker ever tripped
+    retry_allow: jax.Array    # (S,) f32 in [0,1] — budgeted retry prob
+    down_streak_s: jax.Array  # (S,) f32 — time desired < current
+    next_sync_s: jax.Array    # (S,) f32 — next autoscaler sync time
+    last_window: jax.Array    # scalar i32 — last processed window
+    trips: jax.Array          # (S,) f32 — breaker open transitions
+    ejections: jax.Array      # (S,) f32 — ejection events
+    scale_events: jax.Array   # (S,) f32 — autoscaler actuations
+
+
+class PolicyFx(NamedTuple):
+    """The policy state's effect on one block's physics (traced)."""
+
+    replicas: jax.Array      # (S,) f32 — effective replica count >= 1
+    shed: jax.Array          # (S,) f32 — admission-shed probability
+    retry_allow: jax.Array   # (S,) f32 — attempt>=1 survival prob
+
+
+class PolicySummary(NamedTuple):
+    """Per-window actuation series + event counters, reduced on device.
+
+    Series hold the state in effect at each window's END (after that
+    window's control update); unprocessed windows are zero with
+    ``windows_done`` 0.  Replicated across shards (every shard computes
+    the identical control trajectory from the psum-merged signals), so
+    the sharded merge TAKES it rather than summing — like
+    ``window_s``."""
+
+    window_s: jax.Array       # scalar f32
+    replicas: jax.Array       # (S, W) f32 — actuated replicas
+    effective: jax.Array      # (S, W) f32 — replicas minus ejected
+    shed: jax.Array           # (S, W) f32
+    retry_allow: jax.Array    # (S, W) f32
+    ejected: jax.Array        # (S, W) f32
+    breaker_open: jax.Array   # (S, W) f32 (0/1)
+    windows_done: jax.Array   # (W,) f32 (0/1)
+    trips: jax.Array          # (S,) f32
+    ejections: jax.Array      # (S,) f32
+    scale_events: jax.Array   # (S,) f32
+
+    @property
+    def num_windows(self) -> int:
+        return int(np.asarray(self.windows_done).shape[0])
+
+
+def init_state(
+    dt: DeviceTables, lag_periods: int = 0
+) -> PolicyState:
+    """The scan carry's initial policy state.
+
+    ``lag_periods`` delays the autoscaler's FIRST sync by that many
+    sync periods — the ``policies.autoscaler_lag`` chaos site (the
+    control loop missing N syncs at the worst time: startup)."""
+    S = dt.static_replicas.shape[0]
+    z = jnp.zeros(S, jnp.float32)
+    replicas0 = jnp.where(
+        dt.has_hpa,
+        jnp.clip(dt.static_replicas, dt.min_replicas, dt.max_replicas),
+        dt.static_replicas,
+    )
+    return PolicyState(
+        replicas=replicas0,
+        ejected=z,
+        eject_timer_s=z,
+        err_streak=z,
+        shed=z,
+        was_open=jnp.zeros(S, bool),
+        retry_allow=jnp.ones(S, jnp.float32),
+        down_streak_s=z,
+        next_sync_s=dt.sync_period_s * jnp.float32(1 + lag_periods),
+        last_window=jnp.int32(-1),
+        trips=z,
+        ejections=z,
+        scale_events=z,
+    )
+
+
+def effects(state: PolicyState) -> PolicyFx:
+    """What the NEXT block's physics sees: integer-actuated replicas
+    minus ejected capacity (floored at 1 server), the breaker's shed
+    probability, and the budgeted retry survival probability."""
+    eff = jnp.maximum(
+        jnp.round(state.replicas) - jnp.round(state.ejected), 1.0
+    )
+    return PolicyFx(
+        replicas=eff,
+        shed=state.shed,
+        retry_allow=state.retry_allow,
+    )
+
+
+def zeros_summary(spec, num_services: int) -> PolicySummary:
+    W = spec.num_windows
+    S = num_services
+    return PolicySummary(
+        window_s=jnp.float32(spec.window_s),
+        replicas=jnp.zeros((S, W)),
+        effective=jnp.zeros((S, W)),
+        shed=jnp.zeros((S, W)),
+        retry_allow=jnp.zeros((S, W)),
+        ejected=jnp.zeros((S, W)),
+        breaker_open=jnp.zeros((S, W)),
+        windows_done=jnp.zeros(W),
+        trips=jnp.zeros(S),
+        ejections=jnp.zeros(S),
+        scale_events=jnp.zeros(S),
+    )
+
+
+def observe_block(res, spec, retry_hop_mask: jax.Array) -> jax.Array:
+    """(S, W) executed RETRY hops (attempt >= 1) of one block, binned
+    by hop start — the budget law's numerator, an observation channel
+    the flight recorder doesn't carry.  Additive across blocks/shards
+    exactly like the recorder's series."""
+    from isotope_tpu.metrics import timeline as timeline_mod
+
+    T = spec.num_windows * spec.window_s
+    s_c = jnp.clip(res.hop_start, 0.0, T)
+    retry_f = (res.hop_sent & retry_hop_mask[None, :]).astype(jnp.float32)
+    pref = timeline_mod._service_boundary_prefixes(spec, s_c, (retry_f,))
+    return pref[:, 1:, 0] - pref[:, :-1, 0]
+
+
+def advance(
+    state: PolicyState,
+    dt_tables: DeviceTables,
+    tl_acc,                  # TimelineSummary accumulator (global sums)
+    retry_acc: jax.Array,    # (S, W) retry-arrival accumulator (global)
+    t_complete: jax.Array,   # scalar f32 — sim time reached by EVERY
+    #                          shard (windows ending before it are final)
+    spec,                    # timeline.TimelineSpec
+    stuck_breaker: bool = False,
+    downed_w: Optional[jax.Array] = None,  # (S, W) chaos-downed count
+) -> Tuple[PolicyState, PolicySummary]:
+    """Advance the control loop through every newly COMPLETED window.
+
+    Runs an inner ``lax.scan`` over the static window axis; windows at
+    indices ``(state.last_window, floor(t_complete / dt))`` apply the
+    control law in order, the rest pass state through unchanged.
+    Every block pays the full W-window sweep (mostly masked dead), but
+    the recorder's planner caps W at ``timeline_max_windows`` (256),
+    so the O(W x S) law is noise next to a block's (N x H) physics.  The
+    returned PolicySummary delta holds the per-window actuation series
+    for exactly the windows processed this call (summed into the outer
+    accumulator by the engine scan).
+
+    ``stuck_breaker`` is the ``policies.stuck_breaker`` chaos site: a
+    tripped breaker never closes (its shed fraction only ratchets up).
+    """
+    dtw = jnp.float32(spec.window_s)
+    W = spec.num_windows
+    arr_w = tl_acc.svc_arrivals.astype(jnp.float32)       # (S, W)
+    err_w = tl_acc.svc_errors.astype(jnp.float32)
+    busy_w = tl_acc.svc_busy_s
+    infl_w = tl_acc.svc_inflight_s
+    done_below = jnp.floor(t_complete / dtw).astype(jnp.int32)
+
+    def win_body(st: PolicyState, w):
+        live = (w > st.last_window) & (w < done_below)
+        arr = arr_w[:, w]
+        err = err_w[:, w]
+        queue = jnp.maximum(infl_w[:, w] - busy_w[:, w], 0.0) / dtw
+        conc = infl_w[:, w] / dtw
+        retries = retry_acc[:, w]
+
+        # -- outlier ejection: errors over consecutive erroring windows.
+        # A SHEDDING breaker holds the streak instead of accumulating:
+        # shed requests take the error path, so counting them would
+        # self-reinforce (shed -> eject -> less capacity -> more shed);
+        # Envoy's overflow 503s are likewise not outlier-detection
+        # events.  Real 500s while not shedding still accumulate.
+        shedding = st.shed > 0.0
+        streak = jnp.where(
+            shedding,
+            st.err_streak,
+            jnp.where(err > 0, st.err_streak + err, 0.0),
+        )
+        current = jnp.maximum(jnp.round(st.replicas), 1.0)
+        can_eject = (
+            jnp.isfinite(dt_tables.consecutive_errors)
+            & ~shedding
+            & (streak >= dt_tables.consecutive_errors)
+            & (st.ejected + 1.0
+               <= jnp.floor(dt_tables.max_eject_frac * current) + 1e-6)
+        )
+        ejected = st.ejected + jnp.where(can_eject, 1.0, 0.0)
+        timer = jnp.where(
+            can_eject,
+            dt_tables.base_ejection_s,
+            jnp.maximum(st.eject_timer_s - dtw, 0.0),
+        )
+        # baseline interval over: every ejected replica returns
+        restored = (timer <= 0.0) & (ejected > 0.0)
+        ejected = jnp.where(restored, 0.0, ejected)
+        streak = jnp.where(can_eject, 0.0, streak)
+
+        # -- circuit breaker: shed the overflow past either cap.
+        # The observed queue/concurrency already ran at the current
+        # shed fraction — divide the admitted observation back out
+        # (the same demand reconstruction as the retry budget below)
+        # or the law flaps 0 <-> overflow every window instead of
+        # settling at 1 - cap/demand.  The shed ceiling of 0.98 keeps
+        # the reconstruction well-conditioned (denominator >= 0.02)
+        # and matches Envoy, which sheds the excess, never everything.
+        admit = jnp.maximum(1.0 - st.shed, 0.02)
+        over = jnp.maximum(
+            queue / (admit * dt_tables.max_pending),
+            conc / (admit * dt_tables.max_connections),
+        )
+        open_now = over > 1.0
+        shed_target = jnp.where(
+            open_now, jnp.clip(1.0 - 1.0 / jnp.maximum(over, 1.0),
+                               0.0, 0.98), 0.0
+        )
+        if stuck_breaker:
+            # chaos: a tripped breaker never closes — the shed
+            # fraction only ratchets upward
+            shed_new = jnp.maximum(shed_target, st.shed)
+        else:
+            shed_new = shed_target
+        # a TRIP is a closed -> open transition (shed was 0): a
+        # breaker that recovers and re-trips on a second chaos phase
+        # counts again
+        trips = st.trips + jnp.where(
+            open_now & (st.shed <= 0.0), 1.0, 0.0
+        )
+        was_open = st.was_open | open_now
+
+        # -- retry budget: allow = headroom / UNSUPPRESSED demand -----
+        # The observed retries already ran at the current allow, so
+        # the demand estimate divides it back out — comparing the raw
+        # observation to the headroom would snap allow back to 1 the
+        # window after it throttled (bang-bang at ~2x the budget);
+        # with the reconstruction, steady demand D > H settles at
+        # allow = H/D (the same correction the static mirror in
+        # sim/feedback.py applies).
+        headroom = dt_tables.budget_frac * arr + dt_tables.budget_min
+        demand = retries / jnp.maximum(st.retry_allow, 1e-3)
+        allow = jnp.where(
+            dt_tables.has_budget & (demand > headroom),
+            jnp.clip(headroom / jnp.maximum(demand, 1e-6), 0.0, 1.0),
+            1.0,
+        )
+
+        # -- autoscaler: HPA formula at sync boundaries ---------------
+        # Utilization averages over the ALIVE capacity (actuated count
+        # minus ejections minus the chaos phase's down delta) — the
+        # ready-pod averaging a real HPA does.  Dividing by the
+        # actuated count would make a killed service look idle and
+        # scale it DOWN mid-outage.
+        w_end = (w.astype(jnp.float32) + 1.0) * dtw
+        down_now = (
+            downed_w[:, w]
+            if downed_w is not None
+            else jnp.float32(0.0)
+        )
+        alive_raw = current - jnp.round(st.ejected) - down_now
+        alive = jnp.maximum(alive_raw, 1.0)
+        util = busy_w[:, w] / (dtw * alive)
+        desired = jnp.clip(
+            jnp.ceil(current * util / dt_tables.target_util),
+            dt_tables.min_replicas,
+            dt_tables.max_replicas,
+        )
+        # NO READY PODS report metrics during a full kill: a real HPA
+        # skips the scale decision entirely — hold the count, the
+        # stabilization streak, and the sync clock until capacity
+        # returns (the first window after recovery syncs immediately)
+        no_pods = alive_raw < 0.5
+        down_streak = jnp.where(
+            no_pods,
+            st.down_streak_s,
+            jnp.where(desired < current, st.down_streak_s + dtw, 0.0),
+        )
+        do_sync = (
+            dt_tables.has_hpa & (w_end >= st.next_sync_s) & ~no_pods
+        )
+        scale_up = do_sync & (desired > current)
+        scale_down = (
+            do_sync
+            & (desired < current)
+            & (down_streak >= dt_tables.stabilization_s)
+        )
+        new_count = jnp.where(
+            scale_up,
+            jnp.minimum(desired, current + dt_tables.up_step),
+            jnp.where(
+                scale_down,
+                jnp.maximum(desired, current - dt_tables.down_step),
+                st.replicas,
+            ),
+        )
+        next_sync = jnp.where(
+            do_sync, st.next_sync_s + dt_tables.sync_period_s,
+            st.next_sync_s,
+        )
+        scale_events = st.scale_events + jnp.where(
+            scale_up | scale_down, 1.0, 0.0
+        )
+
+        def pick(new, old):
+            return jnp.where(live, new, old)
+
+        nxt = PolicyState(
+            replicas=pick(new_count, st.replicas),
+            ejected=pick(ejected, st.ejected),
+            eject_timer_s=pick(timer, st.eject_timer_s),
+            err_streak=pick(streak, st.err_streak),
+            shed=pick(shed_new, st.shed),
+            was_open=jnp.where(live, was_open, st.was_open),
+            retry_allow=pick(allow, st.retry_allow),
+            down_streak_s=pick(down_streak, st.down_streak_s),
+            next_sync_s=pick(next_sync, st.next_sync_s),
+            last_window=jnp.where(live, w, st.last_window),
+            trips=pick(trips, st.trips),
+            ejections=pick(
+                st.ejections + jnp.where(can_eject, 1.0, 0.0),
+                st.ejections,
+            ),
+            scale_events=pick(scale_events, st.scale_events),
+        )
+        fx = effects(nxt)
+        live_f = live.astype(jnp.float32)
+        ys = (
+            live_f * nxt.replicas,
+            live_f * fx.replicas,
+            live_f * nxt.shed,
+            live_f * nxt.retry_allow,
+            live_f * nxt.ejected,
+            live_f * (nxt.shed > 0.0),
+            live_f,
+        )
+        return nxt, ys
+
+    final, ys = jax.lax.scan(
+        win_body, state, jnp.arange(W, dtype=jnp.int32)
+    )
+    (reps, eff, shed, allow, ejected, open_w, done) = ys
+    delta = PolicySummary(
+        window_s=jnp.float32(spec.window_s),
+        replicas=reps.T,
+        effective=eff.T,
+        shed=shed.T,
+        retry_allow=allow.T,
+        ejected=ejected.T,
+        breaker_open=open_w.T,
+        windows_done=done[:, 0] if done.ndim > 1 else done,
+        trips=final.trips - state.trips,
+        ejections=final.ejections - state.ejections,
+        scale_events=final.scale_events - state.scale_events,
+    )
+    return final, delta
+
+
+def accumulate_summary(
+    acc: PolicySummary, delta: PolicySummary
+) -> PolicySummary:
+    """Fold one block's per-window delta into the carried summary
+    (each window is processed exactly once, so sums reconstruct the
+    full series)."""
+    out = jax.tree.map(
+        jnp.add,
+        acc._replace(window_s=jnp.float32(0.0)),
+        delta._replace(window_s=jnp.float32(0.0)),
+    )
+    return out._replace(window_s=acc.window_s)
+
+
+# -- host-side reporting ---------------------------------------------------
+
+
+def _np(x) -> np.ndarray:
+    return np.asarray(x, np.float64)
+
+
+def to_doc(
+    compiled, pol: PolicySummary, tables: PolicyTables
+) -> dict:
+    """The ``policies.json`` artifact (``isotope-policies/v1``):
+    per-service actuation series plus sim-time ONSETS — the first
+    breaker trip, first scale event, and recovery (shed back to 0)
+    windows — so a chaos phase's breaker-trip -> budget-cap ->
+    autoscaler-recovery cascade reads directly off the document."""
+    names = compiled.services.names
+    dt = float(pol.window_s)
+    done = _np(pol.windows_done) > 0
+    reps = _np(pol.replicas)
+    eff = _np(pol.effective)
+    shed = _np(pol.shed)
+    allow = _np(pol.retry_allow)
+    ejected = _np(pol.ejected)
+    open_w = _np(pol.breaker_open)
+    trips = _np(pol.trips)
+    ejections = _np(pol.ejections)
+    scale_events = _np(pol.scale_events)
+    W = pol.num_windows
+    # processed windows form a prefix of the grid; the series are
+    # truncated to it — beyond ``windows_done`` the state was never
+    # advanced (zero-filled on device), which would read as replicas=0
+    # / allow=0
+    k = int(done.sum())
+
+    def onset(mask_row) -> Optional[float]:
+        idx = np.nonzero(mask_row & done)[0]
+        return round(float(idx[0]) * dt, 6) if len(idx) else None
+
+    services: Dict[str, dict] = {}
+    for s, name in enumerate(names):
+        protected = (
+            np.isfinite(tables.max_pending[s])
+            or np.isfinite(tables.max_connections[s])
+            or np.isfinite(tables.consecutive_errors[s])
+            or bool(tables.has_budget[s])
+            or bool(tables.has_hpa[s])
+        )
+        if not protected:
+            continue
+        trip_t = onset(open_w[s] > 0)
+        recover_t = None
+        if trip_t is not None:
+            after = (np.arange(W) * dt > trip_t) & done
+            closed = after & (shed[s] <= 0)
+            idx = np.nonzero(closed)[0]
+            recover_t = (
+                round(float(idx[0]) * dt, 6) if len(idx) else None
+            )
+        services[name] = {
+            "replicas": [round(float(v), 3) for v in reps[s][:k]],
+            "effective_replicas": [
+                round(float(v), 3) for v in eff[s][:k]
+            ],
+            "shed": [round(float(v), 6) for v in shed[s][:k]],
+            "retry_allow": [
+                round(float(v), 6) for v in allow[s][:k]
+            ],
+            "ejected": [round(float(v), 3) for v in ejected[s][:k]],
+            "breaker_trips": float(trips[s]),
+            "ejections": float(ejections[s]),
+            "scale_events": float(scale_events[s]),
+            "breaker_trip_onset_s": trip_t,
+            "breaker_recovery_s": recover_t,
+            # baseline = the INITIAL actuated count (init_state), not
+            # the first window's post-update value — a scale landing
+            # in window 0 is still an onset
+            "first_scale_onset_s": onset(
+                np.abs(
+                    reps[s]
+                    - (
+                        float(np.clip(
+                            tables.static_replicas[s],
+                            tables.min_replicas[s],
+                            tables.max_replicas[s],
+                        ))
+                        if tables.has_hpa[s]
+                        else float(tables.static_replicas[s])
+                    )
+                ) > 1e-6
+            ),
+            "peak_replicas": float(reps[s].max(initial=0.0)),
+        }
+    return {
+        "schema": "isotope-policies/v1",
+        "window_s": dt,
+        "num_windows": W,
+        "windows_done": int(done.sum()),
+        "services": services,
+    }
+
+
+def format_table(doc: dict) -> str:
+    """Human-readable policy actuation table (CLI stderr rendering)."""
+    from isotope_tpu.metrics.timeline import sparkline
+
+    lines = [
+        f"policies: {doc['windows_done']}/{doc['num_windows']} windows "
+        f"x {doc['window_s']:g}s"
+    ]
+    for name, svc in doc.get("services", {}).items():
+        bits = [f"{name:<20} replicas {sparkline(svc['replicas'])}"]
+        if svc["breaker_trips"]:
+            bits.append(
+                f"trips {svc['breaker_trips']:.0f}"
+                + (f" @{svc['breaker_trip_onset_s']:g}s"
+                   if svc["breaker_trip_onset_s"] is not None else "")
+            )
+        if svc["ejections"]:
+            bits.append(f"ejections {svc['ejections']:.0f}")
+        if svc["scale_events"]:
+            bits.append(
+                f"scales {svc['scale_events']:.0f} "
+                f"peak {svc['peak_replicas']:.0f}"
+            )
+        if any(a < 1.0 for a in svc["retry_allow"]):
+            bits.append("budget-capped")
+        lines.append("  ".join(bits))
+    return "\n".join(lines)

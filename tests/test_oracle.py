@@ -483,12 +483,22 @@ def test_closed_loop_saturated_heavy_tails(service_time, param, tol_p50,
 
 def test_closed_loop_saturated_fork_join_throughput():
     # fork-join saturated throughput: self-consistent fixed point lands
-    # within 8% of the oracle (r4 measured: tree13 +6.3%, star9 +5.2%)
+    # within 8% of the oracle (r4 measured: tree13 +6.3%, star9 +5.2%).
+    # ASYMMETRIC band (the star9 p50/p99 discipline, ADVICE r5): the
+    # engine is uniformly FAST here — star9's convoy idleness slows the
+    # oracle, not the engine — so the slow side pins tight at -3% to
+    # catch any regression below the oracle while the fast side guards
+    # the documented +5-6% edge from widening past +8%.
     load = LoadModel(kind="closed", qps=None, connections=64)
     for yaml_text in (TREE13, STAR9):
         res_e, res_o = both(yaml_text, load, 64_000, 256_000)
         thr_o = len(res_o.client_latency) / float(res_o.client_end.max())
-        assert float(res_e.offered_qps) == pytest.approx(thr_o, rel=0.08)
+        rel = float(res_e.offered_qps) / thr_o - 1.0
+        assert -0.03 <= rel <= 0.08, (
+            f"saturated throughput: engine={float(res_e.offered_qps):.1f} "
+            f"oracle={thr_o:.1f} err={rel * 100:+.2f}% outside "
+            f"[-3%, +8%]"
+        )
 
 
 RETRY_STORM = """
