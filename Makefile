@@ -8,8 +8,9 @@ DURATION ?= 120s
 
 .PHONY: test lint vet-smoke bench telemetry-smoke resilience-smoke \
 	attribution-smoke sparse-smoke timeline-smoke multihost-smoke \
-	policies-smoke examples canonical tree star multitier \
-	auxiliary-services star-auxiliary latency cpu_mem dot clean
+	policies-smoke rollout-smoke examples canonical tree star \
+	multitier auxiliary-services star-auxiliary latency cpu_mem dot \
+	clean
 
 test:
 	$(PY) -m pytest tests/ -x -q
@@ -175,6 +176,14 @@ multihost-smoke:
 # series must recover the killed capacity.
 policies-smoke:
 	$(PY) tools/policies_smoke.py
+
+# progressive-delivery end-to-end check (sim/rollout.py): a seeded bad
+# canary must roll back inside its first bake window, its traffic
+# exposure and error burn must stay strictly below the open-loop
+# `churn`-equivalent twin's, and the 4-shard sharded trajectory must
+# be bit-equal to the emulated twin.
+rollout-smoke:
+	$(PY) tools/rollout_smoke.py
 
 examples:
 	$(PY) tools/gen_examples.py

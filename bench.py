@@ -67,6 +67,7 @@ CASE_ORDER = [
     "closed64",
     "svc1000",
     "realistic50",
+    "rollout50",
     "svc10k",
     "star10k",
     "svc100k_chaos",
@@ -82,7 +83,7 @@ CASE_TIMEOUT_OVERRIDES = {"svc10k_cfg3_10M": 3000}
 
 
 def _rate(sim, load, num_requests, block_size, *, warm=3, iters=3,
-          trials=5):
+          trials=5, runner=None):
     """Steady-state hop-events/s of run_summary on the current device.
 
     Returns (median, rel_spread, best, first_s, warmup_windows) over
@@ -146,6 +147,11 @@ def _rate(sim, load, num_requests, block_size, *, warm=3, iters=3,
             else contextlib.nullcontext()
         )
         with ctx:
+            if runner is not None:
+                # protected co-sim cases (e.g. run_rollouts) time the
+                # control loop's program, not the plain summary path
+                return runner(sim, load, num_requests, k,
+                              serving["block"])
             return sim.run_summary(
                 load, num_requests, k, block_size=serving["block"]
             )
@@ -386,6 +392,47 @@ def run_case(name: str) -> dict:
         )
         b = sim.default_block_size()
         med, spread, best, first_s = measure(sim, open_load, b * 4, b)
+    elif name == "rollout50":
+        # reactive canary co-sim (sim/rollout.py): realistic50 with a
+        # mid-graph service on a step schedule, windows served by
+        # run_rollouts — the case exists for GATE COVERAGE of the
+        # rollout-enabled program: its telemetry block carries
+        # degraded_to like every other case (bench_regress's
+        # previously-clean-case gate), and the `<case>_rollout` marker
+        # records that the rollout controller, not the plain summary
+        # path, produced the number
+        doc = realistic_topology(50, archetype="multitier", seed=0)
+        canary_svc = doc["services"][1]["name"]
+        doc["rollouts"] = {
+            canary_svc: {
+                "steps": ["5%", "25%", "100%"],
+                "bake": "2s",
+                "gates": {"min_samples": 50},
+            }
+        }
+        g = ServiceGraph.decode(doc)
+        compiled = compile_graph(g)
+        from isotope_tpu.compiler import compile_rollouts
+
+        rtables = compile_rollouts(g, compiled)
+        sim = Simulator(compiled, SimParams(timeline=True),
+                        rollouts=rtables)
+
+        def roll_runner(s_, l_, n_, k_, b_):
+            return s_.run_rollouts(
+                l_, n_, k_, block_size=b_, window_s=1.0
+            )[0]
+
+        # half the plain-case request budget: the protected program
+        # sweeps two M/M/k stations per service and carries the
+        # controller state, so its windows cost ~2x run_summary's —
+        # the case exists for coverage, not the headline
+        b = sim.default_block_size()
+        med, spread, best, first_s = measure(
+            sim, open_load, b * 2, b, warm=2, iters=2,
+            runner=roll_runner,
+        )
+        out[f"{name}_rollout"] = 1
     elif name == "svc10k":
         sim = Simulator(
             compile_graph(

@@ -182,6 +182,7 @@ def lint_graph(
                     ))
 
     findings.extend(_lint_policies(graph, params))
+    findings.extend(_lint_rollouts(graph, params))
     return findings
 
 
@@ -267,6 +268,88 @@ def _lint_policies(graph: ServiceGraph, params) -> List[Finding]:
                 "retries > 0: every retry will be suppressed once any "
                 "are observed (drop the retries or raise the budget)",
                 path=f"policies.{name}.retry_budget",
+            ))
+    return findings
+
+
+def _lint_rollouts(graph: ServiceGraph, params) -> List[Finding]:
+    """Progressive-delivery misconfiguration rules (VET-T015..T018)
+    over the topology's ``rollouts:`` block (sim/rollout.py).
+
+    VET-T017 (min-samples reachability) needs an offered rate, so it
+    lives in :func:`lint_config`; the load-free rules here are:
+    VET-T015 a step schedule that is not strictly increasing or does
+    not end at 100% (the rollout can thrash between equal weights, or
+    "finishes" while still splitting traffic) — and, as an error, a
+    rollouts block that does not decode at all; VET-T016 a bake time
+    shorter than the recorder window (a step can promote before the
+    controller ever observes a completed window of it); VET-T018
+    canary overrides on a service with no step schedule (the canary
+    physics never actuate).
+    """
+    if not getattr(graph, "rollouts", None):
+        return []
+    # lazy: keeps the no-rollouts lint path jax-free
+    from isotope_tpu.sim import rollout as rollout_mod
+
+    findings: List[Finding] = []
+    names = [s.name for s in graph.services]
+    rset, problems = rollout_mod.lint_rollouts(graph.rollouts, names)
+    for _, msg in problems:
+        findings.append(Finding(
+            "VET-T015", SEV_ERROR,
+            f"rollouts block does not decode: {msg}",
+            path="rollouts",
+        ))
+    if rset is None:
+        return findings
+    if params is None:
+        from isotope_tpu.sim.config import SimParams
+
+        params = SimParams()
+    for name in names:
+        r = rset.for_service(name)
+        raw = (
+            graph.rollouts.get(name)
+            if isinstance(graph.rollouts, dict) else None
+        )
+        if not r.active:
+            if isinstance(raw, dict) and raw.get("canary"):
+                findings.append(Finding(
+                    "VET-T018", SEV_WARN,
+                    f"canary overrides on {name!r} but no step "
+                    "schedule: the rollout never actuates (declare "
+                    "`steps:` or drop the `canary:` block)",
+                    path=f"rollouts.{name}.canary",
+                ))
+            continue
+        steps = r.steps
+        if any(b <= a for a, b in zip(steps, steps[1:])):
+            findings.append(Finding(
+                "VET-T015", SEV_WARN,
+                f"step schedule {[f'{w:.0%}' for w in steps]} on "
+                f"{name!r} is not strictly increasing: a promotion "
+                "that does not raise the canary weight re-bakes the "
+                "same split and gains nothing",
+                path=f"rollouts.{name}.steps",
+            ))
+        if steps[-1] < 1.0:
+            findings.append(Finding(
+                "VET-T015", SEV_WARN,
+                f"step schedule on {name!r} ends at {steps[-1]:.0%}, "
+                "not 100%: the rollout finishes DONE while still "
+                "splitting traffic between two deployments forever",
+                path=f"rollouts.{name}.steps",
+            ))
+        if r.bake_s < params.timeline_window_s:
+            findings.append(Finding(
+                "VET-T016", SEV_WARN,
+                f"bake {r.bake_s:g}s on {name!r} is shorter than the "
+                f"recorder window {params.timeline_window_s:g}s: a "
+                "step can promote before the controller observes a "
+                "single completed window of it (widen bake or narrow "
+                "--timeline)",
+                path=f"rollouts.{name}.bake",
             ))
     return findings
 
@@ -520,7 +603,53 @@ def lint_config(config) -> Tuple[List[Finding], Dict[str, object]]:
             findings.extend(
                 _lint_breaker_capacity(g, compiled, params, config.qps)
             )
+            findings.extend(
+                _lint_rollout_samples(g, compiled, config.qps)
+            )
     return findings, graphs
+
+
+def _lint_rollout_samples(graph, compiled, qps_grid) -> List[Finding]:
+    """VET-T017: a gate whose ``min_samples`` cannot accumulate on the
+    canary arm within one bake at a configured offered rate — the
+    controller HOLDS forever (or near enough that the schedule never
+    finishes inside the run).  The canary arm's sample rate at a step
+    of weight ``w`` is ``qps x expected_visits x w``, so the binding
+    step is the first (smallest) one."""
+    if not getattr(graph, "rollouts", None):
+        return []
+    from isotope_tpu.sim import rollout as rollout_mod
+
+    rset, _ = rollout_mod.lint_rollouts(
+        graph.rollouts, [s.name for s in graph.services]
+    )
+    if rset is None:
+        return []
+    findings: List[Finding] = []
+    visits = compiled.expected_visits()
+    name_idx = {n: i for i, n in enumerate(compiled.services.names)}
+    for name, r in rset.per_service.items():
+        if not r.active or name not in name_idx:
+            continue
+        w0 = r.steps[0]
+        per_visit = visits[name_idx[name]]
+        for q in qps_grid:
+            if q is None:
+                continue
+            expected = q * per_visit * w0 * r.bake_s
+            if expected < r.gates.min_samples:
+                findings.append(Finding(
+                    "VET-T017", SEV_WARN,
+                    f"gate min_samples={r.gates.min_samples:g} on "
+                    f"{name!r} is unreachable within one bake at "
+                    f"{q:g} qps: step 0 ({w0:.0%}) collects only "
+                    f"~{expected:.0f} canary samples per "
+                    f"{r.bake_s:g}s bake — the controller holds "
+                    "indefinitely (lower min_samples, raise the "
+                    "first step, or lengthen bake)",
+                    path=f"rollouts.{name}.gates.min_samples",
+                ))
+    return findings
 
 
 def _lint_breaker_capacity(

@@ -91,6 +91,20 @@ def _add_policies_args(parser) -> None:
              "actuation series lands next to the windowed series)")
 
 
+def _add_rollouts_args(parser) -> None:
+    """The progressive-delivery co-sim knobs (sim/rollout.py), shared
+    by simulate and sweep."""
+    parser.add_argument(
+        "--rollouts", action="store_true",
+        help="co-simulate the topology's `rollouts:` block (reactive "
+             "canary rollouts: per-service baseline/canary traffic "
+             "splits advanced window-by-window — PROMOTE on passing "
+             "SLO gates, HOLD while samples are short, ROLL BACK on a "
+             "gate trip) inside the block scan: the MAIN run becomes "
+             "the progressively-delivered system (implies --timeline; "
+             "composes with --policies in the same carry)")
+
+
 def _add_mesh_args(parser) -> None:
     """The mesh-layout knobs (parallel/mesh.py + parallel/layout.py),
     shared by simulate and sweep."""
@@ -209,6 +223,12 @@ def register(sub) -> None:
     s.add_argument("--policies-out", metavar="FILE", default=None,
                    help="write the policy actuation series as JSON "
                         "(isotope-policies/v1)")
+    _add_rollouts_args(s)
+    s.add_argument("--rollouts-out", metavar="FILE", default=None,
+                   help="write the rollout trajectory (weight/step "
+                        "series, promote/hold/rollback sim-time "
+                        "onsets, per-arm error shares) as JSON "
+                        "(isotope-rollout/v1)")
     s.add_argument("--timeline-out", metavar="FILE", default=None,
                    help="write the windowed series as JSON "
                         "(isotope-timeline/v1)")
@@ -274,6 +294,7 @@ def register(sub) -> None:
     _add_attribution_args(w)
     _add_timeline_args(w)
     _add_policies_args(w)
+    _add_rollouts_args(w)
     _add_mesh_args(w)
     _add_resilience_args(w)
     _add_vet_arg(w)
@@ -356,6 +377,7 @@ def run_simulate(args) -> int:
         attribution=args.attribution is not None,
         timeline=tl_window is not None,
         policies=args.policies,
+        rollouts=args.rollouts,
         mesh_spec=args.mesh,
         overlap=args.overlap,
         **extra,
@@ -397,7 +419,22 @@ def run_simulate(args) -> int:
             "policies block (unprotected run)",
             file=sys.stderr,
         )
-    if (tl_window is not None or args.policies) \
+    if args.rollouts and result.rollouts is not None:
+        from isotope_tpu.sim import rollout as rollout_mod
+
+        print(rollout_mod.format_table(result.rollouts),
+              file=sys.stderr)
+        if args.rollouts_out:
+            with open(args.rollouts_out, "w") as f:
+                json.dump(result.rollouts, f, indent=2)
+            print(f"rollouts -> {args.rollouts_out}", file=sys.stderr)
+    elif args.rollouts:
+        print(
+            "warning: --rollouts set but the topology declares no "
+            "active rollouts block (open-loop run)",
+            file=sys.stderr,
+        )
+    if (tl_window is not None or args.policies or args.rollouts) \
             and result.timeline is not None:
         _write_timeline_artifacts(args, result)
     elif tl_window is not None:
@@ -627,6 +664,8 @@ def run_sweep(args) -> int:
         config = dataclasses.replace(config, overlap=True)
     if args.policies and not config.policies:
         config = dataclasses.replace(config, policies=True)
+    if args.rollouts and not config.rollouts:
+        config = dataclasses.replace(config, rollouts=True)
     tl_window = _timeline_window(args)
     if tl_window is None and config.timeline:
         # [sim] timeline = true in the TOML arms the pass without a

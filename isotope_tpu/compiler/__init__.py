@@ -31,6 +31,7 @@ from isotope_tpu.compiler.compile import (
     NoEntrypointError,
     compile_graph,
     compile_policies,
+    compile_rollouts,
 )
 
 __all__ = [
@@ -45,6 +46,7 @@ __all__ = [
     "NoEntrypointError",
     "compile_graph",
     "compile_policies",
+    "compile_rollouts",
     "enable_persistent_cache",
     "executable_cache",
     "persistent_cache_dir",

@@ -146,6 +146,30 @@ def compile_policies(graph: ServiceGraph, compiled: CompiledGraph):
     return tables
 
 
+def compile_rollouts(graph: ServiceGraph, compiled: CompiledGraph):
+    """Lower a topology's ``rollouts:`` block to dense per-service
+    tables in COMPILED service order (sim/rollout.RolloutTables) — the
+    device-constant form the engine's in-scan rollout controller
+    consumes.
+
+    Returns ``None`` when the graph declares no active rollout (the
+    engine's byte-identical default path).  Decode errors carry key
+    paths (``rollouts.worker.steps[2]: ...``).
+    """
+    if not getattr(graph, "rollouts", None):
+        return None
+    from isotope_tpu.sim import rollout as rollout_mod
+
+    rset = rollout_mod.RolloutSet.decode(
+        graph.rollouts, compiled.services.names
+    )
+    if rset.empty:
+        return None
+    tables = rollout_mod.build_tables(rset, compiled.services)
+    telemetry.counter_inc("rollouts_compiled")
+    return tables
+
+
 def compile_graph(
     graph: ServiceGraph,
     entry: Optional[str] = None,

@@ -93,6 +93,20 @@ class ExemplarBatch(NamedTuple):
     hop_start: jax.Array   # (K, H)
 
 
+def empty_exemplars(k: int, num_hops: int) -> "ExemplarBatch":
+    """The scan-carry seed batch every attributed entry point starts
+    from: latency = -inf so any real request displaces a seed row."""
+    return ExemplarBatch(
+        latency=jnp.full((k,), -jnp.inf),
+        start=jnp.zeros((k,)),
+        error=jnp.zeros((k,), bool),
+        hop_sent=jnp.zeros((k, num_hops), bool),
+        hop_error=jnp.zeros((k, num_hops), bool),
+        hop_latency=jnp.zeros((k, num_hops)),
+        hop_start=jnp.zeros((k, num_hops)),
+    )
+
+
 class AttributionSummary(NamedTuple):
     """Device-reduced critical-path blame for one run.
 

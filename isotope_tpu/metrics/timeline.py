@@ -221,6 +221,32 @@ def _service_boundary_prefixes(
     return jnp.pad(jnp.cumsum(bins, axis=1), ((0, 0), (1, 0), (0, 0)))
 
 
+def versioned_service_windows(
+    spec: TimelineSpec,
+    t: jax.Array,            # (N, H) f32 — clamped event times, [0, T]
+    version: jax.Array,      # (N, H) bool — per-hop version coin
+    vals: Sequence[jax.Array],  # V arrays (N, H) f32 to window-sum
+) -> jax.Array:
+    """(S, 2, W, V) per-service, per-VERSION window sums of one time
+    family — the recorder's (S, W) observation channel extended along a
+    two-arm deployment axis (axis 1: 0 = baseline, 1 = canary).
+
+    The per-version split rides the SAME boundary-prefix machinery as
+    every other series (one `_service_boundary_prefixes` call over 2V
+    masked channels), so both lowering regimes apply unchanged and the
+    result is additive across blocks and shards exactly like the
+    recorder's series — the property the rollout controller's psum
+    merge (sim/rollout.py) relies on.
+    """
+    ver = version.astype(jnp.float32)
+    base = 1.0 - ver
+    masked = [v * base for v in vals] + [v * ver for v in vals]
+    pref = _service_boundary_prefixes(spec, t, masked)  # (S, W+1, 2V)
+    diff = pref[:, 1:, :] - pref[:, :-1, :]             # (S, W, 2V)
+    V = len(vals)
+    return jnp.stack([diff[..., :V], diff[..., V:]], axis=1)
+
+
 def timeline_block(
     res, spec: TimelineSpec, packed: bool = False
 ) -> TimelineSummary:

@@ -65,6 +65,11 @@ class ServiceGraph:
     # tables (compiler/compile.py compile_policies) with key-pathed
     # validation errors.
     policies: dict = dataclasses.field(default_factory=dict)
+    # Raw ``rollouts:`` block (reactive canary rollouts — per-service
+    # step schedules, SLO gates, rollback policies, canary physics
+    # overrides; sim/rollout.py).  Same raw-until-compiled discipline
+    # as ``policies`` (compiler/compile.py compile_rollouts).
+    rollouts: dict = dataclasses.field(default_factory=dict)
 
     # -- decode ------------------------------------------------------------
 
@@ -89,10 +94,17 @@ class ServiceGraph:
                 raise ValueError(
                     f"policies must be a mapping: {raw_policies!r}"
                 )
+        raw_rollouts = doc.get("rollouts") or {}
+        if not isinstance(raw_rollouts, dict):
+            with config_path("rollouts"):
+                raise ValueError(
+                    f"rollouts must be a mapping: {raw_rollouts!r}"
+                )
         graph = cls(
             services=services,
             defaults=dict(raw_defaults),
             policies=dict(raw_policies),
+            rollouts=dict(raw_rollouts),
         )
         graph.validate()
         return graph
@@ -116,6 +128,8 @@ class ServiceGraph:
         out["services"] = [s.encode(default_service) for s in self.services]
         if self.policies:
             out["policies"] = dict(self.policies)
+        if self.rollouts:
+            out["rollouts"] = dict(self.rollouts)
         return out
 
     def to_yaml(self) -> str:

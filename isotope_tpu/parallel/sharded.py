@@ -108,6 +108,7 @@ class ShardedSimulator:
         churn=(),
         mtls=None,
         policies=None,  # Optional[sim.policies.PolicyTables]
+        rollouts=None,  # Optional[sim.rollout.RolloutTables]
     ):
         self.compiled = compiled
         self.mesh = mesh
@@ -121,7 +122,7 @@ class ShardedSimulator:
         # compiles in the system, so wire the disk cache here too
         enable_persistent_cache()
         self.sim = Simulator(compiled, params, chaos, churn, mtls=mtls,
-                             policies=policies)
+                             policies=policies, rollouts=rollouts)
         self.collector = MetricsCollector(compiled)
         if SVC_AXIS not in mesh.axis_names:
             raise ValueError(
@@ -767,15 +768,7 @@ class ShardedSimulator:
         k0 = min(top_k, block) if top_k > 0 else 0
         H = self.compiled.num_hops
         ex0 = (
-            attribution.ExemplarBatch(
-                latency=jnp.full((k0,), -jnp.inf),
-                start=jnp.zeros((k0,)),
-                error=jnp.zeros((k0,), bool),
-                hop_sent=jnp.zeros((k0, H), bool),
-                hop_error=jnp.zeros((k0, H), bool),
-                hop_latency=jnp.zeros((k0, H)),
-                hop_start=jnp.zeros((k0, H)),
-            )
+            attribution.empty_exemplars(k0, H)
             if k0 > 0
             else None
         )
@@ -1142,7 +1135,7 @@ class ShardedSimulator:
             ),
         )
 
-    # -- resilience-policy co-sim (sim/policies.py) ---------------------
+    # -- protected co-sim runs (sim/policies.py + sim/rollout.py) -------
 
     def _require_policies(self, load: LoadModel) -> None:
         if self.sim._policies is None:
@@ -1150,19 +1143,31 @@ class ShardedSimulator:
                 "policy runs need compiled policy tables "
                 "(ShardedSimulator(..., policies=...))"
             )
+        self._require_protected(load, "policy", "run_policies")
+
+    def _require_rollouts(self, load: LoadModel) -> None:
+        if self.sim._rollouts is None:
+            raise ValueError(
+                "rollout runs need compiled rollout tables "
+                "(ShardedSimulator(..., rollouts=...))"
+            )
+        self._require_protected(load, "rollout", "run_rollouts")
+
+    def _require_protected(self, load: LoadModel, what: str,
+                           method: str) -> None:
         if not self.sim.params.timeline:
             raise ValueError(
-                "policy runs need SimParams(timeline=True)"
+                f"{what} runs need SimParams(timeline=True)"
             )
         if self.sim._saturated(load):
             raise ValueError(
-                "policy runs do not support saturated -qps max loads "
+                f"{what} runs do not support saturated -qps max loads "
                 "(static finite-population tables; see "
-                "Simulator.run_policies)"
+                f"Simulator.{method})"
             )
         if self.n_svc != 1:
             raise ValueError(
-                "policy runs need a mesh with svc=1: the per-service "
+                f"{what} runs need a mesh with svc=1: the per-service "
                 "control state is replicated across shards (every "
                 "shard advances the identical trajectory from the "
                 "psum-merged window signals), which a svc-sharded "
@@ -1178,6 +1183,9 @@ class ShardedSimulator:
         block_size: int = 65_536,
         trim: bool = False,
         window_s=None,
+        attribution: bool = False,
+        tail: bool = False,
+        tail_cut=None,
     ):
         """Sharded twin of :meth:`Simulator.run_policies`: every shard
         scans its blocks under the SHARED policy state — each block's
@@ -1187,26 +1195,50 @@ class ShardedSimulator:
         shard actuates the identical trajectory.  Returns
         ``(RunSummary, TimelineSummary, PolicySummary)``; the
         timeline/policy outputs are replicated (already globally
-        merged) and bit-equal to :meth:`run_policies_emulated`."""
+        merged) and bit-equal to :meth:`run_policies_emulated`.
+
+        ``attribution=True`` ALSO reduces the PR-5 critical-path blame
+        over the protected physics inside the same scan: the O(H) /
+        O(S x buckets) blame accumulators merge with ``psum`` and the
+        top-K exemplar batch with ``all_gather`` + ``top_k`` (the
+        :meth:`run_attributed` collectives), appending an
+        ``AttributionSummary`` to the return."""
         self._require_policies(load)
         self._require_mesh("run_policies")
-        plan = self._plan_run(load, num_requests, key, offered_qps,
-                              block_size, trim)
-        tl_plan = self._timeline_plan(plan, window_s)
-        telemetry.counter_inc("sharded_policy_runs")
-        faults.check("policies.stuck_breaker")
-        faults.check("policies.autoscaler_lag")
-        fn = self._get_pol(plan, tl_plan)
-        vis, windows = self._args_put(plan)
-        faults.check("sharded.compute")
-        out = fn(
-            key, jnp.float32(plan.offered), jnp.float32(plan.gap),
-            jnp.float32(plan.nominal_gap),
-            jnp.float32(plan.window[0]), jnp.float32(plan.window[1]),
-            vis, windows,
+        return self._protected_run(
+            "policy", False, load, num_requests, key, offered_qps,
+            block_size, trim, window_s, attribution, tail, tail_cut,
         )
-        faults.check("sharded.gather")
-        return out
+
+    def run_rollouts(
+        self,
+        load: LoadModel,
+        num_requests: int,
+        key: jax.Array,
+        offered_qps=None,
+        block_size: int = 65_536,
+        trim: bool = False,
+        window_s=None,
+        attribution: bool = False,
+        tail: bool = False,
+        tail_cut=None,
+    ):
+        """Sharded twin of :meth:`Simulator.run_rollouts`: every shard
+        routes its hops through the SHARED rollout state's canary
+        weights, the per-version (S, 2, W, 4) observation channel
+        psum-merges across the mesh inside the scan, and every shard
+        advances the identical promote/hold/rollback trajectory —
+        bit-equal to :meth:`run_rollouts_emulated` (pinned).  Returns
+        ``(RunSummary, TimelineSummary, RolloutSummary)``, appending a
+        ``PolicySummary`` when policy tables are also compiled (the
+        PR 9 loops ride the same carry) and an ``AttributionSummary``
+        under ``attribution=True``."""
+        self._require_rollouts(load)
+        self._require_mesh("run_rollouts")
+        return self._protected_run(
+            "rollout", True, load, num_requests, key, offered_qps,
+            block_size, trim, window_s, attribution, tail, tail_cut,
+        )
 
     def run_policies_emulated(
         self,
@@ -1217,6 +1249,9 @@ class ShardedSimulator:
         block_size: int = 65_536,
         trim: bool = False,
         window_s=None,
+        attribution: bool = False,
+        tail: bool = False,
+        tail_cut=None,
     ):
         """The policy mesh program replayed on one device: unlike the
         other ``*_emulated`` twins (whole-scan per shard), the policy
@@ -1226,53 +1261,172 @@ class ShardedSimulator:
         merges their recorder contributions sequentially (the CPU
         psum's association order — ICI shards within a slice first,
         slices last), and advances the shared state once.  Bit-equal
-        to :meth:`run_policies` on CPU (pinned)."""
+        to :meth:`run_policies` on CPU (pinned); with
+        ``attribution=True`` the per-shard blame stacks merge on host
+        (``attribution.merge_host``) after the scan."""
         self._require_policies(load)
+        return self._protected_emulated(
+            "policy", False, load, num_requests, key, offered_qps,
+            block_size, trim, window_s, attribution, tail, tail_cut,
+        )
+
+    def run_rollouts_emulated(
+        self,
+        load: LoadModel,
+        num_requests: int,
+        key: jax.Array,
+        offered_qps=None,
+        block_size: int = 65_536,
+        trim: bool = False,
+        window_s=None,
+        attribution: bool = False,
+        tail: bool = False,
+        tail_cut=None,
+    ):
+        """The rollout mesh program replayed on one device (the
+        :meth:`run_policies_emulated` per-block coupling, extended
+        with the per-version observation channel) — the equivalence
+        reference / degradation rung for :meth:`run_rollouts`."""
+        self._require_rollouts(load)
+        return self._protected_emulated(
+            "rollout", True, load, num_requests, key, offered_qps,
+            block_size, trim, window_s, attribution, tail, tail_cut,
+        )
+
+    def _protected_prologue(self, what, load, num_requests, key,
+                            offered_qps, block_size, trim, window_s,
+                            attribution, tail, tail_cut, counter):
+        """Shared device/emulated-twin setup for a protected run:
+        validates the attribution precondition, estimates the tail
+        cut, plans the run/timeline, and arms the policy fault sites.
+        Returns ``(plan, tl_plan, attr, tail_cut)``.  One body for
+        both paths so the pinned bit-equality contract cannot be
+        diverged by a fix applied to only one of them."""
+        if attribution and not self.sim.params.attribution:
+            raise ValueError(
+                f"attributed {what} runs need SimParams("
+                "attribution=True)"
+            )
+        if attribution and tail and tail_cut is None:
+            tail_cut = self.sim.estimate_tail_cut(
+                load, num_requests, key, block_size=block_size
+            )
         plan = self._plan_run(load, num_requests, key, offered_qps,
                               block_size, trim)
         tl_plan = self._timeline_plan(plan, window_s)
-        telemetry.counter_inc("sharded_policy_emulated_runs")
-        faults.check("policies.stuck_breaker")
-        faults.check("policies.autoscaler_lag")
-        fn = self._get_local_pol_fn(plan, tl_plan)
+        telemetry.counter_inc(counter)
+        if self.sim._policies is not None:
+            faults.check("policies.stuck_breaker")
+            faults.check("policies.autoscaler_lag")
+        if attribution:
+            # eager: constants created inside the shard_map trace
+            # would be cached as tracers and leak
+            self.sim._attribution_tables()
+        attr = ("tail" if tail else "mean") if attribution else None
+        return plan, tl_plan, attr, tail_cut
+
+    def _protected_run(self, what: str, roll: bool, load, num_requests,
+                       key, offered_qps, block_size, trim, window_s,
+                       attribution, tail, tail_cut):
+        plan, tl_plan, attr, tail_cut = self._protected_prologue(
+            what, load, num_requests, key, offered_qps, block_size,
+            trim, window_s, attribution, tail, tail_cut,
+            f"sharded_{what}_runs",
+        )
+        fn = self._get_prot(plan, tl_plan, attr, roll)
+        vis, windows = self._args_put(plan)
+        faults.check("sharded.compute")
+        out = fn(
+            key, jnp.float32(plan.offered), jnp.float32(plan.gap),
+            jnp.float32(plan.nominal_gap),
+            jnp.float32(plan.window[0]), jnp.float32(plan.window[1]),
+            jnp.float32(
+                tail_cut
+                if (attribution and tail_cut is not None)
+                else np.inf
+            ),
+            vis, windows,
+        )
+        faults.check("sharded.gather")
+        return out
+
+    def _protected_emulated(self, what: str, roll: bool, load,
+                            num_requests, key, offered_qps, block_size,
+                            trim, window_s, attribution, tail,
+                            tail_cut):
+        plan, tl_plan, attr, tail_cut = self._protected_prologue(
+            what, load, num_requests, key, offered_qps, block_size,
+            trim, window_s, attribution, tail, tail_cut,
+            f"sharded_{what}_emulated_runs",
+        )
+        fn = self._get_local_prot_fn(plan, tl_plan, attr, roll)
         vis, windows = self._args_put(plan)
         with telemetry.phase("sharded.emulated"):
-            shard_summaries, tl, pol = fn(
+            out = fn(
                 key, jnp.float32(plan.offered), jnp.float32(plan.gap),
                 jnp.float32(plan.nominal_gap),
                 jnp.float32(plan.window[0]),
                 jnp.float32(plan.window[1]),
+                jnp.float32(
+                    tail_cut
+                    if (attribution and tail_cut is not None)
+                    else np.inf
+                ),
                 vis, windows,
             )
-            jax.block_until_ready(tl.count)
-        return (
-            self._merge_shard_summaries(list(shard_summaries)),
-            tl,
-            pol,
-        )
+            jax.block_until_ready(out[1].count)
+        shard_summaries, rest = out[0], list(out[1:])
+        merged = [self._merge_shard_summaries(list(shard_summaries))]
+        merged.append(rest.pop(0))  # timeline (host-side global)
+        if roll:
+            merged.append(rest.pop(0))
+        if self.sim._policies is not None:
+            merged.append(rest.pop(0))
+        if attr is not None:
+            from isotope_tpu.metrics import attribution as attr_mod
 
-    def _policy_block_ctx(self, tl_plan: Tuple[int, float]):
-        """Static policy-scan context shared by the shard_map body and
-        the emulated twin (identical traced control program)."""
+            merged.append(attr_mod.merge_host(list(rest.pop(0))))
+        return tuple(merged)
+
+    def _prot_block_ctx(self, tl_plan: Tuple[int, float], roll: bool):
+        """Static protected-scan context shared by the shard_map body
+        and the emulated twin (identical traced control program)."""
         from isotope_tpu.metrics import timeline as timeline_mod
-        from isotope_tpu.sim import policies as policies_mod
 
         spec = timeline_mod.build_spec(
             self.compiled, tl_plan[0], tl_plan[1]
         )
-        return dict(
+        ctx = dict(
             spec=spec,
-            dtab=policies_mod.device_tables(self.sim._policies),
-            downed_w=self.sim._policy_downed_windows(spec),
-            stuck=faults.stuck_breaker(),
-            lag=faults.autoscaler_lag(),
-            retry_mask=jnp.asarray(self.compiled.hop_attempt > 0),
             packed=self.sim.params.packed_carries,
-            pol_mod=policies_mod,
             tl_mod=timeline_mod,
+            with_pol=self.sim._policies is not None,
+            pol_mod=None,
+            roll_mod=None,
         )
+        if ctx["with_pol"]:
+            from isotope_tpu.sim import policies as policies_mod
 
-    def _pol_body(
+            ctx.update(
+                pol_mod=policies_mod,
+                dtab=policies_mod.device_tables(self.sim._policies),
+                downed_w=self.sim._policy_downed_windows(
+                    spec, base_split=roll
+                ),
+                stuck=faults.stuck_breaker(),
+                lag=faults.autoscaler_lag(),
+                retry_mask=jnp.asarray(self.compiled.hop_attempt > 0),
+            )
+        if roll:
+            from isotope_tpu.sim import rollout as rollout_mod
+
+            ctx.update(
+                roll_mod=rollout_mod,
+                rdtab=rollout_mod.device_tables(self.sim._rollouts),
+            )
+        return ctx
+
+    def _prot_body(
         self,
         block: int,
         num_blocks: int,
@@ -1280,17 +1434,22 @@ class ShardedSimulator:
         conns_local: int,
         trim: bool,
         tl_plan: Tuple[int, float],
+        attr,
+        roll: bool,
         key: jax.Array,
         offered_qps: jax.Array,
         pace_gap: jax.Array,
         nominal_gap: jax.Array,
         win_lo: jax.Array,
         win_hi: jax.Array,
+        tail_cut: jax.Array,
         visits_pc: jax.Array,
         phase_windows: jax.Array,
     ):
-        ctx = self._policy_block_ctx(tl_plan)
-        spec, pol_mod, tl_mod = ctx["spec"], ctx["pol_mod"], ctx["tl_mod"]
+        ctx = self._prot_block_ctx(tl_plan, roll)
+        spec, tl_mod = ctx["spec"], ctx["tl_mod"]
+        pol_mod, roll_mod = ctx["pol_mod"], ctx["roll_mod"]
+        with_pol = ctx["with_pol"]
         both = tuple(self.mesh.axis_names)
         shard = jnp.int32(0)
         for a in self.mesh.axis_names:
@@ -1300,11 +1459,17 @@ class ShardedSimulator:
         per = block // c
         S = self.compiled.num_services
         W = spec.num_windows
+        if attr is not None:
+            from isotope_tpu.metrics import attribution
+
+            atables = self.sim._attribution_tables()
+            top_k = self.sim.params.attribution_top_k
 
         def block_body(carry, b):
-            ((t0, conn_t0, req_off), tl_acc, obs_acc,
-             pstate, pol_acc) = carry
-            fx = pol_mod.effects(pstate)
+            ((t0, conn_t0, req_off), tl_acc, pobs_acc, pstate,
+             pol_acc, robs_acc, rstate, roll_acc, ex) = carry
+            pfx = pol_mod.effects(pstate) if with_pol else None
+            rfx = roll_mod.effects(rstate) if roll else None
             kb = jax.random.fold_in(local_key, 1_000_000 + b)
             res, t_end, conn_end = self.sim._simulate_core(
                 block, kind, conns_local, kb, offered_qps, pace_gap,
@@ -1312,28 +1477,35 @@ class ShardedSimulator:
                 req_off,
                 visits_pc=visits_pc,
                 phase_windows=phase_windows,
-                policy_fx=fx,
+                policy_fx=pfx,
+                rollout_fx=rfx,
             )
             s = summarize(
                 res, self.collector,
                 window=(win_lo, win_hi) if trim else None,
             )
-            # the control loop consumes GLOBAL window signals: each
-            # block's recorder contribution psums across the mesh
-            # before the (replicated) state advance — the collective
-            # the emulated twin replays in shard order
+            # the control loops consume GLOBAL window signals: each
+            # block's recorder contribution (and the policy/rollout
+            # observation channels) psums across the mesh before the
+            # (replicated) state advances — the collectives the
+            # emulated twin replays in shard order
             tl_blk = tl_mod.timeline_block(res, spec,
                                            packed=ctx["packed"])
             tl_blk = jax.tree.map(
                 lambda x: jax.lax.psum(x, both),
                 tl_blk._replace(window_s=jnp.float32(0.0)),
             )._replace(window_s=jnp.float32(spec.window_s))
-            obs_blk = jax.lax.psum(
-                pol_mod.observe_block(res, spec, ctx["retry_mask"]),
-                both,
-            )
             tl_acc = tl_mod.accumulate(tl_acc, tl_blk)
-            obs_acc = obs_acc + obs_blk
+            if with_pol:
+                pobs_acc = pobs_acc + jax.lax.psum(
+                    pol_mod.observe_block(res, spec,
+                                          ctx["retry_mask"]),
+                    both,
+                )
+            if roll:
+                robs_acc = robs_acc + jax.lax.psum(
+                    roll_mod.observe_block(res, spec), both
+                )
             # a window is final once EVERY shard's SLOWEST clock
             # passed it (closed loop: the slowest connection, not
             # conn_end.max() — faster connections' later blocks still
@@ -1344,16 +1516,46 @@ class ShardedSimulator:
                 else t_end
             )
             t_done = jax.lax.pmin(t_local, both)
-            pstate, delta = pol_mod.advance(
-                pstate, ctx["dtab"], tl_acc, obs_acc, t_done, spec,
-                stuck_breaker=ctx["stuck"], downed_w=ctx["downed_w"],
-            )
-            pol_acc = pol_mod.accumulate_summary(pol_acc, delta)
+            if roll:
+                rstate, rdelta = roll_mod.advance(
+                    rstate, ctx["rdtab"], robs_acc, t_done, spec
+                )
+                roll_acc = roll_mod.accumulate_summary(
+                    roll_acc, rdelta
+                )
+            if with_pol:
+                pstate, delta = pol_mod.advance(
+                    pstate, ctx["dtab"], tl_acc, pobs_acc, t_done,
+                    spec, stuck_breaker=ctx["stuck"],
+                    downed_w=ctx["downed_w"],
+                )
+                pol_acc = pol_mod.accumulate_summary(pol_acc, delta)
+            ys = s
+            if attr is not None:
+                a_blk, ex = attribution.attribute_block(
+                    res, atables,
+                    tail_cut=tail_cut if attr == "tail" else None,
+                    top_k=top_k, ex_state=ex,
+                    packed=ctx["packed"],
+                )
+                ys = (s, a_blk)
             return (
                 (t_end, conn_end, req_off + per),
-                tl_acc, obs_acc, pstate, pol_acc,
-            ), s
+                tl_acc, pobs_acc, pstate, pol_acc,
+                robs_acc, rstate, roll_acc, ex,
+            ), ys
 
+        ex0 = None
+        if attr is not None:
+            k0 = (
+                min(top_k, block) if top_k > 0 else 0
+            )
+            H = self.compiled.num_hops
+            ex0 = (
+                attribution.empty_exemplars(k0, H)
+                if k0 > 0
+                else None
+            )
         carry0 = (
             (
                 jnp.float32(0.0),
@@ -1361,21 +1563,70 @@ class ShardedSimulator:
                 jnp.float32(0.0),
             ),
             tl_mod.zeros_summary(spec, packed=ctx["packed"]),
-            jnp.zeros((S, W)),
-            pol_mod.init_state(ctx["dtab"], lag_periods=ctx["lag"]),
-            pol_mod.zeros_summary(spec, S),
+            jnp.zeros((S, W)) if with_pol else None,
+            (
+                pol_mod.init_state(ctx["dtab"],
+                                   lag_periods=ctx["lag"])
+                if with_pol else None
+            ),
+            pol_mod.zeros_summary(spec, S) if with_pol else None,
+            jnp.zeros((S, 2, W, 4)) if roll else None,
+            roll_mod.init_state(ctx["rdtab"]) if roll else None,
+            roll_mod.zeros_summary(spec, S) if roll else None,
+            ex0,
         )
-        (_, tl_final, _, _, pol_final), parts = jax.lax.scan(
-            block_body, carry0, jnp.arange(num_blocks)
-        )
+        (
+            (_, tl_final, _, _, pol_final, robs_final, _, roll_final,
+             ex_final),
+            ys,
+        ) = jax.lax.scan(block_body, carry0, jnp.arange(num_blocks))
+        if attr is not None:
+            parts, aparts = ys
+        else:
+            parts = ys
         merged_summary = self._merge_summary_collective(
             reduce_stacked(parts), both
         )
-        # tl_final / pol_final are already global (per-block psums) and
+        # tl/pol/roll finals are already global (per-block psums) and
         # replicated across shards
-        return merged_summary, tl_final, pol_final
+        out = (merged_summary, tl_final)
+        if roll:
+            out = out + (
+                roll_mod.attach_observations(roll_final, robs_final),
+            )
+        if with_pol:
+            out = out + (pol_final,)
+        if attr is not None:
+            # blame accumulators merge exactly like run_attributed:
+            # psum for the dense vectors, all_gather + top_k for the
+            # exemplar batch (every shard returns the global top-K)
+            local_attr = attribution.reduce_stacked(aparts, ex_final)
+            ex = local_attr.exemplars
+            psummed = jax.tree.map(
+                lambda x: jax.lax.psum(x, both),
+                local_attr._replace(
+                    tail_cut=jnp.float32(0.0), exemplars=None
+                ),
+            )
+            merged_attr = psummed._replace(
+                tail_cut=local_attr.tail_cut
+            )
+            if ex is not None:
+                k = ex.latency.shape[0]
 
-    def _local_policy_scan_all(
+                def gather(x):
+                    y = jax.lax.all_gather(x, both)
+                    return y.reshape((-1,) + x.shape[1:])
+
+                cat = jax.tree.map(gather, ex)
+                _, keep = jax.lax.top_k(cat.latency, k)
+                merged_attr = merged_attr._replace(
+                    exemplars=jax.tree.map(lambda a: a[keep], cat)
+                )
+            out = out + (merged_attr,)
+        return out
+
+    def _local_prot_scan_all(
         self,
         block: int,
         num_blocks: int,
@@ -1383,22 +1634,28 @@ class ShardedSimulator:
         conns_local: int,
         trim: bool,
         tl_plan: Tuple[int, float],
+        attr,
+        roll: bool,
         key: jax.Array,
         offered_qps: jax.Array,
         pace_gap: jax.Array,
         nominal_gap: jax.Array,
         win_lo: jax.Array,
         win_hi: jax.Array,
+        tail_cut: jax.Array,
         visits_pc: jax.Array,
         phase_windows: jax.Array,
     ):
         """The emulated twin's whole-mesh scan: one traced program
         whose block body sweeps every shard (unrolled, shard order)
-        and replays the per-block psum as sequential sums in the
+        and replays the per-block psums as sequential sums in the
         device merge's association order (ICI shards within each
-        slice first, slice partials last)."""
-        ctx = self._policy_block_ctx(tl_plan)
-        spec, pol_mod, tl_mod = ctx["spec"], ctx["pol_mod"], ctx["tl_mod"]
+        slice first, slice partials last).  Per-shard blame stacks
+        (``attr``) come back un-merged; the caller host-merges them."""
+        ctx = self._prot_block_ctx(tl_plan, roll)
+        spec, tl_mod = ctx["spec"], ctx["tl_mod"]
+        pol_mod, roll_mod = ctx["pol_mod"], ctx["roll_mod"]
+        with_pol = ctx["with_pol"]
         R = self.n_shards
         c = max(conns_local, 1)
         per = block // c
@@ -1406,6 +1663,11 @@ class ShardedSimulator:
         W = spec.num_windows
         n_slices = dict(self.mesh.shape).get(SLICE_AXIS, 1)
         per_slice = R // max(n_slices, 1)
+        if attr is not None:
+            from isotope_tpu.metrics import attribution
+
+            atables = self.sim._attribution_tables()
+            top_k = self.sim.params.attribution_top_k
 
         def _hier_sum(vals):
             def _seq(vs):
@@ -1420,12 +1682,16 @@ class ShardedSimulator:
             ])
 
         def block_body(carry, b):
-            (t0s, conn_t0s, req_offs), tl_acc, obs_acc, pstate, \
-                pol_acc = carry
-            fx = pol_mod.effects(pstate)
+            (t0s, conn_t0s, req_offs), tl_acc, pobs_acc, pstate, \
+                pol_acc, robs_acc, rstate, roll_acc, exs = carry
+            pfx = pol_mod.effects(pstate) if with_pol else None
+            rfx = roll_mod.effects(rstate) if roll else None
             sums = []
+            ablks = []
+            exs_out = []
             tl_parts = []
-            obs_parts = []
+            pobs_parts = []
+            robs_parts = []
             t_ends = []
             conn_ends = []
             for s_i in range(R):
@@ -1439,7 +1705,8 @@ class ShardedSimulator:
                     t0s[s_i], conn_t0s[s_i], req_offs[s_i],
                     visits_pc=visits_pc,
                     phase_windows=phase_windows,
-                    policy_fx=fx,
+                    policy_fx=pfx,
+                    rollout_fx=rfx,
                 )
                 sums.append(summarize(
                     res, self.collector,
@@ -1449,19 +1716,37 @@ class ShardedSimulator:
                     tl_mod.timeline_block(res, spec,
                                           packed=ctx["packed"])
                 )
-                obs_parts.append(
-                    pol_mod.observe_block(res, spec,
-                                          ctx["retry_mask"])
-                )
+                if with_pol:
+                    pobs_parts.append(
+                        pol_mod.observe_block(res, spec,
+                                              ctx["retry_mask"])
+                    )
+                if roll:
+                    robs_parts.append(
+                        roll_mod.observe_block(res, spec)
+                    )
+                if attr is not None:
+                    a_blk, ex_i = attribution.attribute_block(
+                        res, atables,
+                        tail_cut=(
+                            tail_cut if attr == "tail" else None
+                        ),
+                        top_k=top_k, ex_state=exs[s_i],
+                        packed=ctx["packed"],
+                    )
+                    ablks.append(a_blk)
+                    exs_out.append(ex_i)
                 t_ends.append(t_end)
                 conn_ends.append(conn_end)
             tl_blk = _hier_sum([
                 p._replace(window_s=jnp.float32(0.0))
                 for p in tl_parts
             ])._replace(window_s=jnp.float32(spec.window_s))
-            obs_blk = _hier_sum(obs_parts)
             tl_acc = tl_mod.accumulate(tl_acc, tl_blk)
-            obs_acc = obs_acc + obs_blk
+            if with_pol:
+                pobs_acc = pobs_acc + _hier_sum(pobs_parts)
+            if roll:
+                robs_acc = robs_acc + _hier_sum(robs_parts)
             locals_ = [
                 jnp.min(ce) if kind != OPEN_LOOP else te
                 for te, ce in zip(t_ends, conn_ends)
@@ -1469,20 +1754,34 @@ class ShardedSimulator:
             t_done = locals_[0]
             for t in locals_[1:]:
                 t_done = jnp.minimum(t_done, t)
-            pstate, delta = pol_mod.advance(
-                pstate, ctx["dtab"], tl_acc, obs_acc, t_done, spec,
-                stuck_breaker=ctx["stuck"], downed_w=ctx["downed_w"],
-            )
-            pol_acc = pol_mod.accumulate_summary(pol_acc, delta)
+            if roll:
+                rstate, rdelta = roll_mod.advance(
+                    rstate, ctx["rdtab"], robs_acc, t_done, spec
+                )
+                roll_acc = roll_mod.accumulate_summary(
+                    roll_acc, rdelta
+                )
+            if with_pol:
+                pstate, delta = pol_mod.advance(
+                    pstate, ctx["dtab"], tl_acc, pobs_acc, t_done,
+                    spec, stuck_breaker=ctx["stuck"],
+                    downed_w=ctx["downed_w"],
+                )
+                pol_acc = pol_mod.accumulate_summary(pol_acc, delta)
             carry_out = (
                 (
                     jnp.stack(t_ends),
                     jnp.stack(conn_ends),
                     req_offs + per,
                 ),
-                tl_acc, obs_acc, pstate, pol_acc,
+                tl_acc, pobs_acc, pstate, pol_acc,
+                robs_acc, rstate, roll_acc,
+                tuple(exs_out) if attr is not None else None,
             )
-            return carry_out, tuple(sums)
+            ys = tuple(sums)
+            if attr is not None:
+                ys = (ys, tuple(ablks))
+            return carry_out, ys
 
         carry0 = (
             (
@@ -1491,44 +1790,98 @@ class ShardedSimulator:
                 jnp.zeros((R,), jnp.float32),
             ),
             tl_mod.zeros_summary(spec, packed=ctx["packed"]),
-            jnp.zeros((S, W)),
-            pol_mod.init_state(ctx["dtab"], lag_periods=ctx["lag"]),
-            pol_mod.zeros_summary(spec, S),
+            jnp.zeros((S, W)) if with_pol else None,
+            (
+                pol_mod.init_state(ctx["dtab"],
+                                   lag_periods=ctx["lag"])
+                if with_pol else None
+            ),
+            pol_mod.zeros_summary(spec, S) if with_pol else None,
+            jnp.zeros((S, 2, W, 4)) if roll else None,
+            roll_mod.init_state(ctx["rdtab"]) if roll else None,
+            roll_mod.zeros_summary(spec, S) if roll else None,
+            None,
         )
-        (_, tl_final, _, _, pol_final), parts = jax.lax.scan(
-            block_body, carry0, jnp.arange(num_blocks)
-        )
-        return (
+        if attr is not None:
+            k0 = min(top_k, block) if top_k > 0 else 0
+            H = self.compiled.num_hops
+            ex0 = (
+                attribution.empty_exemplars(k0, H)
+                if k0 > 0
+                else None
+            )
+            carry0 = carry0[:-1] + (tuple(ex0 for _ in range(R)),)
+        (
+            (_, tl_final, _, _, pol_final, robs_final, _, roll_final,
+             exs_final),
+            ys,
+        ) = jax.lax.scan(block_body, carry0, jnp.arange(num_blocks))
+        if attr is not None:
+            parts, aparts = ys
+        else:
+            parts = ys
+        out = (
             tuple(reduce_stacked(p) for p in parts),
             tl_final,
-            pol_final,
         )
+        if roll:
+            out = out + (
+                roll_mod.attach_observations(roll_final, robs_final),
+            )
+        if with_pol:
+            out = out + (pol_final,)
+        if attr is not None:
+            out = out + (tuple(
+                attribution.reduce_stacked(ap, ex)
+                for ap, ex in zip(aparts, exs_final)
+            ),)
+        return out
 
-    def _pol_cache_key(self, plan: _RunPlan, tl_plan):
+    def _prot_cache_key(self, plan: _RunPlan, tl_plan, attr,
+                        roll: bool):
         return (plan.block, plan.num_blocks, plan.kind,
-                plan.conns_local, plan.trim, tl_plan)
+                plan.conns_local, plan.trim, tl_plan, attr, roll)
 
-    def _get_pol(self, plan: _RunPlan, tl_plan: Tuple[int, float]):
-        cache_key = self._pol_cache_key(plan, tl_plan)
-        key = ("sharded-pol",) + cache_key
+    def _get_prot(self, plan: _RunPlan, tl_plan: Tuple[int, float],
+                  attr, roll: bool):
+        cache_key = self._prot_cache_key(plan, tl_plan, attr, roll)
+        key = ("sharded-prot",) + cache_key
         if key not in self._fns:
             from isotope_tpu.metrics import timeline as timeline_mod
-            from isotope_tpu.sim import policies as policies_mod
 
-            body = partial(self._pol_body, *cache_key)
+            body = partial(self._prot_body, *cache_key)
             tl_spec = timeline_mod.TimelineSummary(
                 *([P()] * len(timeline_mod.TimelineSummary._fields))
             )
-            pol_spec = policies_mod.PolicySummary(
-                *([P()] * len(policies_mod.PolicySummary._fields))
-            )
+            out_specs = [self._summary_out_specs(), tl_spec]
+            if roll:
+                from isotope_tpu.sim import rollout as rollout_mod
+
+                out_specs.append(rollout_mod.RolloutSummary(
+                    *([P()] * len(rollout_mod.RolloutSummary._fields))
+                ))
+            if self.sim._policies is not None:
+                from isotope_tpu.sim import policies as policies_mod
+
+                out_specs.append(policies_mod.PolicySummary(
+                    *([P()] * len(policies_mod.PolicySummary._fields))
+                ))
+            if attr is not None:
+                from isotope_tpu.metrics import attribution
+
+                ex_spec = (
+                    attribution.ExemplarBatch(*([P()] * 7))
+                    if self.sim.params.attribution_top_k > 0
+                    else None
+                )
+                out_specs.append(attribution.AttributionSummary(
+                    *([P()] * 18), exemplars=ex_spec
+                ))
             mapped = _shard_map(
                 body,
                 mesh=self.mesh,
-                in_specs=tuple(P() for _ in range(8)),
-                out_specs=(
-                    self._summary_out_specs(), tl_spec, pol_spec,
-                ),
+                in_specs=tuple(P() for _ in range(9)),
+                out_specs=tuple(out_specs),
             )
             mesh_sig = (
                 tuple(self.mesh.axis_names),
@@ -1537,7 +1890,7 @@ class ShardedSimulator:
                 tuple(d.id for d in self.mesh.devices.flat),
             )
             self._fns[key] = executable_cache.get_or_build(
-                ("sharded-pol", self.sim.signature, mesh_sig)
+                ("sharded-prot", self.sim.signature, mesh_sig)
                 + cache_key,
                 lambda: telemetry.time_first_call(
                     jax.jit(mapped), "compile.jit_first_call"
@@ -1545,15 +1898,16 @@ class ShardedSimulator:
             )
         return self._fns[key]
 
-    def _get_local_pol_fn(self, plan: _RunPlan,
-                          tl_plan: Tuple[int, float]):
-        cache_key = self._pol_cache_key(plan, tl_plan)
-        full_key = ("sharded-pol-local", self.sim.signature,
+    def _get_local_prot_fn(self, plan: _RunPlan,
+                           tl_plan: Tuple[int, float], attr,
+                           roll: bool):
+        cache_key = self._prot_cache_key(plan, tl_plan, attr, roll)
+        full_key = ("sharded-prot-local", self.sim.signature,
                     self.n_shards) + cache_key
         return executable_cache.get_or_build(
             full_key,
             lambda: telemetry.time_first_call(
-                jax.jit(partial(self._local_policy_scan_all,
+                jax.jit(partial(self._local_prot_scan_all,
                                 *cache_key)),
                 "compile.jit_first_call",
             ),

@@ -163,6 +163,14 @@ class ExperimentConfig:
     # TOML [sim] policies = true).  Implies the timeline recorder (the
     # control loop's observation side).
     policies: bool = False
+    # reactive canary rollouts (sim/rollout.py): when True, the
+    # topology's `rollouts:` block compiles to per-service step
+    # schedules and the MAIN run co-simulates the progressive-delivery
+    # controller (canary traffic splits as scan-carry state, PROMOTE /
+    # HOLD / ROLLBACK from the per-version window signals) inside the
+    # block scan (--rollouts / TOML [sim] rollouts = true).  Implies
+    # the timeline recorder, like policies.
+    rollouts: bool = False
 
     def sim_params(self) -> SimParams:
         return SimParams(
@@ -170,8 +178,8 @@ class ExperimentConfig:
             service_time=self.service_time,
             service_time_param=self.service_time_param,
             attribution=self.attribution,
-            # the policy co-sim observes through the flight recorder
-            timeline=self.timeline or self.policies,
+            # the policy/rollout co-sims observe through the recorder
+            timeline=self.timeline or self.policies or self.rollouts,
             timeline_window_s=self.timeline_window_s,
             overlap=self.overlap,
         )
@@ -396,4 +404,5 @@ def load_toml(path) -> ExperimentConfig:
             else SimParams().timeline_window_s
         ),
         policies=bool(sim.get("policies", False)),
+        rollouts=bool(sim.get("rollouts", False)),
     )

@@ -93,6 +93,11 @@ class RunResult:
     # main run; None when the policy co-sim was off
     policies: Optional[dict] = None
     policies_summary: Optional[object] = None
+    # reactive canary rollouts (sim/rollout.py): the rollout.json doc
+    # and the raw RolloutSummary of the PROTECTED main run; None when
+    # the rollout co-sim was off
+    rollouts: Optional[dict] = None
+    rollouts_summary: Optional[object] = None
 
 
 def _failed_window(reason: str) -> WindowSummary:
@@ -152,6 +157,8 @@ class _LazyTopology:
         self._sims = {}
         self._policy_tables = None
         self._policy_tables_built = False
+        self._rollout_tables = None
+        self._rollout_tables_built = False
 
     @property
     def compiled(self):
@@ -197,6 +204,21 @@ class _LazyTopology:
                 )
         return self._policy_tables
 
+    @property
+    def rollout_tables(self):
+        """Compiled progressive-delivery tables (sim/rollout.py), or
+        None when the topology declares no active rollout or the
+        config leaves the co-sim off."""
+        if not self._rollout_tables_built:
+            self._rollout_tables_built = True
+            if self.config.rollouts:
+                from isotope_tpu.compiler import compile_rollouts
+
+                self._rollout_tables = compile_rollouts(
+                    self.graph, self.compiled
+                )
+        return self._rollout_tables
+
     def mesh_spec(self) -> MeshSpec:
         """The resolved factorization for this topology (``"auto"``
         runs the layout search against the compiled service count)."""
@@ -237,9 +259,10 @@ class _LazyTopology:
         if env.name not in self._sims:
             params = env.apply(self.config.sim_params())
             policies = self.policy_tables
+            rollouts = self.rollout_tables
             sim = Simulator(self.compiled, params, self.config.chaos,
                             self.config.churn, mtls=self.config.mtls,
-                            policies=policies)
+                            policies=policies, rollouts=rollouts)
             spec = self.mesh_spec()
             sharded = (
                 ShardedSimulator(
@@ -250,6 +273,7 @@ class _LazyTopology:
                     self.config.churn,
                     mtls=self.config.mtls,
                     policies=policies,
+                    rollouts=rollouts,
                 )
                 if spec.size > 1
                 else None
@@ -432,24 +456,80 @@ def _timeline_pass(sim, sharded, use_sharded, topo, load, n, key,
         return None, None
 
 
-def _policy_run(sim, sharded, use_sharded, load, n, key, block,
-                config, collector, policy, timeline,
-                attribution=None):
-    """The policy co-sim main run for one case (sim/policies.py):
-    the PROTECTED physics is the measurement, so this replaces the
-    ladder run.  Supervised retries apply (``call_with_retries``);
-    the OOM degradation ladder for policy runs is a follow-up.
+def _protected_rung_specs(is_sharded: bool, block: int):
+    """Rung specs for a PROTECTED (policy/rollout) main run — the
+    PR 3 supervisor rungs adapted to the co-sim entry points.  Each
+    spec is ``(name, block_size, mode)`` with mode ``"dev"`` (the
+    normal entry point), ``"emu"`` (the ``*_emulated`` twin —
+    bit-equal trajectory by construction), or ``"eager"``
+    (``jax.disable_jit``, the rung of last resort).
+
+    NOTE a half-block protected run is a DIFFERENT measurement: the
+    control loops actuate at block boundaries, so halving the block
+    halves the actuation lag.  That is exactly why ``degraded_to`` is
+    recorded on the result (and why bench_regress fails a capture
+    that degrades a previously-clean case)."""
+    half = max(256, block // 2)
+    if is_sharded:
+        return [
+            ("sharded", block, "dev"),
+            ("sharded-half-block", half, "dev"),
+            ("single-device", block, "emu"),
+        ]
+    return [
+        ("scan", block, "dev"),
+        ("half-block", half, "dev"),
+        ("cpu-eager", half, "eager"),
+    ]
+
+
+def _protected_call(runner, method: str, spec, load, n, key, kwargs,
+                    **extra):
+    """Invoke one protected rung: the co-sim entry point named by
+    ``spec``'s mode, blocking on the summary with the numeric
+    sentinels armed (deferred device errors must surface inside the
+    supervised scope)."""
+    import contextlib
+
+    from isotope_tpu.resilience import sentinels
+
+    _, b, mode = spec
+    fn = getattr(runner, f"{method}_emulated" if mode == "emu"
+                 else method)
+    ctx = jax.disable_jit() if mode == "eager" \
+        else contextlib.nullcontext()
+    with ctx:
+        out = fn(load, n, key, block_size=b, **kwargs, **extra)
+        jax.block_until_ready(out[0].count)
+    sentinels.check_summary(out[0])
+    return out
+
+
+def _protected_run(sim, sharded, use_sharded, load, n, key, block,
+                   config, collector, policy, timeline, tables_pol,
+                   tables_roll, attribution=None):
+    """The protected co-sim main run for one case (sim/policies.py
+    and/or sim/rollout.py): the PROTECTED physics is the measurement,
+    so this replaces the plain ladder run.  Failures walk the PR 3
+    supervisor ladder (:func:`_protected_rungs`: half-block →
+    single-device emulation) with ``degraded_to`` recorded, exactly
+    like unprotected cases.
 
     The block size is capped near ONE recorder window of requests:
-    the control loop actuates at block boundaries, so the default
+    the control loops actuate at block boundaries, so the default
     HBM-sized block would give a whole-run actuation lag.
 
     ``attribution`` additionally runs the blame pass OVER THE
     PROTECTED physics (identical streams/blocking/trajectory to the
-    main run) when the case ran single-device; a mesh-served case
-    skips it with a warning (the sharded policy program does not
-    reduce blame yet).  Returns ``(summary, timeline, policies,
-    blame_doc | None, attr_summary | None)``."""
+    main run): single-device reduces in the same scan; mesh-served
+    cases reduce with the ``run_attributed`` collectives (per-block
+    psum + top-K all_gather), bit-equal to the emulated twin.
+
+    Returns ``(summary, timeline, roll_summary | None,
+    pol_summary | None, blame_doc | None, attr_summary | None,
+    degraded_to | None)``."""
+    roll = tables_roll is not None
+    method = "run_rollouts" if roll else "run_policies"
     # svc-sharded meshes split the per-service metric layout the
     # replicated control state needs; fall back to the single-device
     # scan for those rather than failing the case
@@ -462,10 +542,10 @@ def _policy_run(sim, sharded, use_sharded, load, n, key, block,
         # the fallback is a different execution shape — say so
         # instead of silently serving a mesh-sized case on one device
         print(
-            "warning: --policies falls back to the single-device "
-            "scan (the svc-sharded mesh splits the per-service "
-            "metric layout the replicated control state needs; use "
-            "svc=1)",
+            "warning: the protected co-sim falls back to the "
+            "single-device scan (the svc-sharded mesh splits the "
+            "per-service metric layout the replicated control state "
+            "needs; use svc=1)",
             file=sys.stderr,
         )
     if timeline is not None:
@@ -481,47 +561,60 @@ def _policy_run(sim, sharded, use_sharded, load, n, key, block,
     rate = load.qps if load.qps is not None else sim.capacity_qps()
     shards = getattr(runner, "n_shards", 1)
     block = max(256, min(block, int(max(rate * win / shards, 1.0))))
-    kwargs = dict(block_size=block, trim=True, window_s=win)
-    if runner is sim:
+    kwargs = dict(trim=True, window_s=win)
+    is_sharded = runner is not sim
+    if not is_sharded:
         # the sharded runner summarizes with its own collector
         kwargs["collector"] = collector
-    with telemetry.phase("policies.run"):
-        out = call_with_retries(
-            lambda: runner.run_policies(load, n, key, **kwargs),
-            site="engine.run", policy=policy,
+    specs = _protected_rung_specs(is_sharded, block)
+    rungs = [
+        (spec[0],
+         (lambda s: lambda: _protected_call(
+             runner, method, s, load, n, key, kwargs))(spec))
+        for spec in specs
+    ]
+    with telemetry.phase(f"{'rollouts' if roll else 'policies'}.run"):
+        out, degraded_to = run_ladder(
+            rungs, policy, site_prefix="engine"
         )
-    telemetry.counter_inc("policy_main_runs")
+    telemetry.counter_inc(f"{'rollout' if roll else 'policy'}_main_runs")
+    # unpack by construction: run_rollouts -> (summary, tl, roll
+    # [, pol][, attr]); run_policies -> (summary, tl, pol[, attr])
+    summary, tl_main = out[0], out[1]
+    rest = list(out[2:])
+    roll_main = rest.pop(0) if roll else None
+    pol_main = rest.pop(0) if tables_pol is not None else None
     blame_doc = attr_summary = None
     if attribution is not None:
-        if runner is not sim:
+        from isotope_tpu.metrics import attribution as attr_mod
+
+        # replay the RUNG THAT SERVED the main run (identical streams,
+        # blocking, and control trajectory), reduced to blame in the
+        # same scan; mesh-served cases use the run_attributed
+        # collectives (per-block psum + top-K all_gather)
+        served = next(
+            s for s in specs
+            if s[0] == (degraded_to or specs[0][0])
+        )
+        try:
+            with telemetry.phase("attribution.pass"):
+                attr_out = _protected_call(
+                    runner, method, served, load, n, key, kwargs,
+                    attribution=True, tail=attribution == "tail",
+                )
+                attr_summary = attr_out[-1]
+                jax.block_until_ready(attr_summary.count)
+            blame_doc = attr_mod.to_doc(sim.compiled, attr_summary)
+            telemetry.counter_inc("attribution_passes")
+        except Exception as e:  # pragma: no cover - best effort
+            telemetry.counter_inc("attribution_pass_failures")
             print(
-                "warning: --attribution under --policies is skipped "
-                "for mesh-served cases (the sharded policy program "
-                "does not reduce blame yet)",
+                f"warning: protected attribution pass failed: {e}",
                 file=sys.stderr,
             )
-        else:
-            from isotope_tpu.metrics import attribution as attr_mod
-
-            try:
-                with telemetry.phase("attribution.pass"):
-                    _, _, _, attr_summary = sim.run_policies(
-                        load, n, key, attribution=True,
-                        tail=attribution == "tail", **kwargs,
-                    )
-                    jax.block_until_ready(attr_summary.count)
-                blame_doc = attr_mod.to_doc(
-                    sim.compiled, attr_summary
-                )
-                telemetry.counter_inc("attribution_passes")
-            except Exception as e:  # pragma: no cover - best effort
-                telemetry.counter_inc("attribution_pass_failures")
-                print(
-                    f"warning: protected attribution pass failed: {e}",
-                    file=sys.stderr,
-                )
-                attr_summary = None
-    return out + (blame_doc, attr_summary)
+            attr_summary = None
+    return (summary, tl_main, roll_main, pol_main, blame_doc,
+            attr_summary, degraded_to)
 
 
 def _record_vet_memory_ratio() -> None:
@@ -715,30 +808,38 @@ def run_experiment(
                                     vet, sim, topo, config, load,
                                     block, rungs, policy,
                                 )
-                            tl_main = pol_main = None
+                            tl_main = pol_main = roll_main = None
                             pol_blame = pol_attr = None
-                            if topo.policy_tables is not None:
-                                # policy co-sim: the PROTECTED run IS
-                                # the measurement (policies change the
-                                # physics), so it replaces the ladder
-                                # run; supervised retries still apply
-                                # (degradation rungs are a follow-up)
-                                (summary, tl_main, pol_main,
-                                 pol_blame, pol_attr) = _policy_run(
+                            protected = (
+                                topo.policy_tables is not None
+                                or topo.rollout_tables is not None
+                            )
+                            if protected:
+                                # policy/rollout co-sim: the PROTECTED
+                                # run IS the measurement (the control
+                                # loops change the physics), so it
+                                # replaces the plain ladder run —
+                                # failures walk its own supervisor
+                                # ladder (half-block → single-device
+                                # emulation) with degraded_to recorded
+                                (summary, tl_main, roll_main,
+                                 pol_main, pol_blame, pol_attr,
+                                 degraded_to) = _protected_run(
                                     sim, sharded, use_sharded,
                                     load, n, run_key, block,
                                     config, topo.collector,
                                     policy, timeline,
+                                    topo.policy_tables,
+                                    topo.rollout_tables,
                                     attribution=attribution,
                                 )
-                                degraded_to = None
                             else:
                                 summary, degraded_to = run_ladder(
                                     rungs[start_rung:], policy,
                                     site_prefix="engine",
                                 )
                             if start_rung and degraded_to is None \
-                                    and pol_main is None:
+                                    and not protected:
                                 # the pre-selected rung IS a
                                 # degradation: record it exactly as a
                                 # ladder descent would have (bench
@@ -791,10 +892,10 @@ def run_experiment(
                         run_index += 1
                         continue
                     blame_doc = attr_summary = None
-                    if pol_main is not None:
+                    if protected:
                         # the protected attributed pass (if requested)
-                        # already ran inside _policy_run with the same
-                        # streams/trajectory as the main measurement
+                        # already ran inside _protected_run with the
+                        # same streams/trajectory as the measurement
                         blame_doc, attr_summary = pol_blame, pol_attr
                     elif attribution is not None:
                         # identical executor/key/blocking to the main
@@ -808,26 +909,39 @@ def run_experiment(
                         )
                     tl_doc = tl_summary = None
                     pol_doc = pol_summary_out = None
-                    if pol_main is not None:
+                    roll_doc = roll_summary_out = None
+                    if protected:
                         # the protected run already reduced the
-                        # timeline next to the policy series — no
+                        # timeline next to the control series — no
                         # separate recorder pass needed
                         from isotope_tpu.metrics import (
                             timeline as timeline_mod,
-                        )
-                        from isotope_tpu.sim import (
-                            policies as policies_mod,
                         )
 
                         tl_summary = tl_main
                         tl_doc = timeline_mod.to_doc(
                             topo.compiled, tl_main
                         )
-                        pol_summary_out = pol_main
-                        pol_doc = policies_mod.to_doc(
-                            topo.compiled, pol_main,
-                            topo.policy_tables,
-                        )
+                        if pol_main is not None:
+                            from isotope_tpu.sim import (
+                                policies as policies_mod,
+                            )
+
+                            pol_summary_out = pol_main
+                            pol_doc = policies_mod.to_doc(
+                                topo.compiled, pol_main,
+                                topo.policy_tables,
+                            )
+                        if roll_main is not None:
+                            from isotope_tpu.sim import (
+                                rollout as rollout_mod,
+                            )
+
+                            roll_summary_out = roll_main
+                            roll_doc = rollout_mod.to_doc(
+                                topo.compiled, roll_main,
+                                topo.rollout_tables,
+                            )
                     elif timeline is not None:
                         tl_doc, tl_summary = _timeline_pass(
                             sim, sharded, use_sharded, topo, load, n,
@@ -865,6 +979,13 @@ def run_experiment(
                         # run of the same grid cell
                         flat["_policies"] = True
                         telemetry.set_meta("policies", "on")
+                    if roll_doc is not None:
+                        # likewise for the rollout controller: bench
+                        # and bench_regress key on the marker so a
+                        # rollout-enabled case is never compared
+                        # against an open-loop twin
+                        flat["_rollout"] = True
+                        telemetry.set_meta("rollouts", "on")
                     flat.update(
                         {
                             "cpu_cores_" + name: round(v, 4)
@@ -906,6 +1027,8 @@ def run_experiment(
                         timeline_summary=tl_summary,
                         policies=pol_doc,
                         policies_summary=pol_summary_out,
+                        rollouts=roll_doc,
+                        rollouts_summary=roll_summary_out,
                     )
                     results.append(result)
                     if out is not None:
@@ -929,6 +1052,11 @@ def run_experiment(
                                 out / f"{label}.policies.json", "w"
                             ) as f:
                                 json.dump(pol_doc, f, indent=2)
+                        if roll_doc is not None:
+                            with open(
+                                out / f"{label}.rollout.json", "w"
+                            ) as f:
+                                json.dump(roll_doc, f, indent=2)
                         if attr_summary is not None:
                             from isotope_tpu.metrics.export import (
                                 write_flamegraph,

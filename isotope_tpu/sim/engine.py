@@ -93,6 +93,16 @@ class SimResults(NamedTuple):
     # field: consumers that ignore it (summarize) leave the traced
     # program untouched, XLA dead-code-eliminates the alias.
     hop_wait: Optional[jax.Array] = None  # (N, H) f32
+    # per-hop version coin of a rollout-actuated block (sim/rollout.py):
+    # True where the hop routed to the CANARY arm.  Same trailing-
+    # optional discipline as hop_wait — None everywhere rollouts are off.
+    hop_canary: Optional[jax.Array] = None  # (N, H) bool
+    # hops that WOULD have executed but whose target station was chaos-
+    # downed (transport failure charged to that service's arm) — the
+    # rollout gates must see a fully-killed canary's refused calls as
+    # canary errors even though the hop never ran (hop_sent stays
+    # False).  None everywhere rollouts are off.
+    hop_refused: Optional[jax.Array] = None  # (N, H) bool
 
     @property
     def client_end(self) -> jax.Array:
@@ -555,6 +565,7 @@ class Simulator:
         churn: Sequence[TrafficSplit] = (),
         mtls: Optional[MtlsSchedule] = None,
         policies=None,  # Optional[policies.PolicyTables]
+        rollouts=None,  # Optional[rollout.RolloutTables]
     ):
         # engine.build covers everything below: device-constant upload,
         # bucket planning, copula tables — the host-side cost a compile
@@ -599,7 +610,43 @@ class Simulator:
             # max; the Erlang recursion length must cover the widest
             # station the dynamic wait law can reach
             self._k_max = max(self._k_max, policies.k_max)
+        # -- reactive canary rollouts (sim/rollout.py) ---------------------
+        # Compiled per-service step schedules + canary-arm physics
+        # overrides.  ``None`` (the default) keeps every traced program
+        # byte-identical — all rollout effects below gate on it.
+        self._rollouts = rollouts
+        if rollouts is not None:
+            if mtls is not None:
+                # the canary wait selection composes per-request; the
+                # phased mTLS tax is orthogonal but untested together —
+                # reject loudly rather than silently mis-taxing an arm
+                raise ValueError(
+                    "rollout runs do not support MtlsSchedule yet"
+                )
+            self._k_max = max(self._k_max, rollouts.k_max)
         self._mu = 1.0 / params.cpu_time_s
+        if rollouts is not None:
+            # canary-arm constants: per-service mu (cpu_time override),
+            # per-hop cpu ratio and error rate (baseline-substituted)
+            can_cpu = np.where(
+                np.isfinite(rollouts.canary_cpu_s),
+                rollouts.canary_cpu_s, params.cpu_time_s,
+            )
+            self._canary_mu = jnp.asarray(1.0 / can_cpu, jnp.float32)
+            self._canary_cpu_varies = bool(
+                (can_cpu != params.cpu_time_s).any()
+            )
+            self._canary_cpu_ratio_h = jnp.asarray(
+                (can_cpu / params.cpu_time_s)[compiled.hop_service],
+                jnp.float32,
+            )
+            self._canary_err_h = jnp.asarray(
+                rollouts.canary_error_rate[compiled.hop_service],
+                jnp.float32,
+            )
+            self._canary_reps_np = rollouts.canary_replicas.astype(
+                np.float64
+            )
 
         # -- traffic splits (config churner): per-hop schedule ids ---------
         # Each churned call's send probability is multiplied by its
@@ -732,6 +779,33 @@ class Simulator:
             self._downed_p_np = (
                 t.replicas.astype(np.float64)[None, :] - eff
             )
+        if rollouts is not None:
+            # canary-first kill attribution: on a rolled-out service a
+            # chaos phase's down delta removes CANARY replicas before
+            # baseline ones — the newest pods are the ones a bad push
+            # crashes, and a "canary-targeted kill" is exactly a chaos
+            # event with replicas_down <= the canary arm's count.  The
+            # baseline station then keeps (static - remaining delta)
+            # and the canary station (canary_replicas - canary delta);
+            # a fully-downed canary arm transport-fails its hops the
+            # way a fully-down service does.
+            downed_p = t.replicas.astype(np.float64)[None, :] - eff
+            can_down_p = np.where(
+                rollouts.has_rollout[None, :],
+                np.minimum(downed_p, self._canary_reps_np[None, :]),
+                0.0,
+            )
+            base_down_p = downed_p - can_down_p
+            base_eff_p = t.replicas.astype(np.float64)[None, :] \
+                - base_down_p
+            can_eff_p = self._canary_reps_np[None, :] - can_down_p
+            self._downed_base_p_np = base_down_p        # (P, S)
+            self._base_eff_roll_p_np = base_eff_p
+            self._can_eff_roll_p_np = can_eff_p
+            self._svc_down_base_roll_p_np = base_eff_p <= 0
+            self._svc_down_can_p_np = (
+                rollouts.has_rollout[None, :] & (can_eff_p <= 0)
+            )
         self._phase_starts = jnp.asarray(cuts, jnp.float32)  # (P,)
         self._svc_down = jnp.asarray(svc_down_np)            # (P, S) bool
         self._eff_replicas = jnp.asarray(np.maximum(eff, 1), jnp.int32)
@@ -853,6 +927,35 @@ class Simulator:
             self._downed_pc = jnp.asarray(
                 np.repeat(self._downed_p_np, Cc, axis=0), jnp.float32
             )
+        if rollouts is not None:
+            # Cc-repeated canary/baseline phase tables (the chaos split
+            # above); without chaos they degenerate to the static rows
+            rep = lambda a, dt_: jnp.asarray(  # noqa: E731
+                np.repeat(a, Cc, axis=0), dt_
+            )
+            self._eff_base_roll_pc = rep(
+                np.maximum(self._base_eff_roll_p_np, 1.0), jnp.int32
+            ) if chaos else None
+            self._svc_down_base_roll_pc = (
+                rep(self._svc_down_base_roll_p_np, None)
+                if chaos else None
+            )
+            self._can_reps_pc = (
+                rep(np.maximum(self._can_eff_roll_p_np, 1.0),
+                    jnp.float32)
+                if chaos
+                else jnp.broadcast_to(
+                    jnp.asarray(self._canary_reps_np, jnp.float32),
+                    (Cc, compiled.num_services),
+                )
+            )
+            self._svc_down_can_pc = (
+                rep(self._svc_down_can_p_np, None) if chaos else None
+            )
+            if policies is not None and chaos:
+                self._downed_base_pc = rep(
+                    self._downed_base_p_np, jnp.float32
+                )
 
         # -- retry-storm feedback (load-dependent visits) ------------------
         # With finite call timeouts the retry/truncation probabilities are
@@ -973,7 +1076,11 @@ class Simulator:
         self._need_send = bool(churn) or bool(
             (compiled.hop_send_prob[1:] < 1.0).any()
         )
-        self._need_err = bool((t.error_rate[hs] > 0.0).any())
+        self._need_err = bool((t.error_rate[hs] > 0.0).any()) or (
+            # a canary arm that can 500 needs the error coins drawn
+            # even when the baseline is error-free (sim/rollout.py)
+            rollouts is not None and rollouts.any_error_override
+        )
 
         levels: List[_Level] = []
         np_meta: List[dict] = []  # host-side shapes for bucket planning
@@ -1139,6 +1246,8 @@ class Simulator:
             )
             # breaker sheds take the 500 error path (sim/policies.py)
             or (policies is not None and policies.any_breaker)
+            # canary-arm 500s feed the rollout gates (sim/rollout.py)
+            or (rollouts is not None and rollouts.any_error_override)
         )
         shapes = [
             buckets.LevelShape(
@@ -1202,6 +1311,7 @@ class Simulator:
                 # policy tables bake into the traced control program;
                 # absent tables contribute the historical empty digest
                 policies.signature() if policies is not None else "",
+                rollouts.signature() if rollouts is not None else "",
                 compiled.hop_service, compiled.hop_parent,
                 compiled.hop_step, compiled.hop_attempt,
                 compiled.hop_send_prob, compiled.hop_request_size,
@@ -2105,6 +2215,22 @@ class Simulator:
         # below instead
         faults.check("policies.stuck_breaker")
         faults.check("policies.autoscaler_lag")
+        return self._run_protected(
+            load, num_requests, key, roll=False, block_size=block_size,
+            collector=collector, fixed_point_iters=fixed_point_iters,
+            trim=trim, window_s=window_s, attribution=attribution,
+            tail=tail, tail_cut=tail_cut,
+        )
+
+    def _run_protected(self, load, num_requests, key, *, roll: bool,
+                       block_size: int, collector, fixed_point_iters: int,
+                       trim: bool, window_s: Optional[float],
+                       attribution: bool, tail: bool,
+                       tail_cut: Optional[float]):
+        """Shared tail of the protected runners (:meth:`run_policies` /
+        :meth:`run_rollouts`): tail-cut pilot, load planning, the jitted
+        program fetch, and the traced invocation — one copy so the two
+        control planes cannot diverge."""
         if attribution and tail and tail_cut is None:
             tail_cut = self.estimate_tail_cut(
                 load, num_requests, key, block_size=block_size
@@ -2133,15 +2259,16 @@ class Simulator:
         tl_plan = self.plan_timeline_windows(
             num_blocks * block, offered, window_s
         )
-        fn = self._get_policies(
+        fn = self._get_protected(
             block, num_blocks, load.kind, conns, collector, trim,
             tl_plan,
             attr=("tail" if tail else "mean") if attribution else None,
+            roll=roll,
         )
         faults.check("engine.run")
         telemetry.gauge_set("engine_block_requests", block)
         telemetry.gauge_set("engine_num_blocks", num_blocks)
-        telemetry.counter_inc("policy_runs")
+        telemetry.counter_inc("rollout_runs" if roll else "policy_runs")
         with self._detail_ctx():
             return fn(
                 key, jnp.float32(offered), jnp.float32(pace),
@@ -2156,11 +2283,15 @@ class Simulator:
                 self._windows_arg(offered, False),
             )
 
-    def _policy_downed_windows(self, spec):
+    def _policy_downed_windows(self, spec, base_split: bool = False):
         """(S, W) chaos-downed replica counts per recorder window (the
         nominal phase covering each window's END), or None without
         chaos — the autoscaler's alive-capacity denominator must see
-        the kill or a dead service reads as idle and scales DOWN."""
+        the kill or a dead service reads as idle and scales DOWN.
+
+        ``base_split`` (rollout runs) reports the BASELINE arm's share
+        of the delta only — the canary-first kill attribution removes
+        canary pods before the pods the autoscaler manages."""
         if self._policies is None or not self.has_chaos:
             return None
         cuts = np.asarray(self._phase_starts, np.float64)
@@ -2171,44 +2302,138 @@ class Simulator:
             np.searchsorted(cuts, w_end, side="right") - 1,
             0, len(cuts) - 1,
         )
-        return jnp.asarray(self._downed_p_np[p_idx].T, jnp.float32)
+        downed = (
+            self._downed_base_p_np if base_split else self._downed_p_np
+        )
+        return jnp.asarray(downed[p_idx].T, jnp.float32)
 
-    def _get_policies(self, block: int, num_blocks: int, kind: str,
-                      connections: int, collector, trim: bool,
-                      tl_plan: Tuple[int, float],
-                      attr: Optional[str] = None):
-        """Jitted scan-over-blocks program co-simulating the policy
-        control loop: carry = (clocks, timeline accumulator, retry
-        observation accumulator, policy state, policy series) — the
-        stateful-lattice-in-a-scan idiom, policy dynamics as pure
-        carry arithmetic.
+    def run_rollouts(
+        self,
+        load: LoadModel,
+        num_requests: int,
+        key: jax.Array,
+        *,
+        block_size: int = 65_536,
+        collector=None,
+        fixed_point_iters: int = 3,
+        trim: bool = False,
+        window_s: Optional[float] = None,
+        attribution: bool = False,
+        tail: bool = False,
+        tail_cut: Optional[float] = None,
+    ):
+        """Co-simulate the progressive-delivery rollout controller
+        (sim/rollout.py) inside the block scan: the scan carry holds
+        the per-service rollout state (step index, canary traffic
+        weight, bake/cooldown clocks, per-arm sample accumulators)
+        next to the flight-recorder accumulator, each block's hops
+        route to the canary arm with the CURRENT weight (its own
+        M/M/k station, error-rate and cpu-time overrides), and the
+        controller advances through every completed window — PROMOTE /
+        HOLD / ROLLBACK from the per-version observation channel.
+        Same discretization as :meth:`run_policies`: window-granular
+        observation, block-granular actuation (one-block lag).
 
-        ``attr`` additionally reduces the PR-5 blame decomposition
-        over the SAME protected blocks (per-block blame vectors stack,
-        the top-K exemplar state rides the carry next to the policy
-        state); the traced ``tail_cut`` argument is ignored (inf) by
-        the plain variant."""
+        Returns ``(RunSummary, TimelineSummary, RolloutSummary)``;
+        with policy tables ALSO compiled the PR 9 control loops ride
+        the same carry (a rolled-back canary's load surge flows
+        through breakers/HPA) and a ``PolicySummary`` is appended;
+        ``attribution=True`` (needs ``SimParams.attribution``)
+        additionally reduces the critical-path blame over the same
+        physics and appends an ``AttributionSummary``.
+
+        Requires rollout tables (``Simulator(..., rollouts=...)``) and
+        ``SimParams.timeline=True``; saturated ``-qps max`` loads are
+        rejected (static finite-population tables).  The baseline
+        arm's station reports utilization/stability; the canary
+        station's instability folds into its sampled waits.
+        """
+        if self._rollouts is None:
+            raise ValueError(
+                "rollout runs need compiled rollout tables "
+                "(Simulator(..., rollouts=compile_rollouts(graph, "
+                "compiled)))"
+            )
+        if not self.params.timeline:
+            raise ValueError(
+                "rollout runs need SimParams(timeline=True) — the "
+                "flight recorder is the control loop's observation side"
+            )
+        if self._saturated(load):
+            raise ValueError(
+                "rollout runs do not support saturated -qps max loads: "
+                "the finite-population wait tables are host-built from "
+                "static replica counts the rollout state cannot split; "
+                "use a paced closed loop or open loop"
+            )
+        if attribution and not self.params.attribution:
+            raise ValueError(
+                "attributed rollout runs need SimParams(attribution="
+                "True) alongside the rollout tables"
+            )
+        if self._policies is not None:
+            # the policy layer's chaos sites cover composed runs too
+            faults.check("policies.stuck_breaker")
+            faults.check("policies.autoscaler_lag")
+        return self._run_protected(
+            load, num_requests, key, roll=True, block_size=block_size,
+            collector=collector, fixed_point_iters=fixed_point_iters,
+            trim=trim, window_s=window_s, attribution=attribution,
+            tail=tail, tail_cut=tail_cut,
+        )
+
+    def _get_protected(self, block: int, num_blocks: int, kind: str,
+                       connections: int, collector, trim: bool,
+                       tl_plan: Tuple[int, float],
+                       attr: Optional[str] = None, *,
+                       roll: bool = False):
+        """Jitted scan-over-blocks program co-simulating the in-graph
+        control planes — the PR 9 policy loops, the rollout controller,
+        or BOTH in the same carry: carry = (clocks, timeline
+        accumulator[, (S, 2, W, 4) per-version observation accumulator,
+        rollout state, rollout series][, policy obs/state/series][,
+        exemplar state]).  An absent layer rides as ``None`` (an empty
+        pytree — the traced program never mentions it), so ONE body
+        serves both protected runners and a fix applied to the policy
+        wiring cannot diverge the composed path (the same rationale as
+        parallel/sharded.py's ``_prot_body``).
+
+        Return ordering (the runner unpacks by construction):
+        ``roll`` -> (summary, tl, roll[, pol][, attr]); policies-only
+        -> (summary, tl, pol[, attr])."""
         from isotope_tpu.metrics import timeline as timeline_mod
-        from isotope_tpu.sim import policies as policies_mod
         from isotope_tpu.sim import summary as summary_mod
 
+        with_pol = self._policies is not None
+        tag = "rollouts" if roll else "policies"
         cache_key = (block, num_blocks, kind, connections,
                      collector is not None, trim, tl_plan, attr,
-                     "policies")
+                     with_pol, tag)
         if cache_key not in self._summary_fns:
             c = max(connections, 1)
             per = block // c
             tspec = timeline_mod.build_spec(
                 self.compiled, tl_plan[0], tl_plan[1]
             )
-            dtab = policies_mod.device_tables(self._policies)
             S = self.compiled.num_services
             W = tspec.num_windows
-            downed_w = self._policy_downed_windows(tspec)
-            stuck = faults.stuck_breaker()
-            lag = faults.autoscaler_lag()
-            retry_mask = jnp.asarray(self.compiled.hop_attempt > 0)
             packed = self.params.packed_carries
+            if roll:
+                from isotope_tpu.sim import rollout as rollout_mod
+
+                rdtab = rollout_mod.device_tables(self._rollouts)
+            if with_pol:
+                from isotope_tpu.sim import policies as policies_mod
+
+                pdtab = policies_mod.device_tables(self._policies)
+                # rollout runs split the canary-first kill delta off
+                # the baseline arm the autoscaler manages
+                downed_w = self._policy_downed_windows(
+                    tspec, base_split=roll
+                )
+                stuck = faults.stuck_breaker()
+                lag = faults.autoscaler_lag()
+                retry_mask = jnp.asarray(self.compiled.hop_attempt > 0)
             if attr is not None:
                 from isotope_tpu.metrics import attribution
 
@@ -2219,15 +2444,20 @@ class Simulator:
                        nominal_gap, win_lo, win_hi, tail_cut,
                        visits_pc, phase_windows):
                 telemetry.record_trace(
-                    ("policies", self.signature[3]) + cache_key,
+                    (tag, self.signature[3]) + cache_key,
                     tracing=isinstance(key, jax.core.Tracer),
                     requests=block, hops=self.compiled.num_hops,
                 )
 
                 def body(carry, b):
-                    ((t0, conn_t0, req_off), tl_acc, obs_acc,
-                     pstate, pol_acc, ex) = carry
-                    fx = policies_mod.effects(pstate)
+                    ((t0, conn_t0, req_off), tl_acc, robs_acc,
+                     rstate, roll_acc, pobs_acc, pstate, pol_acc,
+                     ex) = carry
+                    rfx = rollout_mod.effects(rstate) if roll else None
+                    pfx = (
+                        policies_mod.effects(pstate)
+                        if with_pol else None
+                    )
                     kb = jax.random.fold_in(key, 1_000_000 + b)
                     res, t_end, conn_end = self._simulate_core(
                         block, kind, connections, kb, offered_qps,
@@ -2235,7 +2465,8 @@ class Simulator:
                         conn_t0, req_off,
                         visits_pc=visits_pc,
                         phase_windows=phase_windows,
-                        policy_fx=fx,
+                        policy_fx=pfx,
+                        rollout_fx=rfx,
                     )
                     s = summary_mod.summarize(
                         res, collector,
@@ -2247,9 +2478,6 @@ class Simulator:
                             res, tspec, packed=packed
                         ),
                     )
-                    obs_acc = obs_acc + policies_mod.observe_block(
-                        res, tspec, retry_mask
-                    )
                     # closed loop: a window is final only once the
                     # SLOWEST connection passed it — later blocks on
                     # faster connections still write into windows
@@ -2259,13 +2487,32 @@ class Simulator:
                         if kind == CLOSED_LOOP
                         else t_end
                     )
-                    pstate, delta = policies_mod.advance(
-                        pstate, dtab, tl_acc, obs_acc, t_done, tspec,
-                        stuck_breaker=stuck, downed_w=downed_w,
-                    )
-                    pol_acc = policies_mod.accumulate_summary(
-                        pol_acc, delta
-                    )
+                    if roll:
+                        robs_acc = (
+                            robs_acc
+                            + rollout_mod.observe_block(res, tspec)
+                        )
+                        rstate, rdelta = rollout_mod.advance(
+                            rstate, rdtab, robs_acc, t_done, tspec
+                        )
+                        roll_acc = rollout_mod.accumulate_summary(
+                            roll_acc, rdelta
+                        )
+                    if with_pol:
+                        pobs_acc = (
+                            pobs_acc
+                            + policies_mod.observe_block(
+                                res, tspec, retry_mask
+                            )
+                        )
+                        pstate, pdelta = policies_mod.advance(
+                            pstate, pdtab, tl_acc, pobs_acc, t_done,
+                            tspec, stuck_breaker=stuck,
+                            downed_w=downed_w,
+                        )
+                        pol_acc = policies_mod.accumulate_summary(
+                            pol_acc, pdelta
+                        )
                     ys = s
                     if attr is not None:
                         a, ex = attribution.attribute_block(
@@ -2279,7 +2526,8 @@ class Simulator:
                         ys = (s, a)
                     return (
                         (t_end, conn_end, req_off + per),
-                        tl_acc, obs_acc, pstate, pol_acc, ex,
+                        tl_acc, robs_acc, rstate, roll_acc,
+                        pobs_acc, pstate, pol_acc, ex,
                     ), ys
 
                 ex0 = None
@@ -2287,15 +2535,7 @@ class Simulator:
                     k0 = min(top_k, block) if top_k > 0 else 0
                     H = self.compiled.num_hops
                     ex0 = (
-                        attribution.ExemplarBatch(
-                            latency=jnp.full((k0,), -jnp.inf),
-                            start=jnp.zeros((k0,)),
-                            error=jnp.zeros((k0,), bool),
-                            hop_sent=jnp.zeros((k0, H), bool),
-                            hop_error=jnp.zeros((k0, H), bool),
-                            hop_latency=jnp.zeros((k0, H)),
-                            hop_start=jnp.zeros((k0, H)),
-                        )
+                        attribution.empty_exemplars(k0, H)
                         if k0 > 0
                         else None
                     )
@@ -2306,30 +2546,51 @@ class Simulator:
                         jnp.float32(0.0),
                     ),
                     timeline_mod.zeros_summary(tspec, packed=packed),
-                    jnp.zeros((S, W)),
-                    policies_mod.init_state(dtab, lag_periods=lag),
-                    policies_mod.zeros_summary(tspec, S),
+                    jnp.zeros((S, 2, W, 4)) if roll else None,
+                    rollout_mod.init_state(rdtab) if roll else None,
+                    (
+                        rollout_mod.zeros_summary(tspec, S)
+                        if roll else None
+                    ),
+                    jnp.zeros((S, W)) if with_pol else None,
+                    (
+                        policies_mod.init_state(pdtab, lag_periods=lag)
+                        if with_pol else None
+                    ),
+                    (
+                        policies_mod.zeros_summary(tspec, S)
+                        if with_pol else None
+                    ),
                     ex0,
                 )
-                (_, tl_final, _, _, pol_final, ex_final), ys = (
-                    jax.lax.scan(body, carry0, jnp.arange(num_blocks))
-                )
+                (
+                    (_, tl_final, robs_final, _, roll_final, _, _,
+                     pol_final, ex_final),
+                    ys,
+                ) = jax.lax.scan(body, carry0, jnp.arange(num_blocks))
+                if roll:
+                    roll_final = rollout_mod.attach_observations(
+                        roll_final, robs_final
+                    )
                 if attr is not None:
                     parts, aparts = ys
-                    return (
-                        summary_mod.reduce_stacked(parts),
-                        tl_final,
-                        pol_final,
-                        attribution.reduce_stacked(aparts, ex_final),
+                    summary = summary_mod.reduce_stacked(parts)
+                    a_out = attribution.reduce_stacked(
+                        aparts, ex_final
                     )
-                return (
-                    summary_mod.reduce_stacked(ys),
-                    tl_final,
-                    pol_final,
-                )
+                else:
+                    summary = summary_mod.reduce_stacked(ys)
+                out = (summary, tl_final)
+                if roll:
+                    out = out + (roll_final,)
+                if with_pol:
+                    out = out + (pol_final,)
+                if attr is not None:
+                    out = out + (a_out,)
+                return out
 
             self._summary_fns[cache_key] = executable_cache.get_or_build(
-                ("policies", self.signature) + cache_key,
+                (tag, self.signature) + cache_key,
                 lambda: telemetry.time_first_call(
                     jax.jit(scanfn), "compile.jit_first_call"
                 ),
@@ -2696,15 +2957,7 @@ class Simulator:
                     k0 = min(top_k, block) if top_k > 0 else 0
                     H = self.compiled.num_hops
                     ex0 = (
-                        attribution.ExemplarBatch(
-                            latency=jnp.full((k0,), -jnp.inf),
-                            start=jnp.zeros((k0,)),
-                            error=jnp.zeros((k0,), bool),
-                            hop_sent=jnp.zeros((k0, H), bool),
-                            hop_error=jnp.zeros((k0, H), bool),
-                            hop_latency=jnp.zeros((k0, H)),
-                            hop_start=jnp.zeros((k0, H)),
-                        )
+                        attribution.empty_exemplars(k0, H)
                         if k0 > 0
                         else None
                     )
@@ -2812,6 +3065,7 @@ class Simulator:
         visits_pc: Optional[jax.Array] = None,
         phase_windows: Optional[jax.Array] = None,
         policy_fx=None,  # Optional[policies.PolicyFx]
+        rollout_fx=None,  # Optional[rollout.RolloutFx]
     ) -> Tuple[SimResults, jax.Array, jax.Array]:
         """``offered_qps`` drives the queueing model (the rate the whole
         fleet of services sees); ``arrival_qps`` paces this batch's
@@ -2868,6 +3122,22 @@ class Simulator:
                     jax.random.uniform(k_retry, (n, H))
                     < allow_h[None, :]
                 )
+        # -- rollout version coin (sim/rollout.py) -------------------------
+        # Each hop routes to the CANARY arm with the controller's
+        # CURRENT traffic weight for its service (0 everywhere a
+        # service has no active rollout, and 0 during cooldown /
+        # failed).  Folded key, same discipline as the policy coins: a
+        # rollout-actuated run differs from its open-loop twin only by
+        # the rollout effects, never by RNG re-shuffling.
+        can_coin = None
+        if rollout_fx is not None:
+            w_h = rollout_fx.weight[self._hop_service]  # (H,)
+            can_coin = (
+                jax.random.uniform(
+                    jax.random.fold_in(key, 880_001), (n, H)
+                )
+                < w_h[None, :]
+            )
         # Wait draws: the saturated path (sat_conns > 0) consumes unit
         # NORMALS (its copulas compose in normal space); the open-loop
         # law consumes uniforms.  Either way the copulas — exact U(0,1)
@@ -3060,10 +3330,41 @@ class Simulator:
                 lam_pc = lam_pc * (1.0 - policy_fx.shed)[None, :]
             if pol.any_hpa or pol.any_ejection:
                 # autoscaled/ejected capacity composes with the chaos
-                # phases' down deltas; every station keeps >= 1 server
+                # phases' down deltas; every station keeps >= 1 server.
+                # Under a rollout the kill takes CANARY replicas first,
+                # so the HPA-scaled BASELINE arm only absorbs the
+                # remainder of the delta.
+                downed = (
+                    self._downed_base_pc
+                    if rollout_fx is not None and self.has_chaos
+                    else self._downed_pc
+                )
                 eff_replicas_pc = jnp.maximum(
-                    policy_fx.replicas[None, :] - self._downed_pc, 1.0
+                    policy_fx.replicas[None, :] - downed, 1.0
                 ).astype(jnp.int32)
+        if rollout_fx is not None:
+            # -- two-version split (sim/rollout.py): the canary arm is
+            # its OWN M/M/k station fed the split-off admitted load
+            # (the same admission-weight multiplication the breaker
+            # shed uses), with its own replica count and cpu-time
+            # override; the baseline station keeps the complement.
+            # Un-rolled-out services have weight 0, so their baseline
+            # row is untouched and their canary row is load-free.
+            w_row = rollout_fx.weight[None, :]  # (1, S)
+            qp_can = queueing.mmk_params(
+                lam_pc * w_row,
+                self._canary_mu,
+                self._can_reps_pc,
+                self._k_max,
+            )
+            lam_pc = lam_pc * (1.0 - w_row)
+            if self.has_chaos and not (
+                policy_fx is not None
+                and (pol.any_hpa or pol.any_ejection)
+            ):
+                # static baseline capacity under chaos: the canary-
+                # first split's remainder, not the full-delta table
+                eff_replicas_pc = self._eff_base_roll_pc
         qp = queueing.mmk_params(
             lam_pc,
             self._mu,
@@ -3071,6 +3372,10 @@ class Simulator:
             self._k_max,
         )
         svc_down_pc = self._svc_down_pc
+        if rollout_fx is not None and self.has_chaos:
+            # baseline-arm outage flags (canary downs selected per hop
+            # below); utilization reporting follows the baseline arm
+            svc_down_pc = self._svc_down_base_roll_pc
         hop_svc = self._hop_service  # (H,)
         # Per-hop parameter tables are tiny (P*Cc, H); expanding them over
         # the request axis with a direct (N, H) 2D gather is catastrophically
@@ -3080,6 +3385,16 @@ class Simulator:
         p_wait_ph = qp.p_wait[:, hop_svc]        # (P*Cc, H)
         wait_rate_ph = qp.wait_rate[:, hop_svc]  # (P*Cc, H)
         down_ph = svc_down_pc[:, hop_svc]        # (P*Cc, H) bool
+        if rollout_fx is not None:
+            # canary-station tables, merged per HOP by the version coin
+            # after the phase expansion below
+            p_wait_c_ph = qp_can.p_wait[:, hop_svc]
+            rate_c_ph = qp_can.wait_rate[:, hop_svc]
+            down_c_ph = (
+                self._svc_down_can_pc[:, hop_svc]
+                if self.has_chaos
+                else None
+            )
         num_phases = P * Cc
         if num_phases == 1:
             p_wait_nh = p_wait_ph[0][None, :]
@@ -3089,6 +3404,17 @@ class Simulator:
                 if self.has_chaos
                 else None
             )
+            if rollout_fx is not None:
+                p_wait_nh = jnp.where(
+                    can_coin, p_wait_c_ph[0][None, :], p_wait_nh
+                )
+                wait_rate_nh = jnp.where(
+                    can_coin, rate_c_ph[0][None, :], wait_rate_nh
+                )
+                if down_c_ph is not None:
+                    down = jnp.where(
+                        can_coin, down_c_ph[0][None, :], down
+                    )
         else:
             if P > 1:
                 # phase WINDOWS, not raw cuts: drain windows keep an
@@ -3124,6 +3450,26 @@ class Simulator:
                 if self.has_chaos
                 else None
             )
+            if rollout_fx is not None:
+                p_wait_nh = jnp.where(
+                    can_coin,
+                    jnp.matmul(oh, p_wait_c_ph, precision=hi),
+                    p_wait_nh,
+                )
+                wait_rate_nh = jnp.where(
+                    can_coin,
+                    jnp.matmul(oh, rate_c_ph, precision=hi),
+                    wait_rate_nh,
+                )
+                if down_c_ph is not None:
+                    down = jnp.where(
+                        can_coin,
+                        jnp.matmul(
+                            oh, down_c_ph.astype(jnp.float32),
+                            precision=hi,
+                        ) > 0.5,
+                        down,
+                    )
         if sat_conns:
             # finite-population law: per-hop quantile polynomial in
             # v = -log(1 - u') — Horner with per-hop coefficient rows,
@@ -3213,11 +3559,29 @@ class Simulator:
         unstable_phase = jnp.where(svc_down_pc, False, qp.unstable)
 
         svc_time = self._sample_service_time(k_svc, (n, H))
+        if can_coin is not None and self._canary_cpu_varies:
+            # canary cpu_time override: a multiplicative rescale keeps
+            # the configured service-time SHAPE (exp/lognormal/pareto)
+            # while moving the mean to the canary's cpu demand
+            svc_time = jnp.where(
+                can_coin,
+                svc_time * self._canary_cpu_ratio_h[None, :],
+                svc_time,
+            )
 
         # None == "statically no 500s" (all error rates are zero)
-        err_coin = (
-            u_err < self._hop_err_rate if u_err is not None else None
-        )  # (N, H) or None
+        if u_err is None:
+            err_coin = None
+        elif can_coin is not None:
+            # per-arm error rates: a canary hop draws against its own
+            # override (baseline-substituted where none was declared)
+            err_coin = u_err < jnp.where(
+                can_coin,
+                self._canary_err_h[None, :],
+                self._hop_err_rate[None, :],
+            )  # (N, H)
+        else:
+            err_coin = u_err < self._hop_err_rate  # (N, H)
         if shed_coin is not None:
             # breaker sheds ride the errorRate path exactly: fast 500,
             # script skipped, nothing sent downstream, and — matching
@@ -3700,26 +4064,51 @@ class Simulator:
 
         # ---- downward pass: which hops actually execute ------------------
         # a down ENTRY service refuses the client's connection itself
+        # rollout runs additionally track REFUSED hops (would-send but
+        # target down): the canary gates charge a killed arm's
+        # transport failures to that arm (observe_block)
+        track_refused = rollout_fx is not None
         if down is not None:
             root_down = down[:, 0]
             sent_cur: jax.Array = ~root_down[:, None]
+            refused_cur = root_down[:, None]
         else:
             root_down = None
             sent_cur = jnp.ones((n, 1), bool)
+            refused_cur = jnp.zeros((n, 1), bool)
         last_level = len(self._levels) - 1
         sent_chunks: List[jax.Array] = []
+        refused_chunks: List[jax.Array] = []
         for si, seg in enumerate(self._segments):
             if isinstance(seg, levelscan.ScanBucket):
-                own, sent_cur = levelscan.sent_sweep(
-                    ctx, seg, bucket_ys[si],
-                    levelscan.pad_cols(sent_cur, seg.plan.bound_hops),
-                )
+                if track_refused:
+                    own, ref_own, sent_cur, refused_cur = (
+                        levelscan.sent_sweep(
+                            ctx, seg, bucket_ys[si],
+                            levelscan.pad_cols(
+                                sent_cur, seg.plan.bound_hops
+                            ),
+                            refused_init=levelscan.pad_cols(
+                                refused_cur, seg.plan.bound_hops
+                            ),
+                        )
+                    )
+                    refused_chunks.append(
+                        levelscan.gather_levels(ref_own, seg.sizes)
+                    )
+                else:
+                    own, sent_cur = levelscan.sent_sweep(
+                        ctx, seg, bucket_ys[si],
+                        levelscan.pad_cols(sent_cur, seg.plan.bound_hops),
+                    )
                 sent_chunks.append(
                     levelscan.gather_levels(own, seg.sizes)
                 )
                 continue
             d = seg.d
             sent_chunks.append(sent_cur)
+            if track_refused:
+                refused_chunks.append(refused_cur)
             if d >= last_level:
                 continue
             lvl = self._levels[d]
@@ -3737,7 +4126,10 @@ class Simulator:
             if used_lvls[d] is not None:
                 sent = sent & used_lvls[d]
             if down is not None:
+                refused_cur = sent & down[:, csl]
                 sent = sent & ~down[:, csl]
+            else:
+                refused_cur = jnp.zeros_like(sent)
             sent_cur = sent
 
         # ---- closed-loop arrivals (need latencies) -----------------------
@@ -3833,6 +4225,11 @@ class Simulator:
                     else jnp.zeros((n, self._levels[d].size), bool)
                 )
         hop_sent = jnp.concatenate(sent_chunks, axis=1)
+        hop_refused = (
+            jnp.concatenate(refused_chunks, axis=1)
+            if track_refused
+            else None
+        )
         hop_lat = jnp.concatenate(lat_chunks, axis=1)
         hop_start = jnp.concatenate(start_chunks, axis=1)
         err_hop = jnp.concatenate(err_chunks, axis=1)
@@ -3887,6 +4284,8 @@ class Simulator:
                 if self.params.attribution or self.params.timeline
                 else None
             ),
+            hop_canary=can_coin,
+            hop_refused=hop_refused,
         )
         t_end = conn_end.max() if kind == CLOSED_LOOP else arrivals[-1]
         return res, t_end, conn_end

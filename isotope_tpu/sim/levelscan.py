@@ -465,13 +465,19 @@ def sent_sweep(
     b: ScanBucket,
     ys: Dict[str, jax.Array],
     sent_init: jax.Array,
-) -> Tuple[jax.Array, jax.Array]:
+    refused_init: Optional[jax.Array] = None,
+):
     """Downward sent-propagation over one bucket.
 
     ``sent_init`` is level ``d0``'s sent mask padded to the bound.
     Returns ``(own, carry)``: the bucket's stacked per-level sent masks
     (levels d0..d1, depth order) and level ``d1+1``'s sent mask (real
     width) for the next segment.
+
+    With ``refused_init`` (level ``d0``'s refused mask, padded — the
+    rollout co-sim's would-send-but-target-down track) the sweep ALSO
+    emits per-level refused masks and returns
+    ``(own, refused_own, sent_carry, refused_carry)``.
     """
     B = b.plan.bound_hops
     seg_err = segment_slice(ctx.err_coin, b)
@@ -486,6 +492,7 @@ def sent_sweep(
         xs["fail"] = ys["fail"]
     if "used" in ys:
         xs["used"] = ys["used"]
+    track_refused = refused_init is not None
 
     def body(sent_p, x):
         sent = sent_p[:, x["cpl"]]
@@ -497,14 +504,33 @@ def sent_sweep(
         if "used" in x:
             sent = sent & x["used"]
         if seg_down is not None:
-            sent = sent & ~_dslice(seg_down, x["choff"], B)
+            dmask = _dslice(seg_down, x["choff"], B)
+            refused = sent & dmask
+            sent = sent & ~dmask
+        else:
+            refused = jnp.zeros_like(sent)
+        if track_refused:
+            return sent, (sent, refused)
         return sent, sent
 
-    _, sent_next = jax.lax.scan(body, sent_init, xs)
+    if track_refused:
+        _, (sent_next, refused_next) = jax.lax.scan(body, sent_init, xs)
+    else:
+        _, sent_next = jax.lax.scan(body, sent_init, xs)
     own = jnp.concatenate(
         [sent_init[None], sent_next[: b.num_levels - 1]], axis=0
     )
-    return own, sent_next[-1][:, : b.child_size]
+    if not track_refused:
+        return own, sent_next[-1][:, : b.child_size]
+    refused_own = jnp.concatenate(
+        [refused_init[None], refused_next[: b.num_levels - 1]], axis=0
+    )
+    return (
+        own,
+        refused_own,
+        sent_next[-1][:, : b.child_size],
+        refused_next[-1][:, : b.child_size],
+    )
 
 
 def start_sweep(

@@ -49,41 +49,22 @@ traces byte-identical programs (pinned, like ``timeline=off``).
 from __future__ import annotations
 
 import dataclasses
+import functools
 from typing import Dict, List, NamedTuple, Optional, Tuple
 
 import numpy as np
 
+from isotope_tpu.models.decode import (
+    duration_s as _dur,
+    field as _field,
+    fraction as _frac,
+    integer as _int,
+    number as _num,
+)
 from isotope_tpu.models.errors import config_path
-from isotope_tpu.models.pct import Percentage
-from isotope_tpu.utils import duration as dur
 
 
 # -- policy configuration (the topology YAML `policies:` block) -----------
-
-
-def _dur(value) -> float:
-    if isinstance(value, str):
-        return dur.parse_duration_seconds(value)
-    if isinstance(value, bool) or not isinstance(value, (int, float)):
-        raise ValueError(f"expected a duration: {value!r}")
-    return float(value)
-
-
-def _frac(value) -> float:
-    """A fraction in [0, 1]: a number, or a percent string ("60%")."""
-    return float(Percentage.decode(value))
-
-
-def _num(value) -> float:
-    if isinstance(value, bool) or not isinstance(value, (int, float)):
-        raise ValueError(f"expected a number: {value!r}")
-    return float(value)
-
-
-def _int(value) -> int:
-    if isinstance(value, bool) or not isinstance(value, int):
-        raise ValueError(f"expected an integer: {value!r}")
-    return value
 
 
 @dataclasses.dataclass(frozen=True)
@@ -118,11 +99,7 @@ class CircuitBreakerPolicy:
         if unknown:
             raise ValueError(f"unknown breaker fields: {sorted(unknown)}")
 
-        def field(key, decode, fallback):
-            if key not in value or value[key] is None:
-                return fallback
-            with config_path(key):
-                return decode(value[key])
+        field = functools.partial(_field, value)
 
         out = cls(
             max_pending=field("max_pending", _num, None),
@@ -169,11 +146,7 @@ class RetryBudgetPolicy:
                 f"unknown retry_budget fields: {sorted(unknown)}"
             )
 
-        def field(key, decode, fallback):
-            if key not in value or value[key] is None:
-                return fallback
-            with config_path(key):
-                return decode(value[key])
+        field = functools.partial(_field, value)
 
         out = cls(
             budget_percent=field("budget_percent", _frac, 0.2),
@@ -223,11 +196,7 @@ class AutoscalerPolicy:
                 f"unknown autoscaler fields: {sorted(unknown)}"
             )
 
-        def field(key, decode, fallback):
-            if key not in value or value[key] is None:
-                return fallback
-            with config_path(key):
-                return decode(value[key])
+        field = functools.partial(_field, value)
 
         out = cls(
             min_replicas=field("min_replicas", _int, 1),

@@ -430,6 +430,31 @@ def test_timeline_overhead_not_a_rate_key(tmp_path, monkeypatch):
     assert run_gate(tmp_path, monkeypatch, new, base) == 0
 
 
+def test_rollout_marker_not_a_rate_key(tmp_path, monkeypatch):
+    # the `<case>_rollout` marker (bench.py rollout50: the rollout
+    # co-sim served the windows) is evidence, not a rate — and the
+    # clean-case degradation gate covers the rollout-enabled case
+    # through its telemetry block like any other
+    base = capture(2.0e9, {"rollout50": 2.0e9, "rollout50_best": 2.1e9,
+                           "rollout50_rollout": 1,
+                           "rollout50_telemetry": {}})
+    new = capture(2.0e9, {"rollout50": 2.0e9, "rollout50_best": 2.1e9,
+                          "rollout50_rollout": 1,
+                          "rollout50_telemetry": {}})
+    assert run_gate(tmp_path, monkeypatch, new, base) == 0
+
+
+def test_rollout_case_degradation_gates(tmp_path, monkeypatch):
+    base = capture(2.0e9, {"rollout50": 2.0e9, "rollout50_best": 2.1e9,
+                           "rollout50_rollout": 1,
+                           "rollout50_telemetry": {}})
+    new = capture(2.0e9, {"rollout50": 2.0e9, "rollout50_best": 2.1e9,
+                          "rollout50_rollout": 1,
+                          "rollout50_telemetry": {
+                              "degraded_to": "half-block"}})
+    assert run_gate(tmp_path, monkeypatch, new, base) == 1
+
+
 def test_layout_gate_off_by_default(tmp_path, monkeypatch):
     base = capture(2.0e9, {"svc1000": 2.0e9, "svc1000_best": 2.1e9,
                            "_mesh_layout": "data=2,svc=4",
