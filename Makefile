@@ -8,7 +8,7 @@ DURATION ?= 120s
 
 .PHONY: test lint vet-smoke bench telemetry-smoke resilience-smoke \
 	attribution-smoke sparse-smoke timeline-smoke multihost-smoke \
-	policies-smoke rollout-smoke examples canonical tree star \
+	policies-smoke rollout-smoke lb-smoke examples canonical tree star \
 	multitier auxiliary-services star-auxiliary latency cpu_mem dot \
 	clean
 
@@ -184,6 +184,13 @@ policies-smoke:
 # be bit-equal to the emulated twin.
 rollout-smoke:
 	$(PY) tools/rollout_smoke.py
+
+# load-balancing end-to-end check (sim/lb.py): least-request beats the
+# shared-queue fifo tail (and the mis-weighted hot pool) at rho ~0.9,
+# prints the per-window per-backend load split, and panic routing
+# keeps goodput nonzero through a 3/4-replica ejection storm
+lb-smoke:
+	$(PY) tools/lb_smoke.py
 
 examples:
 	$(PY) tools/gen_examples.py

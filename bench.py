@@ -69,6 +69,7 @@ CASE_ORDER = [
     "realistic50",
     "rollout50",
     "svc10k",
+    "svc10k_protected",
     "star10k",
     "svc100k_chaos",
     "svc10k_cfg3_10M",
@@ -446,6 +447,76 @@ def run_case(name: str) -> dict:
         med, spread, best, first_s = measure(
             sim, LoadModel(kind="open", qps=1000.0), b * 4, b
         )
+    elif name == "svc10k_protected":
+        # protected svc10k through the DEFAULT scan-bucket plan: the
+        # retry-budget gate reached the bucket attempt loop
+        # (sim/levelscan.py), so Simulator(policies=...) no longer
+        # forces the unrolled trace — this case exists for GATE
+        # COVERAGE of that path at scale (cfg3-style timeouts +
+        # entry-subtree retries, a retry-budget default, and a
+        # least-request lb law on a mid-tier service).  Its telemetry
+        # block carries degraded_to like every case (the
+        # previously-clean-case gate must see the protected program
+        # complete through scan buckets undegraded), and the
+        # `<case>_lb` marker records that the lb-law wait physics, not
+        # the plain M/M/k path, produced the number.
+        from isotope_tpu.compiler import compile_lb, compile_policies
+        from isotope_tpu.compiler.buckets import ScanBucketPlan
+
+        doc = with_call_policy(
+            realistic_topology(10_000, archetype="multitier", seed=0),
+            timeout="30s",
+        )
+        kids: dict = {}
+        for svc in doc["services"]:
+            kids[svc["name"]] = [
+                c["call"]["service"] for c in svc.get("script", [])
+                if isinstance(c, dict) and "call" in c
+            ]
+
+        def psub(name, _memo={}):
+            if name not in _memo:
+                _memo[name] = 1 + sum(psub(c) for c in kids[name])
+            return _memo[name]
+
+        pcalls = [
+            c for c in doc["services"][0].get("script", [])
+            if isinstance(c, dict) and "call" in c
+        ]
+        for cmd in sorted(
+            pcalls, key=lambda c: psub(c["call"]["service"])
+        )[:2]:
+            cmd["call"]["retries"] = 2
+        mid = doc["services"][1]["name"]
+        doc["policies"] = {
+            "defaults": {"retry_budget": {"budget_percent": "20%"}},
+            mid: {"lb": {"policy": "least_request", "choices_d": 2,
+                         "panic_threshold": "30%"}},
+        }
+        g = ServiceGraph.decode(doc)
+        compiled = compile_graph(g)
+        sim = Simulator(
+            compiled, SimParams(timeline=True),
+            policies=compile_policies(g, compiled),
+            lb=compile_lb(g, compiled),
+        )
+        if not any(isinstance(p, ScanBucketPlan) for p in sim._plan):
+            raise RuntimeError(
+                "svc10k_protected must plan scan buckets (the lifted "
+                "restriction is the thing under test)"
+            )
+
+        def prot_runner(s_, l_, n_, k_, b_):
+            return s_.run_policies(
+                l_, n_, k_, block_size=b_, window_s=1.0
+            )[0]
+
+        b = sim.default_block_size()
+        med, spread, best, first_s = measure(
+            sim, LoadModel(kind="open", qps=1000.0), b * 2, b,
+            warm=2, iters=2, runner=prot_runner,
+        )
+        out[f"{name}_lb"] = 1
     elif name == "star10k":
         # the star archetype's skewed hub level runs via the sparse
         # call-slot encoding — dense grids made it block-starved

@@ -183,6 +183,7 @@ def lint_graph(
 
     findings.extend(_lint_policies(graph, params))
     findings.extend(_lint_rollouts(graph, params))
+    findings.extend(_lint_lb(graph, params))
     return findings
 
 
@@ -351,6 +352,93 @@ def _lint_rollouts(graph: ServiceGraph, params) -> List[Finding]:
                 "--timeline)",
                 path=f"rollouts.{name}.bake",
             ))
+    return findings
+
+
+def _lint_lb(graph: ServiceGraph, params) -> List[Finding]:
+    """Load-balancing misconfiguration rules (VET-T019..T022) over the
+    topology's per-service ``lb:`` entries (sim/lb.py).
+
+    VET-T019: ``choices_d`` exceeds the replica count — power-of-d
+    sampling cannot draw more distinct backends than exist, so the law
+    silently degenerates to full-pool least-request (JSQ); VET-T020:
+    ring-hash on a single-replica service — every key maps to the one
+    backend, stickiness is a no-op (info); VET-T021: a panic threshold
+    of 1.0 or above (every run starts panicked — error), or one the
+    breaker's ``max_ejection_fraction`` can never reach (ejection
+    leaves ``1 - max_ejection_fraction`` healthy, so panic is dead
+    code under ejection-only unhealth — warn); VET-T022: lb entries
+    that do not decode at all.
+    """
+    if not getattr(graph, "policies", None):
+        return []
+    # lazy: keeps the no-lb lint path jax-free
+    from isotope_tpu.sim import lb as lb_mod
+    from isotope_tpu.sim import policies as policies_mod
+
+    findings: List[Finding] = []
+    names = [s.name for s in graph.services]
+    lbs, problems = lb_mod.lint_lb(graph.policies, names)
+    for _, msg in problems:
+        findings.append(Finding(
+            "VET-T022", SEV_ERROR,
+            f"lb entries do not decode: {msg}",
+            path="policies",
+        ))
+    if lbs is None or lbs.empty:
+        return findings
+    pset, _ = policies_mod.lint_policies(graph.policies, names)
+    replicas = {s.name: max(1, s.num_replicas) for s in graph.services}
+    for name in names:
+        p = lbs.for_service(name)
+        if p is None or not p.active:
+            continue
+        k = replicas[name]
+        if p.policy == "least_request" and p.choices_d > k:
+            findings.append(Finding(
+                "VET-T019", SEV_WARN,
+                f"lb choices_d={p.choices_d} on {name!r} exceeds its "
+                f"{k} replica(s): power-of-d cannot sample more "
+                "distinct backends than exist — the law degenerates "
+                "to full-pool least-request (lower choices_d or add "
+                "replicas)",
+                path=f"policies.{name}.lb.choices_d",
+            ))
+        if p.policy == "ring_hash" and k <= 1:
+            findings.append(Finding(
+                "VET-T020", SEV_INFO,
+                f"ring_hash on {name!r} with replicas: 1 — every key "
+                "maps to the single backend, so hash stickiness (and "
+                "hash_skew) is a no-op",
+                path=f"policies.{name}.lb",
+            ))
+        if p.panic_threshold >= 1.0:
+            findings.append(Finding(
+                "VET-T021", SEV_ERROR,
+                f"panic_threshold={p.panic_threshold:g} on {name!r}: "
+                "the healthy fraction is always < 1.0 under any "
+                "unhealth, so the pool PANICS from the first ejection "
+                "or kill (thresholds are fractions in [0, 1))",
+                path=f"policies.{name}.lb.panic_threshold",
+            ))
+        elif p.panic_threshold > 0.0 and pset is not None:
+            b = pset.for_service(name).breaker
+            if (
+                b is not None
+                and b.consecutive_errors > 0
+                and 1.0 - b.max_ejection_fraction >= p.panic_threshold
+            ):
+                findings.append(Finding(
+                    "VET-T021", SEV_WARN,
+                    f"panic_threshold={p.panic_threshold:g} on "
+                    f"{name!r} is unreachable via outlier ejection: "
+                    f"max_ejection_fraction={b.max_ejection_fraction:g}"
+                    f" leaves {1.0 - b.max_ejection_fraction:g} of the"
+                    " pool healthy, above the threshold — panic only "
+                    "fires under chaos kills (raise the threshold or "
+                    "the ejection cap)",
+                    path=f"policies.{name}.lb.panic_threshold",
+                ))
     return findings
 
 

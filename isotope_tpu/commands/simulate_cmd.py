@@ -229,6 +229,12 @@ def register(sub) -> None:
                         "series, promote/hold/rollback sim-time "
                         "onsets, per-arm error shares) as JSON "
                         "(isotope-rollout/v1)")
+    s.add_argument("--lb-out", metavar="FILE", default=None,
+                   help="write the load-balancing laws + per-window "
+                        "per-backend load split as JSON "
+                        "(isotope-lb/v1); laws come from the "
+                        "topology's per-service `lb:` entries and "
+                        "apply to EVERY run kind (no flag needed)")
     s.add_argument("--timeline-out", metavar="FILE", default=None,
                    help="write the windowed series as JSON "
                         "(isotope-timeline/v1)")
@@ -432,6 +438,20 @@ def run_simulate(args) -> int:
         print(
             "warning: --rollouts set but the topology declares no "
             "active rollouts block (open-loop run)",
+            file=sys.stderr,
+        )
+    if result.lb is not None:
+        from isotope_tpu.sim import lb as lb_mod
+
+        print(lb_mod.format_table(result.lb), file=sys.stderr)
+        if args.lb_out:
+            with open(args.lb_out, "w") as f:
+                json.dump(result.lb, f, indent=2)
+            print(f"lb -> {args.lb_out}", file=sys.stderr)
+    elif args.lb_out:
+        print(
+            "warning: --lb-out set but the topology declares no "
+            "lb entries (fifo everywhere)",
             file=sys.stderr,
         )
     if (tl_window is not None or args.policies or args.rollouts) \

@@ -30,6 +30,7 @@ from isotope_tpu.compiler.compile import (
     HopBudgetExceededError,
     NoEntrypointError,
     compile_graph,
+    compile_lb,
     compile_policies,
     compile_rollouts,
 )
@@ -45,6 +46,7 @@ __all__ = [
     "HopBudgetExceededError",
     "NoEntrypointError",
     "compile_graph",
+    "compile_lb",
     "compile_policies",
     "compile_rollouts",
     "enable_persistent_cache",

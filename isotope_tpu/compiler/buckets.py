@@ -288,6 +288,12 @@ def plan_segments(
     Runs shorter than ``MIN_SCAN_LEVELS`` fall back to unrolled
     segments either way, and results are bit-identical across plans
     (the executor contract — only wall-clock changes).
+
+    ``enabled`` carries only ``SimParams.bucketed_scan``: protected
+    (policies/rollouts) Simulators plan buckets like any other since
+    the retry-budget gate reached the scan body
+    (sim/levelscan.SweepCtx.retry_coin) — the old
+    ``and policies is None`` restriction is gone.
     """
     segs: List[Segment] = []
     n = len(shapes)

@@ -146,6 +146,28 @@ def compile_policies(graph: ServiceGraph, compiled: CompiledGraph):
     return tables
 
 
+def compile_lb(graph: ServiceGraph, compiled: CompiledGraph):
+    """Lower a topology's per-service ``lb:`` entries (inside the
+    ``policies:`` block) to dense per-service tables in COMPILED
+    service order (sim/lb.LbTables) — the device-constant form the
+    engine's per-station wait-law selection consumes.
+
+    Returns ``None`` when no service declares an ``lb:`` law (the
+    engine's byte-identical default path).  Decode errors carry key
+    paths (``policies.worker.lb.choices_d: ...``).
+    """
+    if not graph.policies:
+        return None
+    from isotope_tpu.sim import lb as lb_mod
+
+    lbs = lb_mod.LbSet.decode(graph.policies, compiled.services.names)
+    if lbs.empty:
+        return None
+    tables = lb_mod.build_tables(lbs, compiled.services)
+    telemetry.counter_inc("lb_compiled")
+    return tables
+
+
 def compile_rollouts(graph: ServiceGraph, compiled: CompiledGraph):
     """Lower a topology's ``rollouts:`` block to dense per-service
     tables in COMPILED service order (sim/rollout.RolloutTables) — the

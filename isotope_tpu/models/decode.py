@@ -42,6 +42,16 @@ def integer(value) -> int:
     return value
 
 
+def keyword(value, options) -> str:
+    """A string drawn from a closed vocabulary (e.g. the ``lb:`` law
+    names) — anything else names the valid options in the error."""
+    if not isinstance(value, str) or value not in options:
+        raise ValueError(
+            f"expected one of {'/'.join(options)}: {value!r}"
+        )
+    return value
+
+
 def field(mapping: dict, key: str, decode, fallback):
     """Decode ``mapping[key]`` under a key-pathed error context, or the
     fallback when the key is absent or explicitly ``null``."""

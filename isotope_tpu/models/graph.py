@@ -59,11 +59,12 @@ class ServiceGraph:
     # Retained so encode() can round-trip the defaults block.
     defaults: dict = dataclasses.field(default_factory=dict)
     # Raw ``policies:`` block (in-graph resilience policies — circuit
-    # breakers, retry budgets, HPA autoscalers; sim/policies.py).  Kept
+    # breakers, retry budgets, HPA autoscalers; sim/policies.py — plus
+    # the per-service ``lb:`` load-balancing laws; sim/lb.py).  Kept
     # raw here so host-only consumers (converters, encode round-trip)
     # never pay the decode; the compiler lowers it to dense per-service
-    # tables (compiler/compile.py compile_policies) with key-pathed
-    # validation errors.
+    # tables (compiler/compile.py compile_policies / compile_lb) with
+    # key-pathed validation errors.
     policies: dict = dataclasses.field(default_factory=dict)
     # Raw ``rollouts:`` block (reactive canary rollouts — per-service
     # step schedules, SLO gates, rollback policies, canary physics

@@ -109,6 +109,7 @@ class ShardedSimulator:
         mtls=None,
         policies=None,  # Optional[sim.policies.PolicyTables]
         rollouts=None,  # Optional[sim.rollout.RolloutTables]
+        lb=None,  # Optional[sim.lb.LbTables]
     ):
         self.compiled = compiled
         self.mesh = mesh
@@ -121,8 +122,15 @@ class ShardedSimulator:
         # set): the sharded sweep programs are the most expensive
         # compiles in the system, so wire the disk cache here too
         enable_persistent_cache()
+        # lb laws ride _simulate_core's per-station wait selection, so
+        # the device path and the emulated twin stay bit-equal with no
+        # extra collectives: the per-backend census the laws consume is
+        # derived from the ALREADY psum-merged recorder windows (the
+        # control-state advance sees global signals), and the profile /
+        # panic tables are replicated trace constants
         self.sim = Simulator(compiled, params, chaos, churn, mtls=mtls,
-                             policies=policies, rollouts=rollouts)
+                             policies=policies, rollouts=rollouts,
+                             lb=lb)
         self.collector = MetricsCollector(compiled)
         if SVC_AXIS not in mesh.axis_names:
             raise ValueError(
@@ -214,6 +222,9 @@ class ShardedSimulator:
                   offered_qps=None, block_size: int = 65_536,
                   trim: bool = False) -> _RunPlan:
         """Resolve the physical run shape (see :class:`_RunPlan`)."""
+        # every sharded entry point plans here: lb preconditions (no
+        # saturated loads) + the lb.degraded_backend fault site
+        self.sim._check_lb_load(load)
         n_local = -(-num_requests // self.n_shards)
         if load.kind == OPEN_LOOP:
             offered = float(load.qps)

@@ -26,17 +26,22 @@ comma-separated ``kind:site[:arg]`` entries:
   closes (its shed fraction only ratchets up);
 - ``lag:policies.autoscaler_lag[:N]`` — the autoscaler control loop
   misses its first ``N`` sync periods (default 1) — the
-  HPA-controller-restart failure mode.
+  HPA-controller-restart failure mode;
+- ``degrade:lb.degraded_backend[:B]`` — BEHAVIORAL chaos against the
+  load-balancing laws (sim/lb.py): backend ``B``'s (default 0)
+  effective attraction weight silently collapses to 1% — the classic
+  gray-failure LB scenario (a ring-hash arc shrinks, wrr skips the
+  pod) the profile-free least_request law routes around.
 
 Sites are the supervisor's phase names: ``engine.build``,
 ``engine.run``, ``sharded.args_put``, ``sharded.compute``,
 ``sharded.dcn_collective`` (DCN-axis meshes only — the dropped
 cross-host collective), ``sharded.gather``, ``cache.load``, plus the
 policy-layer sites ``policies.stuck_breaker`` /
-``policies.autoscaler_lag`` — the standard kinds (oom / transient /
-corrupt) may target those too, raising a taxonomy-classified fault at
-the policy run's entry so the supervisor's retry path covers the
-policy layer.  ``check(site)`` is a dict lookup
+``policies.autoscaler_lag`` / ``lb.degraded_backend`` — the standard
+kinds (oom / transient / corrupt) may target those too, raising a
+taxonomy-classified fault at the protected run's entry so the
+supervisor's retry path covers the policy AND lb layers.  ``check(site)`` is a dict lookup
 returning immediately when no plan is armed — the default no-fault
 path gains zero work and zero sync points.
 """
@@ -56,7 +61,8 @@ from isotope_tpu.resilience.taxonomy import (
 
 ENV_FAULT_INJECT = "ISOTOPE_FAULT_INJECT"
 
-KINDS = ("oom", "transient", "corrupt", "nan", "stuck", "lag")
+KINDS = ("oom", "transient", "corrupt", "nan", "stuck", "lag",
+         "degrade")
 
 #: every instrumented ``check(site)`` call site in the engine — the
 #: closed universe a spec may target.  A typo'd site used to parse
@@ -80,6 +86,11 @@ VALID_SITES = (
     # control program instead of raising
     "policies.stuck_breaker",
     "policies.autoscaler_lag",
+    # the LB layer's chaos site (sim/lb.py): "degrade" collapses one
+    # backend's weight in the traced profile; the standard kinds raise
+    # classified faults at the protected run's entry like the policy
+    # sites (the supervisor retry path is pinned for both)
+    "lb.degraded_backend",
 )
 
 #: fault kind -> (message template, taxonomy class).  Messages imitate
@@ -118,7 +129,7 @@ class FaultPlan:
         self.entries = entries
         self._by_site: Dict[str, List[_Entry]] = {}
         for e in entries:
-            if e.kind not in ("nan", "stuck", "lag"):
+            if e.kind not in ("nan", "stuck", "lag", "degrade"):
                 self._by_site.setdefault(e.site, []).append(e)
 
     @classmethod
@@ -139,7 +150,7 @@ class FaultPlan:
                     f"unknown fault kind {kind!r} (one of {KINDS})"
                 )
             arg = int(bits[2]) if len(bits) == 3 else (
-                0 if kind == "nan" else 1
+                0 if kind in ("nan", "degrade") else 1
             )
             if kind == "nan" and site != "segment":
                 raise ValueError(
@@ -158,13 +169,19 @@ class FaultPlan:
                     "(lag:policies.autoscaler_lag[:N]), got site "
                     f"{site!r}"
                 )
+            if kind == "degrade" and site != "lb.degraded_backend":
+                raise ValueError(
+                    "degrade faults target the lb layer "
+                    "(degrade:lb.degraded_backend[:B]), got site "
+                    f"{site!r}"
+                )
             if kind != "nan" and site not in VALID_SITES:
                 raise ValueError(
                     f"unknown fault site {site!r} — the plan would "
                     f"never fire (valid sites: "
                     f"{', '.join(VALID_SITES)})"
                 )
-            behavioral = kind in ("nan", "stuck", "lag")
+            behavioral = kind in ("nan", "stuck", "lag", "degrade")
             entries.append(
                 _Entry(kind=kind, site=site, arg=arg,
                        remaining=0 if behavioral else arg)
@@ -194,6 +211,17 @@ class FaultPlan:
                 return max(e.arg, 1)
         return 0
 
+    #: the collapse factor of a degraded backend's attraction weight —
+    #: small but nonzero: the pod still advertises (gray failure), it
+    #: just draws ~no traffic
+    DEGRADED_FACTOR = 0.01
+
+    def lb_degraded_backend(self):
+        for e in self.entries:
+            if e.kind == "degrade":
+                return (max(e.arg, 0), self.DEGRADED_FACTOR)
+        return None
+
     def signature(self) -> str:
         """Stable identity of the TRACE-AFFECTING part of the plan.
 
@@ -212,6 +240,9 @@ class FaultPlan:
         lag = self.autoscaler_lag()
         if lag:
             parts.append(f"lag:policies.autoscaler_lag:{lag}")
+        deg = self.lb_degraded_backend()
+        if deg is not None:
+            parts.append(f"degrade:lb.degraded_backend:{deg[0]}")
         return ",".join(parts)
 
 
@@ -289,6 +320,15 @@ def autoscaler_lag() -> int:
     if not _env_loaded:
         _load_env()
     return 0 if _plan is None else _plan.autoscaler_lag()
+
+
+def lb_degraded_backend():
+    """Behavioral LB chaos: ``(backend, factor)`` collapsing that
+    backend's attraction weight in the traced profile, or None
+    (trace-time hook for sim/lb.device_tables)."""
+    if not _env_loaded:
+        _load_env()
+    return None if _plan is None else _plan.lb_degraded_backend()
 
 
 def signature() -> str:

@@ -98,6 +98,10 @@ class RunResult:
     # the rollout co-sim was off
     rollouts: Optional[dict] = None
     rollouts_summary: Optional[object] = None
+    # pluggable load-balancing laws (sim/lb.py): the lb.json doc
+    # (per-service law + per-window per-backend load split); None when
+    # the topology declares no lb entries
+    lb: Optional[dict] = None
 
 
 def _failed_window(reason: str) -> WindowSummary:
@@ -159,6 +163,8 @@ class _LazyTopology:
         self._policy_tables_built = False
         self._rollout_tables = None
         self._rollout_tables_built = False
+        self._lb_tables = None
+        self._lb_tables_built = False
 
     @property
     def compiled(self):
@@ -219,6 +225,19 @@ class _LazyTopology:
                 )
         return self._rollout_tables
 
+    @property
+    def lb_tables(self):
+        """Compiled load-balancing tables (sim/lb.py), or None when
+        the topology declares no ``lb:`` entries.  Unlike the policy /
+        rollout co-sims there is no config gate: a declared lb law IS
+        the data plane being measured, on every run kind."""
+        if not self._lb_tables_built:
+            self._lb_tables_built = True
+            from isotope_tpu.compiler import compile_lb
+
+            self._lb_tables = compile_lb(self.graph, self.compiled)
+        return self._lb_tables
+
     def mesh_spec(self) -> MeshSpec:
         """The resolved factorization for this topology (``"auto"``
         runs the layout search against the compiled service count)."""
@@ -260,9 +279,10 @@ class _LazyTopology:
             params = env.apply(self.config.sim_params())
             policies = self.policy_tables
             rollouts = self.rollout_tables
+            lb = self.lb_tables
             sim = Simulator(self.compiled, params, self.config.chaos,
                             self.config.churn, mtls=self.config.mtls,
-                            policies=policies, rollouts=rollouts)
+                            policies=policies, rollouts=rollouts, lb=lb)
             spec = self.mesh_spec()
             sharded = (
                 ShardedSimulator(
@@ -274,6 +294,7 @@ class _LazyTopology:
                     mtls=self.config.mtls,
                     policies=policies,
                     rollouts=rollouts,
+                    lb=lb,
                 )
                 if spec.size > 1
                 else None
@@ -910,6 +931,7 @@ def run_experiment(
                     tl_doc = tl_summary = None
                     pol_doc = pol_summary_out = None
                     roll_doc = roll_summary_out = None
+                    lb_doc = None
                     if protected:
                         # the protected run already reduced the
                         # timeline next to the control series — no
@@ -946,6 +968,22 @@ def run_experiment(
                         tl_doc, tl_summary = _timeline_pass(
                             sim, sharded, use_sharded, topo, load, n,
                             run_key, block, window_s=timeline,
+                        )
+                    if (
+                        topo.lb_tables is not None
+                        and topo.lb_tables.active
+                    ):
+                        # ACTIVE laws only: an all-fifo/no-panic block
+                        # is the pinned neutral path — marking it _lb
+                        # would mislabel a plain-M/M/k measurement.
+                        # Static law/split always; the per-window
+                        # per-backend census when a recorder ran (and
+                        # the actuated pool sizes when PR 9 loops did)
+                        from isotope_tpu.sim import lb as lb_mod
+
+                        lb_doc = lb_mod.to_doc(
+                            topo.lb_tables,
+                            tl=tl_summary, pol=pol_summary_out,
                         )
                     doc = fortio_result_from_summary(
                         summary, load, labels=label,
@@ -986,6 +1024,12 @@ def run_experiment(
                         # against an open-loop twin
                         flat["_rollout"] = True
                         telemetry.set_meta("rollouts", "on")
+                    if lb_doc is not None:
+                        # lb laws change the wait physics of every run
+                        # kind — the marker keeps bench_regress from
+                        # comparing an lb row against a fifo twin
+                        flat["_lb"] = True
+                        telemetry.set_meta("lb", "on")
                     flat.update(
                         {
                             "cpu_cores_" + name: round(v, 4)
@@ -1029,6 +1073,7 @@ def run_experiment(
                         policies_summary=pol_summary_out,
                         rollouts=roll_doc,
                         rollouts_summary=roll_summary_out,
+                        lb=lb_doc,
                     )
                     results.append(result)
                     if out is not None:
@@ -1057,6 +1102,11 @@ def run_experiment(
                                 out / f"{label}.rollout.json", "w"
                             ) as f:
                                 json.dump(roll_doc, f, indent=2)
+                        if lb_doc is not None:
+                            with open(
+                                out / f"{label}.lb.json", "w"
+                            ) as f:
+                                json.dump(lb_doc, f, indent=2)
                         if attr_summary is not None:
                             from isotope_tpu.metrics.export import (
                                 write_flamegraph,

@@ -566,6 +566,7 @@ class Simulator:
         mtls: Optional[MtlsSchedule] = None,
         policies=None,  # Optional[policies.PolicyTables]
         rollouts=None,  # Optional[rollout.RolloutTables]
+        lb=None,  # Optional[lb.LbTables]
     ):
         # engine.build covers everything below: device-constant upload,
         # bucket planning, copula tables — the host-side cost a compile
@@ -646,6 +647,36 @@ class Simulator:
             )
             self._canary_reps_np = rollouts.canary_replicas.astype(
                 np.float64
+            )
+
+        # -- pluggable load-balancing laws (sim/lb.py) ---------------------
+        # Per-service wait-law selection (least_request / ring_hash /
+        # wrr / panic routing) compiled from the topology's `lb:`
+        # entries.  ``None`` or an all-fifo-no-panic table keeps every
+        # traced wait draw on the legacy M/M/k path; the backend
+        # profile is resolved against the FINAL k_max (autoscaler and
+        # canary growth included) so dynamic pools extend the ring /
+        # weight cycle instead of truncating it.  The armed
+        # ``lb.degraded_backend`` chaos site bakes its weight collapse
+        # into the profile constant (trace-affecting, covered by
+        # faults.signature()).
+        self._lb = lb
+        self._lb_dev = None
+        self._lb_profile_np = None
+        if lb is not None and lb.active:
+            from isotope_tpu.sim import lb as lb_mod
+
+            self._lb_mod = lb_mod
+            degraded = faults.lb_degraded_backend()
+            # one profile serves the traced constants AND the host
+            # feedback mirror below — the degraded-backend collapse
+            # must be visible to both or the static fixed point
+            # diverges from the traced physics under the chaos site
+            self._lb_profile_np = lb_mod.effective_profile(
+                lb, self._k_max, degraded
+            )
+            self._lb_dev = lb_mod.device_tables(
+                lb, self._k_max, degraded=degraded
             )
 
         # -- traffic splits (config churner): per-hop schedule ids ---------
@@ -927,6 +958,18 @@ class Simulator:
             self._downed_pc = jnp.asarray(
                 np.repeat(self._downed_p_np, Cc, axis=0), jnp.float32
             )
+        if lb is not None and lb.any_panic:
+            # static panic inputs: alive replicas per phase (UNclamped
+            # — a fully-killed pool is 0 healthy, not 1) and the static
+            # pool size.  Protected runs substitute the policy state's
+            # actuated/ejected counts for these at trace time.
+            self._lb_alive_pc = jnp.asarray(
+                np.repeat(eff.astype(np.float64), Cc, axis=0),
+                jnp.float32,
+            )
+            self._lb_total_row = jnp.asarray(
+                t.replicas, jnp.float32
+            )[None, :]
         if rollouts is not None:
             # Cc-repeated canary/baseline phase tables (the chaos split
             # above); without chaos they degenerate to the static rows
@@ -988,6 +1031,15 @@ class Simulator:
                         policies.budget_min,
                     )
                     if policies is not None and policies.any_budget
+                    else None
+                ),
+                # the LB laws change the per-station wait tails the
+                # timeout probabilities integrate over; the fixed
+                # point mirrors them (sim/lb.np_wait_stats) or a hot
+                # ring-hash arc's retry storm goes statically unseen
+                lb=(
+                    (lb, self._lb_profile_np)
+                    if self._lb_profile_np is not None
                     else None
                 ),
             )
@@ -1248,6 +1300,13 @@ class Simulator:
             or (policies is not None and policies.any_breaker)
             # canary-arm 500s feed the rollout gates (sim/rollout.py)
             or (rollouts is not None and rollouts.any_error_override)
+            # panic routing fast-fails the dead-backend share
+            # (sim/lb.py) — reachable only when something can actually
+            # unhealth the pool (chaos kills or policy ejection)
+            or (
+                lb is not None and lb.any_panic
+                and (bool(chaos) or policies is not None)
+            )
         )
         shapes = [
             buckets.LevelShape(
@@ -1261,11 +1320,12 @@ class Simulator:
         plan = buckets.plan_segments(
             shapes,
             waste=params.level_bucket_waste,
-            # the policy co-sim's retry-budget gate lives in the
-            # UNROLLED attempt loop only; a policies Simulator keeps
-            # the specialized per-level trace (bit-identical results,
-            # sim/levelscan.py — scan-bucket support is a follow-up)
-            enabled=params.bucketed_scan and policies is None,
+            # protected runs ride the scan buckets too: the
+            # retry-budget gate reached the bucket attempt loop in
+            # sim/levelscan.py (SweepCtx.retry_coin), so a policies
+            # Simulator keeps the PR 6 fast path — pinned <= 1 ULP
+            # against the unrolled plan (tests/test_lb.py)
+            enabled=params.bucketed_scan,
             schedule=params.bucket_schedule,
         )
         self._segments = tuple(
@@ -1312,6 +1372,8 @@ class Simulator:
                 # absent tables contribute the historical empty digest
                 policies.signature() if policies is not None else "",
                 rollouts.signature() if rollouts is not None else "",
+                # lb tables select the traced wait law per station
+                lb.signature() if lb is not None else "",
                 compiled.hop_service, compiled.hop_parent,
                 compiled.hop_step, compiled.hop_attempt,
                 compiled.hop_send_prob, compiled.hop_request_size,
@@ -1837,6 +1899,7 @@ class Simulator:
         few pilot iterations before the full run.
         """
         faults.check("engine.run")
+        self._check_lb_load(load)
         if load.kind == OPEN_LOOP:
             with self._detail_ctx():
                 return self._get(num_requests, OPEN_LOOP)(
@@ -1886,6 +1949,23 @@ class Simulator:
             and load.qps is None
             and self._mtls is None
         )
+
+    def _check_lb_load(self, load: LoadModel) -> None:
+        """LB-law preconditions for one run: the saturated ``-qps
+        max`` path samples the finite-population MVA law, which has no
+        per-backend dispatch notion — reject loudly rather than
+        silently falling back to fifo.  Also the ``lb.degraded_backend``
+        chaos site's classified-fault entry (the supervisor retry path
+        covers the lb layer like the PR 9 policy sites)."""
+        if self._lb is None or not self._lb.active:
+            return
+        faults.check("lb.degraded_backend")
+        if self._saturated(load):
+            raise ValueError(
+                "lb laws do not support saturated -qps max loads: the "
+                "finite-population wait tables have no per-backend "
+                "dispatch; use a paced closed loop or open loop"
+            )
 
     def solve_closed_rate(
         self,
@@ -2038,6 +2118,7 @@ class Simulator:
         fn = self._get_summary(block, num_blocks, load.kind, conns,
                                collector, trim, sat=sat)
         faults.check("engine.run")
+        self._check_lb_load(load)
         telemetry.gauge_set("engine_block_requests", block)
         telemetry.gauge_set("engine_num_blocks", num_blocks)
         with self._detail_ctx():
@@ -2129,6 +2210,7 @@ class Simulator:
             sat=sat, timeline=tl_plan,
         )
         faults.check("engine.run")
+        self._check_lb_load(load)
         telemetry.gauge_set("engine_block_requests", block)
         telemetry.gauge_set("engine_num_blocks", num_blocks)
         telemetry.counter_inc("timeline_runs")
@@ -2266,6 +2348,7 @@ class Simulator:
             roll=roll,
         )
         faults.check("engine.run")
+        self._check_lb_load(load)
         telemetry.gauge_set("engine_block_requests", block)
         telemetry.gauge_set("engine_num_blocks", num_blocks)
         telemetry.counter_inc("rollout_runs" if roll else "policy_runs")
@@ -2700,6 +2783,7 @@ class Simulator:
             sat=sat, attr="tail" if tail else "mean",
         )
         faults.check("engine.run")
+        self._check_lb_load(load)
         telemetry.gauge_set("engine_block_requests", block)
         telemetry.gauge_set("engine_num_blocks", num_blocks)
         telemetry.counter_inc("attributed_runs")
@@ -3342,6 +3426,7 @@ class Simulator:
                 eff_replicas_pc = jnp.maximum(
                     policy_fx.replicas[None, :] - downed, 1.0
                 ).astype(jnp.int32)
+        lam_can = None
         if rollout_fx is not None:
             # -- two-version split (sim/rollout.py): the canary arm is
             # its OWN M/M/k station fed the split-off admitted load
@@ -3351,12 +3436,7 @@ class Simulator:
             # Un-rolled-out services have weight 0, so their baseline
             # row is untouched and their canary row is load-free.
             w_row = rollout_fx.weight[None, :]  # (1, S)
-            qp_can = queueing.mmk_params(
-                lam_pc * w_row,
-                self._canary_mu,
-                self._can_reps_pc,
-                self._k_max,
-            )
+            lam_can = lam_pc * w_row
             lam_pc = lam_pc * (1.0 - w_row)
             if self.has_chaos and not (
                 policy_fx is not None
@@ -3365,12 +3445,71 @@ class Simulator:
                 # static baseline capacity under chaos: the canary-
                 # first split's remainder, not the full-delta table
                 eff_replicas_pc = self._eff_base_roll_pc
-        qp = queueing.mmk_params(
-            lam_pc,
-            self._mu,
-            eff_replicas_pc,
-            self._k_max,
-        )
+        # -- panic-threshold routing (sim/lb.py) ---------------------------
+        # When the healthy fraction of a pool (after outlier ejection
+        # and chaos kills) drops below the service's panic threshold,
+        # route to ALL backends: the dead-backend share fast-fails via
+        # the panic coin below and the wait law's load scales by the
+        # healthy fraction (survivors keep undegraded per-backend
+        # load).  Baseline arm only — a rolled-out canary has its own
+        # kill physics (transport failures on a downed arm).
+        panic_fail_ph = None
+        lbd = self._lb_dev
+        if (
+            lbd is not None
+            and self._lb.any_panic
+            and not sat_conns
+            and (self.has_chaos or policy_fx is not None)
+        ):
+            if policy_fx is not None and policy_fx.total is not None:
+                total = policy_fx.total[None, :]
+                alive = policy_fx.alive[None, :]
+                if self.has_chaos:
+                    alive = alive - (
+                        self._downed_base_pc
+                        if rollout_fx is not None
+                        else self._downed_pc
+                    )
+                alive = jnp.maximum(alive, 0.0)
+            else:
+                total = self._lb_total_row
+                alive = self._lb_alive_pc
+            lam_pc, panic_fail_pc = self._lb_mod.panic_split(
+                lbd, lam_pc, alive, total
+            )
+            panic_fail_ph = panic_fail_pc[:, self._hop_service]
+        # -- per-station wait law (sim/lb.py) ------------------------------
+        # The lb tables swap the wait law per service (power-of-d /
+        # mixture); fifo rows pass through mmk_params untouched.  The
+        # saturated -qps max path keeps its finite-population law (lb
+        # runs reject it loudly at the entry points).
+        if lbd is not None and not sat_conns:
+            qp = self._lb_mod.wait_params(
+                self._lb, lbd, lam_pc, self._mu, eff_replicas_pc,
+                self._k_max,
+            )
+            if rollout_fx is not None:
+                # the canary arm hashes its OWN ring / weight cycle
+                # over its own replicas: stickiness respects version
+                # weights (each version's endpoint set is its own pool)
+                qp_can = self._lb_mod.wait_params(
+                    self._lb, lbd, lam_can, self._canary_mu,
+                    self._can_reps_pc, self._k_max,
+                )
+        else:
+            qp = queueing.mmk_params(
+                lam_pc,
+                self._mu,
+                eff_replicas_pc,
+                self._k_max,
+            )
+            if rollout_fx is not None:
+                qp_can = queueing.mmk_params(
+                    lam_can,
+                    self._canary_mu,
+                    self._can_reps_pc,
+                    self._k_max,
+                )
         svc_down_pc = self._svc_down_pc
         if rollout_fx is not None and self.has_chaos:
             # baseline-arm outage flags (canary downs selected per hop
@@ -3396,9 +3535,12 @@ class Simulator:
                 else None
             )
         num_phases = P * Cc
+        pf_nh = None
         if num_phases == 1:
             p_wait_nh = p_wait_ph[0][None, :]
             wait_rate_nh = wait_rate_ph[0][None, :]
+            if panic_fail_ph is not None:
+                pf_nh = panic_fail_ph[0][None, :]
             down = (
                 jnp.broadcast_to(down_ph[0][None, :], (n, H))
                 if self.has_chaos
@@ -3444,6 +3586,8 @@ class Simulator:
             hi = jax.lax.Precision.HIGHEST
             p_wait_nh = jnp.matmul(oh, p_wait_ph, precision=hi)
             wait_rate_nh = jnp.matmul(oh, wait_rate_ph, precision=hi)
+            if panic_fail_ph is not None:
+                pf_nh = jnp.matmul(oh, panic_fail_ph, precision=hi)
             down = (
                 jnp.matmul(oh, down_ph.astype(jnp.float32), precision=hi)
                 > 0.5
@@ -3470,6 +3614,26 @@ class Simulator:
                         ) > 0.5,
                         down,
                     )
+        # -- panic coin (sim/lb.py): the dead-backend share fast-fails.
+        # Folded key like the policy/rollout coins, so a panicking run
+        # differs from its healthy twin only by the panic effects.  A
+        # canary-routed hop is exempt (its arm's kill physics already
+        # transport-fail it); the coin merges into the shed path —
+        # identical fast-500 semantics at admission.
+        if pf_nh is not None:
+            panic_coin = (
+                jax.random.uniform(
+                    jax.random.fold_in(key, 660_001), (n, H)
+                )
+                < pf_nh
+            )
+            if can_coin is not None:
+                panic_coin = panic_coin & ~can_coin
+            shed_coin = (
+                panic_coin
+                if shed_coin is None
+                else (shed_coin | panic_coin)
+            )
         if sat_conns:
             # finite-population law: per-hop quantile polynomial in
             # v = -log(1 - u') — Horner with per-hop coefficient rows,
@@ -3620,6 +3784,7 @@ class Simulator:
             u_send=u_send, down=down, tax=tax, churn_w=churn_w,
             track_err=self._track_err,
             pallas_census=self._pallas_census,
+            retry_coin=retry_coin,
         )
         bucket_ys: Dict[int, dict] = {}
         up_units: List[tuple] = []

@@ -133,6 +133,7 @@ class RetryFeedback:
         static_visits_pc: np.ndarray,  # (PC, S)
         mtls=None,                     # Optional[MtlsSchedule]
         retry_budget=None,             # (has (S,), frac (S,), min (S,))
+        lb=None,                       # (lb.LbTables, profile (S, k))
     ):
         self.compiled = compiled
         self.params = params
@@ -156,6 +157,16 @@ class RetryFeedback:
                 np.asarray(frac, np.float64),
                 np.asarray(floor, np.float64),
             )
+        # per-service LB wait laws (sim/lb.py): the fixed point's
+        # P(timeout) integrates the same skewed per-backend tails the
+        # engine samples.  Panic routing mirrors the wait-law load
+        # scaling only — the panic share's fast-fail reach truncation
+        # is NOT mirrored (stated approximation: the static estimate
+        # keeps the full subtree load, conservatively overstating it).
+        self.lb = lb
+        self._static_replicas = np.maximum(
+            np.asarray(compiled.services.replicas, np.float64), 1.0
+        )
         self._retry_hop = compiled.hop_attempt > 0
 
         t = compiled.services
@@ -298,7 +309,25 @@ class RetryFeedback:
         # the engine's budget gate); 1 everywhere without budgets
         allow = np.ones(S)
         for _ in range(iters):
-            p_wait, wait_rate, _ = np_mmk(offered * visits, self.mu, eff)
+            lam = offered * visits
+            if self.lb is not None:
+                from isotope_tpu.sim import lb as lb_mod
+
+                tables, profile = self.lb
+                if tables.any_panic:
+                    alive = np.where(down, 0.0, eff)
+                    frac = np.clip(
+                        alive / self._static_replicas, 0.0, 1.0
+                    )
+                    panic = (tables.panic_threshold > 0.0) & (
+                        frac < tables.panic_threshold
+                    )
+                    lam = np.where(panic, lam * frac, lam)
+                p_wait, wait_rate = lb_mod.np_wait_stats(
+                    tables, profile, lam, self.mu, eff
+                )
+            else:
+                p_wait, wait_rate, _ = np_mmk(lam, self.mu, eff)
             ew = np.where(down, 0.0, p_wait / wait_rate)
 
             # -- bottom-up: subtree means + per-call failure probabilities

@@ -79,6 +79,13 @@ class SweepCtx(NamedTuple):
     # serve the per-step census join from the fused Pallas kernel
     # (native/census_pallas.py) instead of the XLA op chain
     pallas_census: bool = False
+    # retry-budget gate (sim/policies.py): attempt >= 1 runs only when
+    # its budget coin admits it.  None exactly when no budget can
+    # throttle (the byte-identical default) — with it set, protected
+    # runs ride the scan buckets too (the PR 6 fast path; previously
+    # the gate lived in the unrolled attempt loop only and forced
+    # plan_segments(enabled=False) under policies)
+    retry_coin: Optional[jax.Array] = None  # (N, H) bool
 
 
 @dataclasses.dataclass(frozen=True)
@@ -282,6 +289,13 @@ def up_sweep(
     seg_err = segment_slice(ctx.err_coin, b)
     seg_send = segment_slice(ctx.u_send, b)
     seg_down = segment_slice(ctx.down, b)
+    # budget gate only matters past attempt 0 — single-attempt buckets
+    # never consult it (their retry fan is statically empty)
+    seg_retry = (
+        segment_slice(ctx.retry_coin, b)
+        if not b.single_attempt
+        else None
+    )
     churn_w = ctx.churn_w
     tax = ctx.tax
 
@@ -350,6 +364,15 @@ def up_sweep(
                 if coin is not None
                 else jnp.ones((n, a0.shape[0]), bool)
             )
+            # retry-budget gate (sim/policies.py): the child slice's
+            # budget coins, padded like down_child — the bucket dummy
+            # column ``B`` is False (dead lane), matching the unrolled
+            # path's dead pad column
+            retry_gate = (
+                pad1(_dslice(seg_retry, x["choff"], B))
+                if seg_retry is not None
+                else None
+            )
             dur_call = jnp.zeros((n, a0.shape[0]))
             final_transport = (
                 jnp.zeros((n, a0.shape[0]), bool) if transportable
@@ -362,6 +385,11 @@ def up_sweep(
                 idx = x["att_child"][a]
                 valid = x["att_valid"][a]
                 use = used_a & valid
+                if retry_gate is not None and a > 0:
+                    # a suppressed retry surfaces the PREVIOUS
+                    # attempt's failure to the caller (Envoy budget
+                    # semantics) — same op as the unrolled gate
+                    use = use & retry_gate[:, idx]
                 t = rtt_child[idx] + lat_child[:, idx]
                 if tax is not None:
                     t = t + 2.0 * tax[:, None]

@@ -231,7 +231,10 @@ class ServicePolicy:
     retry_budget: Optional[RetryBudgetPolicy] = None
     autoscaler: Optional[AutoscalerPolicy] = None
 
-    _FIELDS = {"breaker", "retry_budget", "autoscaler"}
+    # ``lb`` shares the block but is decoded/compiled by sim/lb.py
+    # (compiler/compile.compile_lb) into its own tables — listed here
+    # only so the strict unknown-field check admits it
+    _FIELDS = {"breaker", "retry_budget", "autoscaler", "lb"}
 
     @classmethod
     def decode(
@@ -583,6 +586,14 @@ class PolicyFx(NamedTuple):
     replicas: jax.Array      # (S,) f32 — effective replica count >= 1
     shed: jax.Array          # (S,) f32 — admission-shed probability
     retry_allow: jax.Array   # (S,) f32 — attempt>=1 survival prob
+    # panic-routing inputs (sim/lb.py): the actuated pool size and its
+    # UNfloored healthy remainder (replicas minus ejections — 0 means
+    # 0, unlike ``replicas`` above which keeps one server for the wait
+    # law).  Optional with None defaults so hand-built fixtures and
+    # older callers stay valid; engine paths that need panic always
+    # receive them from :func:`effects`.
+    total: Optional[jax.Array] = None   # (S,) f32
+    alive: Optional[jax.Array] = None   # (S,) f32
 
 
 class PolicySummary(NamedTuple):
@@ -648,13 +659,14 @@ def effects(state: PolicyState) -> PolicyFx:
     """What the NEXT block's physics sees: integer-actuated replicas
     minus ejected capacity (floored at 1 server), the breaker's shed
     probability, and the budgeted retry survival probability."""
-    eff = jnp.maximum(
-        jnp.round(state.replicas) - jnp.round(state.ejected), 1.0
-    )
+    total = jnp.round(state.replicas)
+    alive = total - jnp.round(state.ejected)
     return PolicyFx(
-        replicas=eff,
+        replicas=jnp.maximum(alive, 1.0),
         shed=state.shed,
         retry_allow=state.retry_allow,
+        total=total,
+        alive=alive,
     )
 
 

@@ -1,0 +1,926 @@
+"""Pluggable load-balancing laws (sim/lb.py): decode/tables, the
+power-of-d wait law vs a host-side DES oracle, mixture laws, panic
+routing, canary composition, byte-identity off, sharded twin
+bit-equality, the scan-bucket protected-run pin (the lifted unrolled
+restriction), the degraded-backend chaos site, and the VET rules."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from isotope_tpu.compiler import (
+    compile_graph,
+    compile_lb,
+    compile_policies,
+    compile_rollouts,
+)
+from isotope_tpu.models.graph import ServiceGraph
+from isotope_tpu.resilience import faults
+from isotope_tpu.sim import lb as lb_mod
+from isotope_tpu.sim import queueing
+from isotope_tpu.sim.config import ChaosEvent, LoadModel, SimParams
+from isotope_tpu.sim.engine import Simulator
+
+KEY = jax.random.PRNGKey(0)
+MU = 13_000.0
+
+CHAIN = """
+services:
+- name: entry
+  isEntrypoint: true
+  numReplicas: 8
+  script:
+  - call: worker
+- name: worker
+  numReplicas: 4
+"""
+
+LB_LR = """
+policies:
+  worker:
+    lb: {policy: least_request, choices_d: 2}
+"""
+
+
+def graph_with_lb(extra: str = LB_LR) -> ServiceGraph:
+    return ServiceGraph.from_yaml(CHAIN + extra)
+
+
+def tables_for(graph: ServiceGraph):
+    return compile_lb(graph, compile_graph(graph))
+
+
+def _ulp_equal(a, b, maxulp=1):
+    for x, y in zip(jax.tree.leaves(a), jax.tree.leaves(b)):
+        x, y = np.asarray(x), np.asarray(y)
+        if np.issubdtype(x.dtype, np.floating):
+            np.testing.assert_array_max_ulp(x, y, maxulp=maxulp)
+        else:
+            assert np.array_equal(x, y)
+
+
+def _bit_equal(a, b):
+    for x, y in zip(jax.tree.leaves(a), jax.tree.leaves(b)):
+        assert np.array_equal(np.asarray(x), np.asarray(y))
+
+
+# -- decode / tables -------------------------------------------------------
+
+
+def test_decode_defaults_shorthand_and_null():
+    g = ServiceGraph.from_yaml(CHAIN + """
+policies:
+  defaults:
+    lb: least_request
+  worker:
+    lb: {policy: ring_hash, hash_skew: 1.2}
+""")
+    lbs = lb_mod.LbSet.decode(g.policies, ["entry", "worker"])
+    assert lbs.for_service("entry").policy == "least_request"
+    assert lbs.for_service("entry").choices_d == 2  # default
+    assert lbs.for_service("worker").policy == "ring_hash"
+    assert lbs.for_service("worker").hash_skew == 1.2
+    g2 = ServiceGraph.from_yaml(CHAIN + """
+policies:
+  defaults:
+    lb: least_request
+  worker:
+    lb: null
+""")
+    lbs2 = lb_mod.LbSet.decode(g2.policies, ["entry", "worker"])
+    assert lbs2.for_service("worker") is None
+    assert lbs2.for_service("entry") is not None
+
+
+def test_decode_rejects_bad_entries():
+    with pytest.raises(ValueError, match="unknown lb fields"):
+        lb_mod.LbPolicy.decode({"policy": "wrr", "spread": 2})
+    with pytest.raises(ValueError, match="one of"):
+        lb_mod.LbPolicy.decode("bogus")
+    with pytest.raises(ValueError, match="choices_d only applies"):
+        lb_mod.LbPolicy.decode({"policy": "ring_hash", "choices_d": 2})
+    with pytest.raises(ValueError, match="hash_skew only applies"):
+        lb_mod.LbPolicy.decode({"policy": "wrr", "hash_skew": 1.0})
+    with pytest.raises(ValueError, match="weights only applies"):
+        lb_mod.LbPolicy.decode(
+            {"policy": "least_request", "weights": [1, 2]}
+        )
+    with pytest.raises(ValueError, match="positive"):
+        lb_mod.LbPolicy.decode({"policy": "wrr", "weights": [1, 0]})
+    with pytest.raises(ValueError, match="unknown service"):
+        lb_mod.LbSet.decode({"ghost": {"lb": "fifo"}}, ["entry"])
+    # key-pathed errors through the graph decode surface
+    with pytest.raises(ValueError) as e:
+        compile_lb(
+            ServiceGraph.from_yaml(CHAIN + """
+policies:
+  worker:
+    lb: {policy: least_request, choices_d: 0}
+"""),
+            compile_graph(ServiceGraph.from_yaml(CHAIN)),
+        )
+    assert "policies.worker.lb" in str(e.value)
+
+
+def test_build_tables_profile_and_signature():
+    g = ServiceGraph.from_yaml(CHAIN + """
+policies:
+  entry:
+    lb: {policy: ring_hash, hash_skew: 1.0}
+  worker:
+    lb: {policy: wrr, weights: [3, 1]}
+""")
+    t = tables_for(g)
+    assert t is not None and t.any_mix and not t.any_lr
+    assert "lb:" in t.signature()
+    prof = t.backend_profile(4)
+    e = list(t.names).index("entry")
+    w = list(t.names).index("worker")
+    # zipf ranks over the ring's arcs
+    np.testing.assert_allclose(prof[e], [1, 1 / 2, 1 / 3, 1 / 4])
+    # wrr weights cycle over pool growth
+    np.testing.assert_allclose(prof[w], [3, 1, 3, 1])
+    # round-trips through encode (raw block preserved)
+    again = ServiceGraph.decode(g.encode())
+    assert again.policies == g.policies
+
+
+def test_compile_lb_none_without_entries():
+    g = ServiceGraph.from_yaml(CHAIN)
+    assert compile_lb(g, compile_graph(g)) is None
+    # a policies block WITHOUT lb entries compiles policies, not lb
+    g2 = ServiceGraph.from_yaml(CHAIN + """
+policies:
+  worker:
+    breaker: {max_pending: 8}
+""")
+    c2 = compile_graph(g2)
+    assert compile_lb(g2, c2) is None
+    assert compile_policies(g2, c2) is not None
+
+
+# -- wait laws -------------------------------------------------------------
+
+
+def _law_params(extra, lam, k, mu=MU, k_max=None):
+    g = graph_with_lb(extra)
+    t = tables_for(g)
+    k_max = k_max or int(np.max(k))
+    dlb = lb_mod.device_tables(t, k_max)
+    return t, lb_mod.wait_params(
+        t, dlb, jnp.asarray(lam, jnp.float32),
+        mu, jnp.asarray(k, jnp.int32), k_max,
+    )
+
+
+def test_d1_is_exact_mm1_random_dispatch():
+    """choices_d=1 (uniform random per-backend dispatch) must be the
+    EXACT M/M/1 law at every utilization: P(wait) = rho and the
+    conditional rate mu(1 - rho) — the closed-form anchor of the
+    truncated mean-field sum + geometric residue."""
+    lam = np.array([[200.0, 0.95 * 4 * MU]])
+    _, qp = _law_params(
+        "policies:\n  worker:\n"
+        "    lb: {policy: least_request, choices_d: 1}\n",
+        lam, [[8, 4]],
+    )
+    rho = 0.95
+    assert np.isclose(float(qp.p_wait[0, 1]), rho, rtol=1e-4)
+    assert np.isclose(
+        float(qp.wait_rate[0, 1]), MU * (1 - rho), rtol=1e-3
+    )
+
+
+def _des_jsq(lam, mu, k, d, n=120_000, seed=3):
+    """Host-side DES oracle: JSQ(d) over k per-backend FCFS M/M/1
+    queues (join the least-occupied of d sampled backends)."""
+    from collections import deque
+
+    rng = np.random.default_rng(seed)
+    arr = rng.exponential(1.0 / lam, n).cumsum()
+    svc = rng.exponential(1.0 / mu, n)
+    ready = np.zeros(k)
+    deps = [deque() for _ in range(k)]
+    waits = np.empty(n)
+    for i in range(n):
+        t = arr[i]
+        for s in range(k):
+            dq = deps[s]
+            while dq and dq[0] <= t:
+                dq.popleft()
+        cand = (
+            rng.choice(k, size=d, replace=False)
+            if d < k else np.arange(k)
+        )
+        s = cand[int(np.argmin([len(deps[c]) for c in cand]))]
+        start = max(t, ready[s])
+        ready[s] = start + svc[i]
+        deps[s].append(ready[s])
+        waits[i] = start - t
+    w = waits[n // 5:]  # drop warmup
+    return float((w > 1e-12).mean()), float(w.mean())
+
+
+@pytest.mark.slow
+def test_power_of_d_vs_des_oracle_two_backends():
+    """The mean-field power-of-d law against a DES oracle on a
+    2-backend station.  Stated envelope (lb.py docstring): the law is
+    a k -> infinity asymptotic, a LOWER bound on the finite-k mean
+    wait — P(wait) tracks the oracle within ~15%, the mean wait sits
+    in [0.3, 1.05] x oracle, and the d-ordering (2 choices beat 1)
+    matches the oracle's."""
+    mu, k, rho = 1.0, 2, 0.8
+    lam = rho * k * mu
+    p_des, w_des = _des_jsq(lam, mu, k, d=2)
+    p_des1, w_des1 = _des_jsq(lam, mu, k, d=1)
+    t, qp = _law_params(
+        "policies:\n  worker:\n"
+        "    lb: {policy: least_request, choices_d: 2}\n",
+        np.array([[0.1, lam]]), [[8, k]], mu=mu, k_max=8,
+    )
+    p_law = float(qp.p_wait[0, 1])
+    w_law = p_law / float(qp.wait_rate[0, 1])
+    assert abs(p_law - p_des) / p_des < 0.15
+    assert 0.3 * w_des < w_law < 1.05 * w_des
+    # the oracle confirms the law's direction: sampling 2 beats 1
+    assert w_des < w_des1 and p_des < p_des1
+    _, qp1 = _law_params(
+        "policies:\n  worker:\n"
+        "    lb: {policy: least_request, choices_d: 1}\n",
+        np.array([[0.1, lam]]), [[8, k]], mu=mu, k_max=8,
+    )
+    w_law1 = float(qp1.p_wait[0, 1]) / float(qp1.wait_rate[0, 1])
+    assert w_law < w_law1 and p_law < float(qp1.p_wait[0, 1])
+
+
+def test_wrr_uniform_equals_random_dispatch_and_scale_invariance():
+    """Determinism anchors of the wrr mixture: uniform weights are
+    exactly uniform-random per-backend dispatch (the d=1 law), and
+    weights are scale-free ([2,2] == [1,1])."""
+    lam = np.array([[100.0, 0.8 * 4 * MU]])
+    k = [[8, 4]]
+    _, qp_u = _law_params(
+        "policies:\n  worker:\n    lb: {policy: wrr}\n", lam, k
+    )
+    _, qp_1 = _law_params(
+        "policies:\n  worker:\n"
+        "    lb: {policy: least_request, choices_d: 1}\n", lam, k
+    )
+    np.testing.assert_allclose(
+        np.asarray(qp_u.p_wait)[0, 1], np.asarray(qp_1.p_wait)[0, 1],
+        rtol=1e-5,
+    )
+    np.testing.assert_allclose(
+        np.asarray(qp_u.wait_rate)[0, 1],
+        np.asarray(qp_1.wait_rate)[0, 1], rtol=1e-4,
+    )
+    _, qp_2 = _law_params(
+        "policies:\n  worker:\n"
+        "    lb: {policy: wrr, weights: [2, 2, 2, 2]}\n", lam, k
+    )
+    _bit_equal(qp_u.p_wait, qp_2.p_wait)
+    # and run-level determinism: no extra RNG stream — two runs of the
+    # same key are identical
+    g = graph_with_lb(
+        "policies:\n  worker:\n"
+        "    lb: {policy: wrr, weights: [3, 1, 1, 1]}\n"
+    )
+    c = compile_graph(g)
+    sim = Simulator(c, lb=tables_for(g))
+    load = LoadModel(kind="open", qps=2_000.0)
+    _bit_equal(
+        sim.run_summary(load, 1_024, KEY, block_size=512),
+        sim.run_summary(load, 1_024, KEY, block_size=512),
+    )
+
+
+def test_mixture_hot_backend_flags_unstable():
+    """A skewed ring saturates its hottest arc long before the
+    aggregate does: rho_aggregate ~0.5 but the hot backend takes ~52%
+    of the load -> per-backend rho > 1 -> unstable."""
+    lam = np.array([[100.0, 0.5 * 4 * MU]])
+    _, qp = _law_params(
+        "policies:\n  worker:\n"
+        "    lb: {policy: ring_hash, hash_skew: 2.0}\n",
+        lam, [[8, 4]],
+    )
+    assert bool(qp.unstable[0, 1])
+    assert float(qp.utilization[0, 1]) < 0.6  # aggregate still calm
+    base = queueing.mmk_params(
+        jnp.asarray(lam, jnp.float32), MU,
+        jnp.asarray([[8, 4]], jnp.int32), 8,
+    )
+    assert not bool(base.unstable[0, 1])
+
+
+def test_panic_split_flip_law():
+    """The panic threshold is a flip: healthy fraction at/above it
+    keeps the law untouched; below it the load scales by the fraction
+    and the complement fast-fails."""
+    g = graph_with_lb(
+        "policies:\n  worker:\n"
+        "    lb: {policy: fifo, panic_threshold: 50%}\n"
+    )
+    t = tables_for(g)
+    dlb = lb_mod.device_tables(t, 4)
+    lam = jnp.asarray([[100.0, 1000.0]])
+    total = jnp.asarray([[8.0, 4.0]])
+    for alive_w, expect_panic in ((2.0, False), (1.0, True)):
+        alive = jnp.asarray([[8.0, alive_w]])
+        lam_out, p_fail = lb_mod.panic_split(dlb, lam, alive, total)
+        frac = alive_w / 4.0
+        if expect_panic:
+            assert np.isclose(float(lam_out[0, 1]), 1000.0 * frac)
+            assert np.isclose(float(p_fail[0, 1]), 1.0 - frac)
+        else:
+            assert float(lam_out[0, 1]) == 1000.0
+            assert float(p_fail[0, 1]) == 0.0
+        # entry has no panic threshold: never panics
+        assert float(p_fail[0, 0]) == 0.0
+
+
+# -- byte-identity / neutrality pins ---------------------------------------
+
+
+def test_lb_absent_byte_identical():
+    """The acceptance pin: no ``lb:`` entries -> compile_lb is None ->
+    a Simulator built with lb=None traces the same program as one
+    never told about lb — run_summary outputs bit-equal leaf by
+    leaf."""
+    g = ServiceGraph.from_yaml(CHAIN)
+    compiled = compile_graph(g)
+    load = LoadModel(kind="open", qps=2_000.0)
+    a = Simulator(compiled).run_summary(load, 2_048, KEY,
+                                        block_size=512)
+    b = Simulator(compiled, lb=compile_lb(g, compiled)).run_summary(
+        load, 2_048, KEY, block_size=512
+    )
+    _bit_equal(a, b)
+
+
+def test_fifo_tables_neutral_law_pin():
+    """An all-fifo lb block with no panic is the neutral law: tables
+    compile (and key the cache) but every wait draw stays on the
+    legacy M/M/k path — <= 1 ULP against the no-tables run (exact
+    today: the engine skips the selection entirely)."""
+    g = graph_with_lb("policies:\n  worker:\n    lb: fifo\n")
+    compiled = compile_graph(g)
+    t = tables_for(g)
+    assert t is not None and not t.active
+    load = LoadModel(kind="open", qps=2_000.0)
+    a = Simulator(compiled).run_summary(load, 2_048, KEY,
+                                        block_size=512)
+    b = Simulator(compiled, lb=t).run_summary(load, 2_048, KEY,
+                                              block_size=512)
+    _ulp_equal(a, b)
+
+
+def test_active_law_changes_physics():
+    """Sanity complement of the pins: an ACTIVE law must move the
+    latency distribution (a skewed ring at rho 0.9 is not fifo)."""
+    load = LoadModel(kind="open", qps=47_000.0)
+    g0 = ServiceGraph.from_yaml(CHAIN)
+    c0 = compile_graph(g0)
+    a = Simulator(c0).run_summary(load, 4_096, KEY, block_size=1_024)
+    g1 = graph_with_lb(
+        "policies:\n  worker:\n"
+        "    lb: {policy: ring_hash, hash_skew: 1.5}\n"
+    )
+    c1 = compile_graph(g1)
+    b = Simulator(c1, lb=tables_for(g1)).run_summary(
+        load, 4_096, KEY, block_size=1_024
+    )
+    assert float(b.latency_sum) > 2.0 * float(a.latency_sum)
+
+
+def test_saturated_load_rejected_with_active_lb():
+    g = graph_with_lb()
+    compiled = compile_graph(g)
+    sim = Simulator(compiled, lb=tables_for(g))
+    sat = LoadModel(kind="closed", qps=None, connections=8)
+    with pytest.raises(ValueError, match="-qps max"):
+        sim.run_summary(sat, 256, KEY)
+
+
+# -- panic routing end-to-end ----------------------------------------------
+
+
+def test_panic_routing_keeps_tail_through_storm():
+    """3 of 4 worker replicas die mid-run.  Without panic the lone
+    survivor absorbs everything (rho >> 1); with panic_threshold 50%
+    the dead-backend share fast-fails (worker hop 500s appear) and
+    the survivor keeps its undegraded load — the client tail stays
+    orders of magnitude lower."""
+    chaos = (ChaosEvent(service="worker", start_s=0.05, end_s=10.0,
+                        replicas_down=3),)
+    load = LoadModel(kind="open", qps=30_000.0)
+    g_p = graph_with_lb(
+        "policies:\n  worker:\n"
+        "    lb: {policy: least_request, panic_threshold: 50%}\n"
+    )
+    c_p = compile_graph(g_p)
+    sim_p = Simulator(c_p, SimParams(timeline=True), chaos,
+                      lb=tables_for(g_p))
+    s_p, tl_p = sim_p.run_timeline(load, 8_192, KEY, block_size=2_048,
+                                   window_s=0.05)
+    g_0 = ServiceGraph.from_yaml(CHAIN)
+    c_0 = compile_graph(g_0)
+    s_0 = Simulator(c_0, chaos=chaos).run_summary(
+        load, 8_192, KEY, block_size=2_048
+    )
+    assert float(s_p.latency_sum) < 0.2 * float(s_0.latency_sum)
+    # the fast-fail share lands as worker-hop 500s in the recorder
+    w = list(c_p.services.names).index("worker")
+    err = np.asarray(tl_p.svc_errors, np.float64)[w]
+    arr = np.asarray(tl_p.svc_arrivals, np.float64)[w]
+    live = arr > 0
+    share = err[live].sum() / arr[live].sum()
+    assert 0.5 < share < 0.9  # ~0.75 of routed hops hit dead backends
+
+
+def test_panic_composes_with_policy_ejection():
+    """Protected-run composition: the panic inputs come from the
+    policy state's actuated pool (total) and its ejection remainder
+    (alive) — a forced PolicyFx with 3 of 4 ejected must panic a 50%
+    threshold and scale the admitted wait-law load."""
+    g = graph_with_lb(
+        "policies:\n  worker:\n"
+        "    lb: {policy: least_request, panic_threshold: 50%}\n"
+        "    breaker: {consecutive_errors: 5, "
+        "max_ejection_fraction: 0.9}\n"
+    )
+    compiled = compile_graph(g)
+    pt = compile_policies(g, compiled)
+    sim = Simulator(compiled, SimParams(timeline=True), policies=pt,
+                    lb=tables_for(g))
+    from isotope_tpu.sim import policies as pol_mod
+
+    S = compiled.num_services
+    w = list(compiled.services.names).index("worker")
+    alive = np.full(S, 8.0)
+    alive[w] = 1.0
+    total = np.full(S, 8.0)
+    total[w] = 4.0
+    fx = pol_mod.PolicyFx(
+        replicas=jnp.asarray(np.maximum(alive, 1.0), jnp.float32),
+        shed=jnp.zeros(S, jnp.float32),
+        retry_allow=jnp.ones(S, jnp.float32),
+        total=jnp.asarray(total, jnp.float32),
+        alive=jnp.asarray(alive, jnp.float32),
+    )
+    n = 2_048
+    res, _, _ = sim._simulate_core(
+        n, "open", 0, KEY, jnp.float32(20_000.0), jnp.float32(0.0),
+        jnp.float32(20_000.0), jnp.float32(0.0), jnp.float32(0.0),
+        jnp.zeros((1,), jnp.float32), jnp.float32(0.0),
+        policy_fx=fx,
+    )
+    worker_cols = np.nonzero(
+        np.asarray(compiled.hop_service) == w
+    )[0]
+    err = np.asarray(res.hop_error)[:, worker_cols]
+    sent = np.asarray(res.hop_sent)[:, worker_cols]
+    share = err.sum() / max(sent.sum(), 1)
+    assert 0.6 < share < 0.9  # 1 - 1/4 healthy ~ 0.75 fast-fails
+
+
+# -- canary composition ----------------------------------------------------
+
+
+def test_ring_hash_composes_with_canary_split():
+    """Hash stickiness respects version weights: each arm re-applies
+    the ring over its OWN pool.  Unit law: a 1-replica canary arm's
+    mixture collapses to M/M/1 of the canary lam regardless of skew;
+    end-to-end: a rollout over a ring-hash service runs and its
+    per-arm channel fills."""
+    g = ServiceGraph.from_yaml(CHAIN + """
+policies:
+  worker:
+    lb: {policy: ring_hash, hash_skew: 1.5}
+rollouts:
+  worker:
+    steps: ["25%", "100%"]
+    bake: 500ms
+    gates: {min_samples: 10}
+    canary: {replicas: 1}
+""")
+    compiled = compile_graph(g)
+    t = tables_for(g)
+    dlb = lb_mod.device_tables(t, 8)
+    w = list(compiled.services.names).index("worker")
+    # canary pool of 1: share vector is a point mass -> exact M/M/1
+    lam = np.zeros((1, compiled.num_services), np.float32)
+    lam[0, w] = 0.25 * 0.7 * MU
+    k1 = np.ones((1, compiled.num_services), np.int32)
+    qp = lb_mod.wait_params(t, dlb, jnp.asarray(lam), MU,
+                            jnp.asarray(k1), 8)
+    rho = float(lam[0, w]) / MU
+    assert np.isclose(float(qp.p_wait[0, w]), rho, rtol=1e-4)
+    assert np.isclose(float(qp.wait_rate[0, w]), MU * (1 - rho),
+                      rtol=1e-3)
+    rt = compile_rollouts(g, compiled)
+    sim = Simulator(compiled, SimParams(timeline=True), rollouts=rt,
+                    lb=t)
+    out = sim.run_rollouts(
+        LoadModel(kind="open", qps=10_000.0), 8_192, KEY,
+        block_size=2_048, window_s=0.25,
+    )
+    roll = out[2]
+    done = np.asarray(roll.windows_done) > 0
+    assert done.any()
+    # both arms actually served hops under the ring-hash law
+    arr = np.asarray(roll.ver_arrivals, np.float64)
+    assert arr[w, 0].sum() > 0 and arr[w, 1].sum() > 0
+
+
+# -- sharded twin ----------------------------------------------------------
+
+
+def test_sharded_lb_bit_equal_to_emulated_twin():
+    from isotope_tpu.parallel import (
+        MeshSpec,
+        ShardedSimulator,
+        build_mesh,
+    )
+
+    g = graph_with_lb(
+        "policies:\n  worker:\n"
+        "    lb: {policy: ring_hash, hash_skew: 1.2, "
+        "panic_threshold: 40%}\n"
+    )
+    compiled = compile_graph(g)
+    chaos = (ChaosEvent(service="worker", start_s=0.2, end_s=1.0,
+                        replicas_down=3),)
+    params = SimParams(timeline=True, timeline_window_s=0.25)
+    sh = ShardedSimulator(
+        compiled, build_mesh(MeshSpec(data=4, svc=1)), params, chaos,
+        lb=tables_for(g),
+    )
+    load = LoadModel(kind="open", qps=20_000.0)
+    out_dev = sh.run_timeline(load, 8_192, KEY, block_size=2_048,
+                              window_s=0.25)
+    out_em = sh.run_timeline_emulated(load, 8_192, KEY,
+                                      block_size=2_048, window_s=0.25)
+    _bit_equal(out_dev, out_em)
+
+
+# -- the lifted scan-bucket restriction ------------------------------------
+
+
+def _retry_chain(n=6, retries=1, timeout="600us"):
+    out = ["services:"]
+    names = ["entry"] + [f"s{i}" for i in range(1, n)]
+    for i, nm in enumerate(names):
+        out.append(f"- name: {nm}")
+        if i == 0:
+            out.append("  isEntrypoint: true")
+        out.append("  numReplicas: 4")
+        if i + 1 < n:
+            out.append("  script:")
+            out.append(
+                f"  - call: {{service: {names[i + 1]}, "
+                f"timeout: {timeout}, retries: {retries}}}"
+            )
+    return "\n".join(out) + """
+policies:
+  defaults:
+    retry_budget: {budget_percent: 5%, min_retries_concurrent: 0}
+  s3:
+    lb: {policy: least_request, choices_d: 2}
+"""
+
+
+def test_policies_simulator_keeps_bucketed_plan():
+    """The lifted restriction: a Simulator CARRYING policy tables now
+    plans scan buckets like any other (previously it forced the
+    unrolled trace)."""
+    from isotope_tpu.compiler.buckets import ScanBucketPlan
+
+    g = ServiceGraph.from_yaml(_retry_chain())
+    compiled = compile_graph(g)
+    sim = Simulator(
+        compiled,
+        SimParams(timeline=True, level_bucket_waste=8.0),
+        policies=compile_policies(g, compiled),
+        lb=compile_lb(g, compiled),
+    )
+    assert any(isinstance(p, ScanBucketPlan) for p in sim._plan)
+
+
+def test_protected_scan_bucket_pins_to_unrolled():
+    """The acceptance pin: run_policies under the default bucketed
+    plan vs the unrolled plan — <= 1 ULP on every leaf (same law,
+    same budget gate, the scan body's ops in lockstep with the
+    unrolled attempt loop)."""
+    g = ServiceGraph.from_yaml(_retry_chain())
+    compiled = compile_graph(g)
+    pt = compile_policies(g, compiled)
+    lt = compile_lb(g, compiled)
+    load = LoadModel(kind="open", qps=20_000.0)
+    args = dict(block_size=1_024, window_s=0.1)
+    pB = SimParams(timeline=True, timeline_window_s=0.1,
+                   level_bucket_waste=8.0)
+    pU = SimParams(timeline=True, timeline_window_s=0.1,
+                   bucketed_scan=False)
+    from isotope_tpu.compiler.buckets import ScanBucketPlan
+
+    simB = Simulator(compiled, pB, policies=pt, lb=lt)
+    assert any(isinstance(p, ScanBucketPlan) for p in simB._plan)
+    simU = Simulator(compiled, pU, policies=pt, lb=lt)
+    outB = simB.run_policies(load, 2_048, KEY, **args)
+    outU = simU.run_policies(load, 2_048, KEY, **args)
+    _ulp_equal(outB, outU)
+
+
+@pytest.mark.slow
+def test_protected_scan_bucket_storm_eager_bit_identical():
+    """Under a chaos storm the budget gate ACTUATES inside the scan
+    buckets; eagerly (no XLA fusion) the bucketed and unrolled
+    protected runs are bit-identical — the levelscan equivalence
+    contract extended to the budget gate.  (Under jit the closed
+    control loop amplifies FMA-contraction rounding across blocks, so
+    the jit pin lives in the no-storm test above.)"""
+    g = ServiceGraph.from_yaml(_retry_chain())
+    compiled = compile_graph(g)
+    pt = compile_policies(g, compiled)
+    chaos = (ChaosEvent(service="s4", start_s=0.1, end_s=0.4,
+                        replicas_down=3),)
+    load = LoadModel(kind="open", qps=40_000.0)
+    args = dict(block_size=2_048, window_s=0.1)
+    simB = Simulator(
+        compiled,
+        SimParams(timeline=True, timeline_window_s=0.1,
+                  level_bucket_waste=8.0),
+        chaos, policies=pt,
+    )
+    simU = Simulator(
+        compiled,
+        SimParams(timeline=True, timeline_window_s=0.1,
+                  bucketed_scan=False),
+        chaos, policies=pt,
+    )
+    with jax.disable_jit():
+        outB = simB.run_policies(load, 8_192, KEY, **args)
+        outU = simU.run_policies(load, 8_192, KEY, **args)
+    _bit_equal(outB, outU)
+    # and the budget visibly actuated (the gate is not dead code)
+    ra = np.asarray(outB[2].retry_allow)
+    done = np.asarray(outB[2].windows_done) > 0
+    assert done.any() and float(ra[:, done].min()) < 1.0
+
+
+# -- degraded-backend chaos site -------------------------------------------
+
+
+def test_degraded_backend_chaos_site():
+    """The gray-failure site: one backend's weight collapses in the
+    traced profile — the wrr pool's survivors absorb its share (the
+    physics shift is visible), the spec participates in the
+    trace-affecting fault signature, and the standard kinds raise
+    classified faults at the run entry (supervisor retry path, pinned
+    like the PR 9 policy sites)."""
+    from isotope_tpu.resilience import (
+        ResiliencePolicy,
+        call_with_retries,
+    )
+    from isotope_tpu.resilience.taxonomy import TRANSIENT, classify
+
+    plan = faults.FaultPlan.parse("degrade:lb.degraded_backend:1")
+    assert plan.lb_degraded_backend() == (1, plan.DEGRADED_FACTOR)
+    assert "degrade:lb.degraded_backend:1" in plan.signature()
+    with pytest.raises(ValueError, match="degrade faults target"):
+        faults.FaultPlan.parse("degrade:engine.run")
+
+    g = graph_with_lb(
+        "policies:\n  worker:\n"
+        "    lb: {policy: wrr, weights: [1, 1, 1, 1]}\n"
+    )
+    compiled = compile_graph(g)
+    load = LoadModel(kind="open", qps=45_000.0)
+    try:
+        faults.clear()
+        clean = Simulator(compiled, lb=tables_for(g)).run_summary(
+            load, 4_096, KEY, block_size=1_024
+        )
+        faults.install("degrade:lb.degraded_backend:0")
+        degraded = Simulator(compiled, lb=tables_for(g)).run_summary(
+            load, 4_096, KEY, block_size=1_024
+        )
+        # a collapsed backend concentrates its share on 3 survivors:
+        # rho_b 0.87 -> ~1.16 saturates them; waits explode
+        assert float(degraded.latency_sum) > 1.5 * float(
+            clean.latency_sum
+        )
+        # classified-fault entry + supervisor retry
+        faults.install("transient:lb.degraded_backend:1")
+        sim = Simulator(compiled, lb=tables_for(g))
+        with pytest.raises(Exception) as e:
+            sim.run_summary(load, 512, KEY, block_size=256)
+        assert classify(e.value) == TRANSIENT
+        faults.install("transient:lb.degraded_backend:1")
+        out = call_with_retries(
+            lambda: sim.run_summary(load, 512, KEY, block_size=256),
+            site="lb.run",
+            policy=ResiliencePolicy(max_retries=2,
+                                    sleep=lambda s: None),
+        )
+        assert float(out.count) >= 512
+    finally:
+        faults.clear()
+
+
+# -- feedback mirror -------------------------------------------------------
+
+
+def test_feedback_mirrors_lb_wait_law():
+    """The visit fixed point integrates the LB wait law through the
+    numpy mirror: np_wait_stats agrees with the traced device law,
+    the mirror's skewed mean wait exceeds the aggregate M/M/k's at
+    the same load, and a Simulator with lb tables solves a DIFFERENT
+    fixed point than the fifo twin."""
+    # mirror == device law (per service, both laws)
+    g = ServiceGraph.from_yaml(CHAIN + """
+policies:
+  entry:
+    lb: {policy: least_request, choices_d: 3}
+  worker:
+    lb: {policy: ring_hash, hash_skew: 2.0}
+""")
+    t = tables_for(g)
+    prof = t.backend_profile(8)
+    lam = np.array([0.6 * 8 * MU, 0.5 * 4 * MU])
+    k = np.array([8.0, 4.0])
+    p_np, r_np = lb_mod.np_wait_stats(t, prof, lam, MU, k)
+    dlb = lb_mod.device_tables(t, 8)
+    qp = lb_mod.wait_params(
+        t, dlb, jnp.asarray(lam[None, :], jnp.float32), MU,
+        jnp.asarray(k[None, :], jnp.int32), 8,
+    )
+    np.testing.assert_allclose(p_np, np.asarray(qp.p_wait)[0],
+                               rtol=1e-4)
+    np.testing.assert_allclose(r_np, np.asarray(qp.wait_rate)[0],
+                               rtol=1e-3)
+    # the skewed mirror sees the hot arc the aggregate law misses
+    from isotope_tpu.sim.feedback import np_mmk
+
+    p_f, r_f, _ = np_mmk(lam, MU, k)
+    assert p_np[1] / r_np[1] > 2.0 * (p_f[1] / r_f[1])
+    # and the engine's fixed point actually consumes the mirror
+    topo = """
+services:
+- name: entry
+  isEntrypoint: true
+  numReplicas: 8
+  script:
+  - call: {service: worker, timeout: 2ms, retries: 2}
+- name: worker
+  numReplicas: 4
+"""
+    g0 = ServiceGraph.from_yaml(topo)
+    sim0 = Simulator(compile_graph(g0))
+    g1 = ServiceGraph.from_yaml(topo + """
+policies:
+  worker:
+    lb: {policy: ring_hash, hash_skew: 2.0}
+""")
+    c1 = compile_graph(g1)
+    sim1 = Simulator(c1, lb=compile_lb(g1, c1))
+    assert sim0._feedback is not None
+    assert sim1._feedback is not None and sim1._feedback.lb is not None
+    qps = 0.3 * 4 * MU
+    v0 = sim0._feedback.visits_pc(qps)
+    v1 = sim1._feedback.visits_pc(qps)
+    assert not np.allclose(v0, v1)
+
+
+# -- artifacts / reporting -------------------------------------------------
+
+
+def test_to_doc_and_format_table():
+    g = graph_with_lb(
+        "policies:\n  worker:\n"
+        "    lb: {policy: wrr, weights: [3, 1, 1, 1], "
+        "panic_threshold: 25%}\n"
+    )
+    compiled = compile_graph(g)
+    t = tables_for(g)
+    sim = Simulator(compiled, SimParams(timeline=True), lb=t)
+    _, tl = sim.run_timeline(
+        LoadModel(kind="open", qps=5_000.0), 4_096, KEY,
+        block_size=1_024, window_s=0.2,
+    )
+    doc = lb_mod.to_doc(t, tl=tl)
+    assert doc["schema"] == "isotope-lb/v1"
+    svc = doc["services"]["worker"]
+    assert svc["policy"] == "wrr"
+    assert svc["panic_threshold"] == 0.25
+    np.testing.assert_allclose(
+        svc["share"], [0.5, 1 / 6, 1 / 6, 1 / 6], atol=1e-6
+    )
+    assert svc["window_split"] and all(
+        len(row) == 4 for row in svc["window_split"]
+    )
+    # split reconciles with the recorder's arrivals
+    w = list(compiled.services.names).index("worker")
+    arr = np.asarray(tl.svc_arrivals, np.float64)[w]
+    total_split = sum(sum(r) for r in svc["window_split"])
+    assert np.isclose(
+        total_split, arr[: len(svc["window_split"])].sum(), rtol=1e-3
+    )
+    text = lb_mod.format_table(doc)
+    assert "worker" in text and "wrr" in text and "panic<25%" in text
+    # entry declares nothing: absent from the doc
+    assert "entry" not in doc["services"]
+
+
+def test_to_doc_truncates_to_completed_policy_windows():
+    """Protected runs pass a PolicySummary: the split must stop at
+    pol.windows_done — never-advanced windows are zero-filled on
+    device and would read as a pool collapsed to one backend."""
+    from isotope_tpu.sim import policies as pol_mod
+
+    g = graph_with_lb(
+        "policies:\n  worker:\n"
+        "    lb: {policy: wrr, weights: [3, 1, 1, 1]}\n"
+    )
+    t = tables_for(g)
+    S, W = 2, 4
+    arr = np.zeros((S, W))
+    arr[1] = [40.0, 40.0, 0.0, 0.0]
+
+    class _Tl:
+        svc_arrivals = arr
+
+    eff = np.zeros((S, W))
+    eff[:, 0] = [8.0, 4.0]  # only window 0 completed
+    pol = pol_mod.PolicySummary(
+        window_s=np.float32(0.5),
+        replicas=eff, effective=eff, shed=np.zeros((S, W)),
+        retry_allow=np.ones((S, W)), ejected=np.zeros((S, W)),
+        breaker_open=np.zeros((S, W)),
+        windows_done=np.array([1.0, 0.0, 0.0, 0.0]),
+        trips=np.zeros(S), ejections=np.zeros(S),
+        scale_events=np.zeros(S),
+    )
+    doc = lb_mod.to_doc(t, tl=_Tl(), pol=pol)
+    split = doc["services"]["worker"]["window_split"]
+    assert len(split) == 1 and len(split[0]) == 4
+
+
+# -- vet rules -------------------------------------------------------------
+
+
+def test_vet_lb_rules():
+    from isotope_tpu.analysis.topo_lint import lint_graph
+
+    def rules(extra):
+        g = ServiceGraph.from_yaml(CHAIN + extra)
+        return [
+            (f.rule, f.severity)
+            for f in lint_graph(g)
+            if f.rule in ("VET-T019", "VET-T020", "VET-T021",
+                          "VET-T022")
+        ]
+
+    assert rules(
+        "policies:\n  worker:\n"
+        "    lb: {policy: least_request, choices_d: 9}\n"
+    ) == [("VET-T019", "warn")]
+    one_replica = CHAIN.replace("numReplicas: 4", "numReplicas: 1")
+    g1 = ServiceGraph.from_yaml(
+        one_replica + "policies:\n  worker:\n    lb: ring_hash\n"
+    )
+    from isotope_tpu.analysis.topo_lint import lint_graph as lg
+
+    assert [(f.rule, f.severity) for f in lg(g1)
+            if f.rule == "VET-T020"] == [("VET-T020", "info")]
+    assert rules(
+        "policies:\n  worker:\n"
+        "    lb: {policy: fifo, panic_threshold: 100%}\n"
+    ) == [("VET-T021", "error")]
+    assert rules(
+        "policies:\n  worker:\n"
+        "    lb: {policy: fifo, panic_threshold: 20%}\n"
+        "    breaker: {consecutive_errors: 5, "
+        "max_ejection_fraction: 50%}\n"
+    ) == [("VET-T021", "warn")]
+    assert rules(
+        "policies:\n  worker:\n    lb: {policy: bogus}\n"
+    ) == [("VET-T022", "error")]
+    # clean entry: no lb findings
+    assert rules(LB_LR) == []
+
+
+def test_vet_clean_lb_no_findings():
+    from isotope_tpu.analysis.topo_lint import lint_graph
+
+    g = ServiceGraph.from_yaml(CHAIN + """
+policies:
+  defaults:
+    lb: least_request
+  worker:
+    lb: {policy: wrr, weights: [2, 1, 1, 1], panic_threshold: 30%}
+""")
+    assert [f for f in lint_graph(g)
+            if f.rule.startswith("VET-T0") and f.rule >= "VET-T019"] \
+        == []

@@ -134,12 +134,13 @@ def test_policies_round_trips_through_encode():
 def test_policies_off_byte_identical():
     """The acceptance pin: a Simulator WITHOUT policy tables (the
     default) and one CARRYING tables trace the same plain-run program —
-    run_summary outputs are bit-equal leaf by leaf.  (Same bucket plan:
-    the policies build forces the unrolled trace, so the comparison
-    fixes bucketed_scan=False on both sides.)"""
+    run_summary outputs are bit-equal leaf by leaf.  Both sides share
+    the DEFAULT bucketed plan: the bucket planner no longer depends on
+    policy-table presence (the retry-budget gate reached the scan
+    body, sim/levelscan.py)."""
     g = graph_with_policies()
     compiled = compile_graph(g)
-    params = SimParams(bucketed_scan=False)
+    params = SimParams()
     load = LoadModel(kind="open", qps=2_000.0)
     a = Simulator(compiled, params).run_summary(
         load, 4_096, KEY, block_size=1_024

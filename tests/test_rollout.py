@@ -16,7 +16,6 @@ from isotope_tpu.compiler import (
     compile_policies,
     compile_rollouts,
 )
-from isotope_tpu.metrics import timeline as timeline_mod
 from isotope_tpu.models.graph import ServiceGraph
 from isotope_tpu.resilience import faults
 from isotope_tpu.sim import rollout as roll_mod

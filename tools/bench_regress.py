@@ -111,7 +111,7 @@ def _cases(doc: dict, prefer_best: bool = False) -> dict:
         if k.endswith(("_inflight", "_spread", "_census", "_best",
                        "_compile_s", "_warmup_windows",
                        "_timeline_overhead", "_mesh_layout_score",
-                       "_rollout")):
+                       "_rollout", "_lb")):
             continue  # evidence / variance keys, not rates
         cases[k] = float(v)
     if prefer_best:
