@@ -8,9 +8,9 @@ DURATION ?= 120s
 
 .PHONY: test lint vet-smoke bench telemetry-smoke resilience-smoke \
 	attribution-smoke sparse-smoke timeline-smoke multihost-smoke \
-	policies-smoke rollout-smoke lb-smoke examples canonical tree star \
-	multitier auxiliary-services star-auxiliary latency cpu_mem dot \
-	clean
+	policies-smoke rollout-smoke lb-smoke ensemble-smoke examples \
+	canonical tree star multitier auxiliary-services star-auxiliary \
+	latency cpu_mem dot clean
 
 test:
 	$(PY) -m pytest tests/ -x -q
@@ -191,6 +191,15 @@ rollout-smoke:
 # keeps goodput nonzero through a 3/4-replica ejection storm
 lb-smoke:
 	$(PY) tools/lb_smoke.py
+
+# scenario-ensemble end-to-end check (sim/ensemble.py): a 32-member
+# svc-scale fleet on CPU — exactly one compile serves every member
+# (telemetry trace/cache counters), the P(SLO-violation) estimate
+# with its Wilson CI matches the brute-force per-seed loop exactly
+# (member k bit-equals the solo run with that folded seed), and the
+# fleet's aggregate wall-clock beats the sequential dispatch loop.
+ensemble-smoke:
+	$(PY) tools/ensemble_smoke.py
 
 examples:
 	$(PY) tools/gen_examples.py

@@ -66,6 +66,7 @@ CASE_ORDER = [
     "tree121",
     "closed64",
     "svc1000",
+    "ensembleN",
     "realistic50",
     "rollout50",
     "svc10k",
@@ -383,6 +384,80 @@ def run_case(name: str) -> dict:
         med, spread, best, first_s = measure(
             sim, LoadModel(kind="open", qps=10_000.0), 262_144, 32_768
         )
+    elif name == "ensembleN":
+        # scenario ensembles (sim/ensemble.py): svc1000 x N seed
+        # members behind ONE jitted program (run_ensemble).  The case
+        # rate is the fleet's AGGREGATE hop-events/s; the embedded
+        # evidence carries the member count, the fleet's engine-trace
+        # delta (exactly ONE compile serves every member), the
+        # N-sequential-solo-dispatch rate of the SAME member keys
+        # (the Python case loop the fleet replaces, host sync per
+        # member like runner/run.py), and the aggregate speedup.
+        # tools/bench_regress.py gates the per-member throughput
+        # (opt-in BENCH_REGRESS_ENSEMBLE_THRESHOLD) and excludes the
+        # evidence keys from the plain rate comparison.
+        from isotope_tpu.sim.ensemble import EnsembleSpec
+
+        with open("examples/topologies/1000-svc_2000-end.yaml") as f:
+            doc = yaml.safe_load(f)
+        sim = Simulator(compile_graph(ServiceGraph.decode(doc)))
+        # screening-fleet shape: MANY members, SHORT horizons — the
+        # successive-halving / what-if-triage regime where the Python
+        # case loop's per-dispatch overhead dominates and the fleet's
+        # one-dispatch amortization pays even on a 1-core CPU (the
+        # >= 2x acceptance bar).  Longer-horizon fleets converge to
+        # compute parity per member on CPU; on TPU the vmap batch dim
+        # feeds the MXU, so the TPU case runs wider blocks.
+        members = int(os.environ.get(
+            "BENCH_ENSEMBLE_MEMBERS", "32" if on_tpu else "128"
+        ))
+        spec = EnsembleSpec.of(members)
+        load_e = LoadModel(kind="open", qps=10_000.0)
+        n_e = int(os.environ.get(
+            "BENCH_ENSEMBLE_REQUESTS", "8192" if on_tpu else "16"
+        ))
+        b_e = min(n_e, 8_192 if on_tpu else 1_024)
+        traces0 = telemetry.counter_get("engine_traces")
+
+        def ens_runner(s_, l_, n_, k_, b_):
+            return s_.run_ensemble(
+                l_, n_, k_, spec, block_size=b_
+            ).pooled()
+
+        med, spread, best, first_s = measure(
+            sim, load_e, n_e, b_e, warm=2, iters=2,
+            runner=ens_runner,
+        )
+        out[f"{name}_ensemble_members"] = members
+        out[f"{name}_ensemble_traces"] = int(
+            telemetry.counter_get("engine_traces") - traces0
+        )
+
+        # the sequential baseline: N solo dispatches of the SAME
+        # member keys, one host sync each (the case-loop pattern)
+        key_e = jax.random.PRNGKey(0)
+
+        def solo_loop(k):
+            tot = 0.0
+            for s_i in spec.seeds:
+                s = sim.run_summary(
+                    load_e, n_e, jax.random.fold_in(k, s_i),
+                    block_size=b_e,
+                )
+                tot += float(s.hop_events)
+            return tot
+
+        hops_total = solo_loop(key_e)  # warm: compiles the solo path
+        solo_best = 0.0
+        for w in range(3):
+            t0 = time.perf_counter()
+            hops_total = solo_loop(jax.random.fold_in(key_e, 900 + w))
+            dt = time.perf_counter() - t0
+            solo_best = max(solo_best, hops_total / dt)
+        out[f"{name}_ensemble_solo_rate"] = solo_best
+        out[f"{name}_ensemble_speedup"] = round(
+            med / max(solo_best, 1e-9), 3
+        )
     elif name == "realistic50":
         sim = Simulator(
             compile_graph(
@@ -673,7 +748,10 @@ def main() -> None:
             print(f"bench:   probe| {tail_line}", file=sys.stderr)
         sys.exit(1)
     on_tpu = platform != "cpu"
-    names = CASE_ORDER if on_tpu else ["tree121"]
+    # CPU keeps the cheap cases: the headline tree plus the ensemble
+    # fleet (its acceptance bar — >= 2x aggregate vs N sequential solo
+    # dispatches with ONE compile — is a CPU-checkable claim)
+    names = CASE_ORDER if on_tpu else ["tree121", "ensembleN"]
 
     extra: dict = {}
     for name in names:

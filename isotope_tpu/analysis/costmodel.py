@@ -544,6 +544,71 @@ def timeline_findings(estimate: CostEstimate) -> List[Finding]:
     )]
 
 
+def ensemble_chunk(
+    members: int,
+    peak_bytes_per_member: float,
+    capacity_bytes: Optional[float],
+    fill: float = CAPACITY_FILL,
+) -> int:
+    """Members per device dispatch for a Monte Carlo fleet
+    (sim/ensemble.py): the vmapped member axis multiplies every event
+    tensor, so ``members * peak_bytes`` must fit the capacity budget.
+
+    Balanced split: when the fleet must chunk, the chunk count is
+    minimized first and members spread evenly across chunks (a
+    33-member fleet over a 16-member budget runs 11+11+11, not
+    16+16+1), so every chunk reuses ONE compiled program shape after
+    the last chunk pads.
+    Unknown capacity (CPU backend, no env override) runs the whole
+    fleet in one dispatch — the vet gate never invents OOMs it cannot
+    substantiate.  Pre-computed at plan time the way the VET-M memory
+    verdict pre-selects degradation-ladder rungs.  CPU-era heuristic:
+    the real-TPU retune rides the ROADMAP calibration-debt item.
+    """
+    members = max(int(members), 1)
+    if (
+        capacity_bytes is None
+        or capacity_bytes <= 0
+        or peak_bytes_per_member <= 0
+    ):
+        return members
+    budget = fill * float(capacity_bytes)
+    per_dispatch = int(budget // float(peak_bytes_per_member))
+    if per_dispatch >= members:
+        return members
+    per_dispatch = max(per_dispatch, 1)
+    num_chunks = -(-members // per_dispatch)
+    return -(-members // num_chunks)
+
+
+def ensemble_findings(
+    estimate: CostEstimate,
+    members: int,
+) -> List[Finding]:
+    """The VET-M004 verdict: an ensemble fleet whose
+    ``members x peak-bytes`` exceeds the device budget — WARN (never
+    blocking): the engine pre-computes the member chunk and splits the
+    fleet instead of OOMing, and the finding reports that auto-chunk.
+    """
+    cap = estimate.capacity_bytes
+    members = int(members)
+    if members <= 1 or cap is None or cap <= 0:
+        return []
+    peak = estimate.peak_bytes_at_block
+    budget = CAPACITY_FILL * cap
+    if members * peak <= budget:
+        return []
+    chunk = ensemble_chunk(members, peak, cap)
+    return [Finding(
+        "VET-M004", SEV_WARN,
+        f"ensemble of {members} members needs {members * peak:.3g} B "
+        f"(> the {budget:.3g} B budget, {CAPACITY_FILL:.0%} of "
+        f"{cap:.3g} B capacity); the fleet will run in member chunks "
+        f"of {chunk} — shrink the block or the fleet to run it in "
+        "one dispatch",
+    )]
+
+
 def memory_findings(
     estimate: CostEstimate,
     rung_names: Sequence[str] = ("scan", "half-block", "cpu-eager"),

@@ -68,6 +68,12 @@ RULES: Dict[str, str] = {
     "VET-M003": "timeline recorder carries (O(services x windows) per "
                 "scan block) take a large share of device capacity; "
                 "the window planner will clamp or widen windows",
+    # -- scenario ensembles (sim/ensemble.py) ------------------------------
+    "VET-T023": "ensemble spec has zero members or duplicate member "
+                "seeds (duplicated members are bit-identical copies, "
+                "not extra Monte Carlo samples)",
+    "VET-M004": "ensemble members x peak-bytes exceed device capacity; "
+                "the fleet runs in pre-computed member chunks",
 }
 
 

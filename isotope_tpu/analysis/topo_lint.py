@@ -442,6 +442,41 @@ def _lint_lb(graph: ServiceGraph, params) -> List[Finding]:
     return findings
 
 
+def lint_ensemble(spec) -> List[Finding]:
+    """Ensemble-spec misconfiguration rules (VET-T023) over an
+    :class:`~isotope_tpu.sim.ensemble.EnsembleSpec`.
+
+    VET-T023 errors on a fleet with zero members (nothing to
+    simulate) or duplicate member seeds: duplicated seeds make two
+    members bit-identical copies of one trajectory, silently
+    narrowing every confidence interval the ensemble exists to
+    produce.  ``run_ensemble`` raises the same defects loudly at run
+    entry (sim/ensemble.py ``EnsembleSpec.check``)."""
+    findings: List[Finding] = []
+    if spec is None:
+        return findings
+    if spec.members == 0:
+        findings.append(Finding(
+            "VET-T023", SEV_ERROR,
+            "ensemble spec has zero members: the fleet would simulate "
+            "nothing (set members >= 1 or drop the ensemble)",
+            path="sim.ensemble",
+        ))
+        return findings
+    seeds = tuple(spec.seeds)
+    dupes = sorted({s for s in seeds if seeds.count(s) > 1})
+    if dupes:
+        findings.append(Finding(
+            "VET-T023", SEV_ERROR,
+            f"ensemble spec has duplicate member seeds {dupes}: "
+            "duplicated members replay one trajectory bit-for-bit — "
+            "they are not extra Monte Carlo samples and silently "
+            "narrow every confidence interval",
+            path="sim.ensemble",
+        ))
+    return findings
+
+
 def lint_compiled(compiled, params=None) -> List[Finding]:
     """Shape rules needing the unrolled hop tree (VET-T007/T008).
 
@@ -694,6 +729,17 @@ def lint_config(config) -> Tuple[List[Finding], Dict[str, object]]:
             findings.extend(
                 _lint_rollout_samples(g, compiled, config.qps)
             )
+
+    # VET-T023: the sweep's ensemble spec (zero members / duplicate
+    # seeds) — config-level, so a broken fleet fails before any
+    # topology compiles
+    if getattr(config, "ensemble", 0):
+        try:
+            findings.extend(lint_ensemble(config.ensemble_spec()))
+        except ValueError as e:
+            findings.append(Finding(
+                "VET-T023", SEV_ERROR, str(e), path="sim.ensemble",
+            ))
     return findings, graphs
 
 

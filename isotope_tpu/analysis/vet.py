@@ -96,6 +96,7 @@ def vet_simulator(
     device_bytes: Optional[float] = None,
     suppress=(),
     rung_names=("scan", "half-block", "cpu-eager"),
+    ensemble=None,
 ) -> Report:
     """Full vet of one built Simulator under one load.
 
@@ -105,6 +106,11 @@ def vet_simulator(
     (``trace=False`` degrades the cost model to the plan-only
     estimate).  The recommended ladder start rung lands in
     ``report.meta['start_rung']``.
+
+    ``ensemble`` (an EnsembleSpec, or a member count) additionally
+    lints the fleet spec (VET-T023) and runs the member-capacity
+    verdict (VET-M004: members x peak-bytes vs device budget,
+    reporting the auto-chunk the engine would pre-select).
     """
     report = Report(suppress=suppress)
     with telemetry.phase("vet.total"):
@@ -133,6 +139,22 @@ def vet_simulator(
         )
         report.extend(mem_findings)
         report.extend(costmodel.timeline_findings(est))
+        if ensemble is not None:
+            if isinstance(ensemble, int):
+                from isotope_tpu.sim.ensemble import EnsembleSpec
+
+                ensemble = EnsembleSpec.of(ensemble)
+            report.extend(topo_lint.lint_ensemble(ensemble))
+            report.extend(costmodel.ensemble_findings(
+                est, ensemble.members,
+            ))
+            report.meta["ensemble"] = {
+                "members": ensemble.members,
+                "chunk": costmodel.ensemble_chunk(
+                    ensemble.members, est.peak_bytes_at_block,
+                    est.capacity_bytes,
+                ),
+            }
         report.meta["cost"] = {
             "block_requests": est.block_requests,
             "flops_at_block": est.flops_at_block,

@@ -105,6 +105,50 @@ def _add_rollouts_args(parser) -> None:
              "composes with --policies in the same carry)")
 
 
+def _add_ensemble_args(parser) -> None:
+    """The scenario-ensemble knobs (sim/ensemble.py), shared by
+    simulate and sweep."""
+    parser.add_argument(
+        "--ensemble", type=int, default=None, metavar="N",
+        help="Monte Carlo fleet: run every case as N seed members in "
+             "ONE jitted program per device (member k bit-equals a "
+             "solo run with fold_in(run_key, k)); the reported row "
+             "pools the members and <label>.ensemble.json carries "
+             "per-member quantiles, quantile bands, and the "
+             "SLO-violation probability with a Wilson CI")
+    parser.add_argument(
+        "--ensemble-jitter", default=None, metavar="SPEC",
+        help="per-member perturbations as axis=sigma pairs, e.g. "
+             "'qps=0.1,cpu=0.05,error=0.2[,seed=K]': mean-preserving "
+             "lognormal factors on the offered qps, per-request CPU "
+             "demand, and per-hop error rates (deterministic per "
+             "seed K)")
+    parser.add_argument(
+        "--ensemble-slo", default=None, metavar="LATENCY",
+        help="SLO latency (e.g. '250ms') the ensemble artifact's "
+             "P(p99 > SLO) estimate targets")
+
+
+def _ensemble_config_kwargs(args) -> dict:
+    """ExperimentConfig overrides from the --ensemble* flags."""
+    out: dict = {}
+    if args.ensemble is not None:
+        out["ensemble"] = int(args.ensemble)
+    if args.ensemble_jitter is not None:
+        from isotope_tpu.sim.ensemble import parse_jitter_spec
+
+        j = parse_jitter_spec(args.ensemble_jitter)
+        out["ensemble_qps_jitter"] = j["qps_jitter"]
+        out["ensemble_cpu_jitter"] = j["cpu_jitter"]
+        out["ensemble_error_jitter"] = j["error_jitter"]
+        out["ensemble_jitter_seed"] = j.get("jitter_seed", 0)
+    if args.ensemble_slo is not None:
+        out["ensemble_slo_s"] = dur.parse_duration_seconds(
+            args.ensemble_slo
+        )
+    return out
+
+
 def _add_mesh_args(parser) -> None:
     """The mesh-layout knobs (parallel/mesh.py + parallel/layout.py),
     shared by simulate and sweep."""
@@ -246,6 +290,10 @@ def register(sub) -> None:
                    help="write the timestamped Prometheus exposition "
                         "(one sample per window, like a scrape "
                         "sequence)")
+    _add_ensemble_args(s)
+    s.add_argument("--ensemble-out", metavar="FILE", default=None,
+                   help="write the ensemble's distributional summary "
+                        "as JSON (isotope-ensemble/v1)")
     _add_mesh_args(s)
     _add_resilience_args(s)
     _add_vet_arg(s)
@@ -301,6 +349,7 @@ def register(sub) -> None:
     _add_timeline_args(w)
     _add_policies_args(w)
     _add_rollouts_args(w)
+    _add_ensemble_args(w)
     _add_mesh_args(w)
     _add_resilience_args(w)
     _add_vet_arg(w)
@@ -386,6 +435,7 @@ def run_simulate(args) -> int:
         rollouts=args.rollouts,
         mesh_spec=args.mesh,
         overlap=args.overlap,
+        **_ensemble_config_kwargs(args),
         **extra,
     )
     (result,) = run_experiment(config, policy=_policy(args),
@@ -438,6 +488,34 @@ def run_simulate(args) -> int:
         print(
             "warning: --rollouts set but the topology declares no "
             "active rollouts block (open-loop run)",
+            file=sys.stderr,
+        )
+    if result.ensemble is not None:
+        d = result.ensemble
+        band = d["quantile_band_p99"]
+        line = (
+            f"ensemble: {d['members']} members (chunk {d['chunk']}): "
+            f"p99 band [{band['lo_s'] * 1e3:.2f}, "
+            f"{band['mid_s'] * 1e3:.2f}, {band['hi_s'] * 1e3:.2f}] ms"
+        )
+        if "slo" in d:
+            s = d["slo"]
+            line += (
+                f"; P(p{s['quantile'] * 100:g} > "
+                f"{s['slo_s'] * 1e3:g}ms) = {s['p_violation']:.3f} "
+                f"[{s['ci_lo']:.3f}, {s['ci_hi']:.3f}] "
+                f"@{s['confidence']:.0%}"
+            )
+        print(line, file=sys.stderr)
+        if args.ensemble_out:
+            with open(args.ensemble_out, "w") as f:
+                json.dump(d, f, indent=2)
+            print(f"ensemble -> {args.ensemble_out}", file=sys.stderr)
+    elif args.ensemble:
+        print(
+            "warning: --ensemble set but the run was not served by a "
+            "fleet dispatch (protected co-sim runs and fleet "
+            "failures fall back to the solo path)",
             file=sys.stderr,
         )
     if result.lb is not None:
@@ -686,6 +764,9 @@ def run_sweep(args) -> int:
         config = dataclasses.replace(config, policies=True)
     if args.rollouts and not config.rollouts:
         config = dataclasses.replace(config, rollouts=True)
+    ens_kw = _ensemble_config_kwargs(args)
+    if ens_kw:
+        config = dataclasses.replace(config, **ens_kw)
     tl_window = _timeline_window(args)
     if tl_window is None and config.timeline:
         # [sim] timeline = true in the TOML arms the pass without a

@@ -27,8 +27,10 @@ from isotope_tpu.compiler.cache import (
 )
 from isotope_tpu.compiler.compile import (
     CycleError,
+    EnsembleTables,
     HopBudgetExceededError,
     NoEntrypointError,
+    compile_ensemble,
     compile_graph,
     compile_lb,
     compile_policies,
@@ -43,8 +45,10 @@ __all__ = [
     "ServiceTable",
     "UnrolledLevelPlan",
     "CycleError",
+    "EnsembleTables",
     "HopBudgetExceededError",
     "NoEntrypointError",
+    "compile_ensemble",
     "compile_graph",
     "compile_lb",
     "compile_policies",

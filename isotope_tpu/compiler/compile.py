@@ -16,7 +16,7 @@ strictly safer.
 from __future__ import annotations
 
 import dataclasses
-from typing import List, Optional, Sequence, Tuple
+from typing import List, NamedTuple, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -190,6 +190,56 @@ def compile_rollouts(graph: ServiceGraph, compiled: CompiledGraph):
     tables = rollout_mod.build_tables(rset, compiled.services)
     telemetry.counter_inc("rollouts_compiled")
     return tables
+
+
+class EnsembleTables(NamedTuple):
+    """Stacked device tables of one Monte Carlo fleet (sim/ensemble.py)
+    — the ``(N,)``-leading leaves the engine's vmapped summary program
+    consumes.
+
+    ``qps_scale`` stays host-side (it reshapes the per-member offered
+    rate, visit tables and trim windows BEFORE tracing); ``cpu_scale``
+    / ``err_scale`` are the traced per-member physics arguments (all
+    ones when the spec leaves that axis off — the vmapped program is
+    specialized on ``jittered``, not on the values).  The trace facts
+    the executable cache keys on are the chunk WIDTH (not the total
+    fleet size), ``jittered``, and ``mode`` — see
+    ``Simulator._get_ensemble``.
+    """
+
+    members: int
+    seeds: Tuple[int, ...]
+    qps_scale: "object"   # (N,) np.float64, all-ones when off
+    cpu_scale: "object"   # (N,) jnp.float32
+    err_scale: "object"   # (N,) jnp.float32
+    jittered: bool
+    mode: str             # "vmap" | "map" (auto already resolved)
+
+
+def compile_ensemble(spec) -> EnsembleTables:
+    """Lower an :class:`~isotope_tpu.sim.ensemble.EnsembleSpec` to the
+    stacked tables the engine's vmapped fleet program consumes.  The
+    scale VALUES ride as traced arguments, so re-drawn jitters reuse
+    the compiled fleet program.
+    """
+    import jax.numpy as jnp
+    import numpy as np
+
+    n = spec.members
+    ones = np.ones(max(n, 1), np.float64)
+    qps = ones if spec.qps_scale is None else spec.qps_scale
+    cpu = ones if spec.cpu_scale is None else spec.cpu_scale
+    err = ones if spec.error_scale is None else spec.error_scale
+    telemetry.counter_inc("ensembles_compiled")
+    return EnsembleTables(
+        members=n,
+        seeds=tuple(spec.seeds),
+        qps_scale=np.asarray(qps, np.float64),
+        cpu_scale=jnp.asarray(cpu, jnp.float32),
+        err_scale=jnp.asarray(err, jnp.float32),
+        jittered=spec.jittered,
+        mode=spec.resolved_mode(),
+    )
 
 
 def compile_graph(

@@ -238,7 +238,13 @@ class MetricsCollector:
     def full_text(self, summary) -> str:
         """The complete exposition for a run summary: the five service
         series plus the sim-side resource series — what a scraper (and
-        the alarm queries) should see."""
+        the alarm queries) should see.  A summary without collector
+        metrics (ensemble fleet runs keep the per-service series out
+        of the vmapped program) renders the resource series only."""
+        if summary.metrics is None:
+            return self.resource_text(
+                None, summary.utilization, float(summary.end_max)
+            )
         return self.to_text(summary.metrics) + self.resource_text(
             summary.metrics, summary.utilization, float(summary.end_max)
         )
@@ -261,10 +267,22 @@ class MetricsCollector:
         util = np.asarray(utilization, np.float64)
         cpu_s = util * reps * float(duration_s)
 
-        inc = np.asarray(m.incoming_total, np.float64)
-        lat_sum = np.asarray(m.duration_sum, np.float64).sum(1)
-        rate = inc / duration_s if duration_s > 0 else np.zeros_like(inc)
-        mean_lat = np.where(inc > 0, lat_sum / np.maximum(inc, 1.0), 0.0)
+        if m is None:
+            # no collector series (ensemble fleet summaries): the
+            # memory estimate's rate/latency inputs are unavailable
+            inc = np.zeros(len(names))
+            rate = np.zeros(len(names))
+            mean_lat = np.zeros(len(names))
+        else:
+            inc = np.asarray(m.incoming_total, np.float64)
+            lat_sum = np.asarray(m.duration_sum, np.float64).sum(1)
+            rate = (
+                inc / duration_s if duration_s > 0
+                else np.zeros_like(inc)
+            )
+            mean_lat = np.where(
+                inc > 0, lat_sum / np.maximum(inc, 1.0), 0.0
+            )
         # mean request payload arriving at each service (static per hop)
         req_sum = np.zeros(len(names))
         req_cnt = np.zeros(len(names))

@@ -626,6 +626,231 @@ class ShardedSimulator:
             unstable=local.unstable,
         )
 
+    # -- scenario ensembles (sim/ensemble.py) ---------------------------
+
+    def _plan_ensemble(self, load, num_requests: int, key, spec,
+                       block_size: int, trim: bool, member_keys,
+                       member_qps=None):
+        """Resolve (spec, tables, stacked args, members-per-shard) for
+        one fleet dispatch.  Each member is a FULL run of
+        ``num_requests`` — the mesh parallelizes the member axis, not
+        the request stream, so a member's physics (and bits) are the
+        single-device member program's."""
+        from isotope_tpu.compiler.compile import compile_ensemble
+        from isotope_tpu.sim import ensemble as ens_mod
+
+        if spec is None:
+            if self.sim.params.ensemble <= 0:
+                raise ValueError(
+                    "run_ensemble needs an EnsembleSpec (or "
+                    "SimParams.ensemble > 0 for the seeds-only "
+                    "default fleet)"
+                )
+            spec = ens_mod.EnsembleSpec.of(self.sim.params.ensemble)
+        spec.check(allow_duplicate_seeds=member_keys is not None)
+        self.sim._check_lb_load(load)
+        tables = compile_ensemble(spec)
+        args = self.sim._ensemble_args(
+            load, num_requests, key, spec, tables,
+            member_keys=member_keys, block_size=block_size, trim=trim,
+            member_qps=member_qps,
+        )
+        per_shard = -(-spec.members // self.n_shards)
+        # member chunking, mesh edition: per_shard members ride EACH
+        # device, so the solo path's capacity pre-check applies to the
+        # per-shard width — an over-wide fleet splits into sequential
+        # ROUNDS of narrower dispatches (the planned split VET-M004
+        # promises, not an OOM)
+        width = spec.chunk
+        if width is None:
+            width = self.sim.ensemble_chunk_size(
+                per_shard, args["block"]
+            )
+        width = max(1, min(int(width), per_shard))
+        rounds = -(-per_shard // width)
+        width = -(-per_shard // rounds)  # balanced rounds
+        return spec, tables, args, width, rounds
+
+    def _ensemble_padded(self, args, n_mem: int, width: int,
+                         rounds: int):
+        """The member-stacked fleet arguments padded (the engine's
+        shared pad law) so every (round, shard) slot holds ``width``
+        members — round r dispatches the contiguous member slice
+        ``[r * n_shards * width, (r + 1) * n_shards * width)``, which
+        is exactly the order the emulated twin's flat chunk loop
+        walks."""
+        return self.sim._ensemble_pad_args(
+            self.sim._ensemble_stacked_args(args), n_mem,
+            rounds * width * self.n_shards,
+        )
+
+    def _ensemble_out_specs(self, axes) -> RunSummary:
+        """Every summary leaf carries a leading member axis sharded
+        over the flattened mesh (``metrics`` stays None — the
+        per-service collector series stay out of the fleet program)."""
+        member = P(axes)
+        return RunSummary(
+            count=member, error_count=member, hop_events=member,
+            latency_sum=member, latency_m2=member, latency_min=member,
+            latency_max=member, latency_hist=member, end_max=member,
+            win_lo=member, win_hi=member, win_count=member,
+            win_error_count=member, win_latency_hist=member,
+            metrics=None, utilization=member, unstable=member,
+        )
+
+    def run_ensemble(
+        self,
+        load: LoadModel,
+        num_requests: int,
+        key: jax.Array,
+        spec=None,  # Optional[ensemble.EnsembleSpec]
+        *,
+        block_size: int = 65_536,
+        trim: bool = False,
+        member_keys=None,
+        member_qps=None,
+    ):
+        """The Monte Carlo fleet sharded over the mesh: the member
+        axis distributes over the FLATTENED device list (every mesh
+        axis, ``data`` included) and each device ``vmap``s its local
+        member slice — one jitted program for the whole fleet, with
+        per-member physics identical to ``Simulator.run_ensemble``
+        (no cross-member collectives exist to reorder float sums).
+
+        Over-wide fleets split into sequential ROUNDS of narrower
+        dispatches (the per-shard width is pre-computed from the vet
+        cost model like the solo path's member chunk); every round
+        reuses ONE compiled program.  Bit-equal to
+        :meth:`run_ensemble_emulated`, which replays the same
+        per-shard vmapped program serially on one device
+        (tests/test_ensemble.py) — the OOM-degradation rung and the
+        laptop twin of a pod-scale fleet.
+        """
+        self._require_mesh("run_ensemble")
+        spec, tables, args, width, rounds = self._plan_ensemble(
+            load, num_requests, key, spec, block_size, trim,
+            member_keys, member_qps,
+        )
+        n_mem = spec.members
+        telemetry.counter_inc("sharded_ensemble_runs")
+        telemetry.gauge_set("ensemble_members", n_mem)
+        telemetry.gauge_set("ensemble_members_per_shard", width)
+        telemetry.gauge_set("ensemble_rounds", rounds)
+        fn = self._get_ensemble_fn(args, width, tables, trim)
+        padded = self._ensemble_padded(args, n_mem, width, rounds)
+        faults.check("sharded.compute")
+        if self.dcn_axes:
+            faults.check("sharded.dcn_collective")
+        per_round = width * self.n_shards
+        parts = []
+        for r in range(rounds):
+            sl = slice(r * per_round, (r + 1) * per_round)
+            parts.append(fn(*(x[sl] for x in padded)))
+            if rounds > 1:
+                # serialize rounds: live memory stays bounded by one
+                # round's event tensors (the point of the split)
+                jax.block_until_ready(parts[-1].count)
+        summaries = self.sim._ensemble_concat(parts, n_mem)
+        from isotope_tpu.sim import ensemble as ens_mod
+
+        return ens_mod.EnsembleSummary(
+            spec=spec,
+            summaries=summaries,
+            offered_qps=args["offered"],
+            chunk=width,
+        )
+
+    def _get_ensemble_fn(self, args, width: int, tables,
+                         trim: bool):
+        """Jitted shard_map of the vmapped member program; the member
+        axis (per-shard round width) and jitter arming key the
+        cache."""
+        axes = tuple(self.mesh.axis_names)
+        cache_key = (args["block"], args["num_blocks"], args["kind"],
+                     args["conns"], trim,
+                     args["sat"], width, tables.jittered,
+                     tables.mode)
+        full_key = (
+            ("sharded-ensemble", self.sim.signature,
+             (axes,
+              tuple(int(self.mesh.shape[a]) for a in axes),
+              tuple(d.id for d in self.mesh.devices.flat)))
+            + cache_key
+        )
+        member = self.sim._ensemble_member_fn(
+            args["block"], args["num_blocks"], args["kind"],
+            args["conns"], trim, args["sat"], tables.jittered,
+        )
+        if tables.mode == "map":
+            def local(*xs):
+                return jax.lax.map(lambda t: member(*t), xs)
+        else:
+            local = jax.vmap(member)
+        mapped = _shard_map(
+            local,
+            mesh=self.mesh,
+            in_specs=tuple(P(axes) for _ in range(10)),
+            out_specs=self._ensemble_out_specs(axes),
+        )
+        return executable_cache.get_or_build(
+            full_key,
+            lambda: telemetry.time_first_call(
+                jax.jit(mapped), "compile.jit_first_call",
+            ),
+        )
+
+    def run_ensemble_emulated(
+        self,
+        load: LoadModel,
+        num_requests: int,
+        key: jax.Array,
+        spec=None,
+        *,
+        block_size: int = 65_536,
+        trim: bool = False,
+        member_keys=None,
+        member_qps=None,
+    ):
+        """The fleet's single-device twin: each shard's member slice
+        runs through the SAME vmapped member program (the engine's
+        ``_get_ensemble`` at ``per_shard`` width), serially, then the
+        slices concatenate on host.  No collectives exist in the fleet
+        program, so this is bit-equal to :meth:`run_ensemble` — works
+        over an :class:`~isotope_tpu.parallel.mesh.EmulatedMesh` (any
+        host count on one CPU) and serves as the fleet's OOM
+        degradation rung."""
+        spec, tables, args, width, rounds = self._plan_ensemble(
+            load, num_requests, key, spec, block_size, trim,
+            member_keys, member_qps,
+        )
+        n_mem = spec.members
+        telemetry.counter_inc("sharded_ensemble_emulated_runs")
+        fn = self.sim._get_ensemble(
+            args["block"], args["num_blocks"], args["kind"],
+            args["conns"], trim, args["sat"], width,
+            tables.jittered, tables.mode,
+        )
+        padded = self._ensemble_padded(args, n_mem, width, rounds)
+        parts = []
+        with telemetry.phase("sharded.emulated"):
+            # the flat width-chunk walk visits members in exactly the
+            # device path's (round, shard) order — contiguous slices
+            for c in range(rounds * self.n_shards):
+                sl = slice(c * width, (c + 1) * width)
+                out = fn(*(x[sl] for x in padded))
+                # serialize: live memory stays bounded by ONE shard
+                jax.block_until_ready(out.count)
+                parts.append(out)
+        summaries = self.sim._ensemble_concat(parts, n_mem)
+        from isotope_tpu.sim import ensemble as ens_mod
+
+        return ens_mod.EnsembleSummary(
+            spec=spec,
+            summaries=summaries,
+            offered_qps=args["offered"],
+            chunk=width,
+        )
+
     # -- attributed runs (metrics/attribution.py) -----------------------
 
     def run_attributed(

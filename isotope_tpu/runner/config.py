@@ -171,6 +171,23 @@ class ExperimentConfig:
     # block scan (--rollouts / TOML [sim] rollouts = true).  Implies
     # the timeline recorder, like policies.
     rollouts: bool = False
+    # scenario ensembles (sim/ensemble.py): N > 0 runs every
+    # unprotected case as a Monte Carlo fleet of N seed members in ONE
+    # jitted program per device (--ensemble N / TOML [sim] ensemble),
+    # reporting the pooled summary plus a `<label>.ensemble.json`
+    # artifact with quantile bands and SLO-violation probabilities.
+    # 0 (the default) leaves every run byte-identical to the solo path.
+    ensemble: int = 0
+    # per-member lognormal jitters (log-space sigma; see
+    # EnsembleSpec.from_jitter) — the seed-jitter spec of
+    # `--ensemble-jitter qps=0.1,cpu=0.05,error=0.2`
+    ensemble_qps_jitter: float = 0.0
+    ensemble_cpu_jitter: float = 0.0
+    ensemble_error_jitter: float = 0.0
+    ensemble_jitter_seed: int = 0
+    # the SLO latency (seconds) the ensemble artifact's P(violation)
+    # estimate is computed against; None omits the estimate
+    ensemble_slo_s: Optional[float] = None
 
     def sim_params(self) -> SimParams:
         return SimParams(
@@ -182,6 +199,22 @@ class ExperimentConfig:
             timeline=self.timeline or self.policies or self.rollouts,
             timeline_window_s=self.timeline_window_s,
             overlap=self.overlap,
+            ensemble=max(int(self.ensemble), 0),
+        )
+
+    def ensemble_spec(self):
+        """The sweep's :class:`~isotope_tpu.sim.ensemble.EnsembleSpec`
+        (None when the ensemble axis is off)."""
+        if self.ensemble <= 0:
+            return None
+        from isotope_tpu.sim.ensemble import EnsembleSpec
+
+        return EnsembleSpec.from_jitter(
+            self.ensemble,
+            qps_jitter=self.ensemble_qps_jitter,
+            cpu_jitter=self.ensemble_cpu_jitter,
+            error_jitter=self.ensemble_error_jitter,
+            jitter_seed=self.ensemble_jitter_seed,
         )
 
     def load_models(self):
@@ -405,4 +438,28 @@ def load_toml(path) -> ExperimentConfig:
         ),
         policies=bool(sim.get("policies", False)),
         rollouts=bool(sim.get("rollouts", False)),
+        **_ensemble_kwargs(sim),
     )
+
+
+def _ensemble_kwargs(sim: dict) -> dict:
+    """The ``[sim]`` ensemble keys: ``ensemble = N`` (member count),
+    ``ensemble_jitter = "qps=0.1,cpu=0.05,error=0.2[,seed=K]"`` (the
+    per-member perturbation spec), ``ensemble_slo = "250ms"`` (the SLO
+    the artifact's P(violation) estimate targets)."""
+    out: dict = {"ensemble": int(sim.get("ensemble", 0))}
+    if "ensemble_jitter" in sim:
+        from isotope_tpu.sim.ensemble import parse_jitter_spec
+
+        with config_path("sim.ensemble_jitter"):
+            j = parse_jitter_spec(str(sim["ensemble_jitter"]))
+        out["ensemble_qps_jitter"] = j["qps_jitter"]
+        out["ensemble_cpu_jitter"] = j["cpu_jitter"]
+        out["ensemble_error_jitter"] = j["error_jitter"]
+        out["ensemble_jitter_seed"] = j.get("jitter_seed", 0)
+    if "ensemble_slo" in sim:
+        with config_path("sim.ensemble_slo"):
+            out["ensemble_slo_s"] = dur.parse_duration_seconds(
+                sim["ensemble_slo"]
+            )
+    return out

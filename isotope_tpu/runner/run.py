@@ -102,6 +102,13 @@ class RunResult:
     # (per-service law + per-window per-backend load split); None when
     # the topology declares no lb entries
     lb: Optional[dict] = None
+    # scenario ensembles (sim/ensemble.py): the ensemble.json doc
+    # (isotope-ensemble/v1: per-member quantiles, quantile bands,
+    # SLO-violation probability with Wilson CI) and the raw
+    # EnsembleSummary; None when the ensemble axis was off or the
+    # fleet dispatch fell back to the solo path
+    ensemble: Optional[dict] = None
+    ensemble_summary: Optional[object] = None
 
 
 def _failed_window(reason: str) -> WindowSummary:
@@ -303,8 +310,143 @@ class _LazyTopology:
         return self._sims[env.name]
 
 
+class _EnsembleGroups:
+    """Same-shape case collapse for ensemble sweeps (sim/ensemble.py).
+
+    Grid cells of one (topology, environment) that share the load
+    KIND, connection count, and computed run shape (request count +
+    block) compile to the same fleet program — so their fleets pack
+    into ONE dispatch: members of cell i are keyed
+    ``fold_in(fold_in(seed_key, run_index_i), seed)`` (the
+    checkpoint-resume fold law, so a collapsed cell's members are
+    bit-identical to its uncollapsed dispatch) with each cell's exact
+    target qps riding the stacked ``member_qps`` argument.  Typical
+    win: a qps grid capped by ``num_requests`` — every cell past the
+    cap has the same shape and the whole loop collapses.
+
+    Results are cached per label; cells reached later in the sweep
+    loop read their slice instead of re-dispatching.
+    """
+
+    def __init__(self, config: ExperimentConfig, spec, key, cells,
+                 completed):
+        self.config = config
+        self.spec = spec          # the per-cell EnsembleSpec
+        self.key = key
+        self.cells = cells        # [{"topo","env","label","load","idx"}]
+        self.completed = set(completed)
+        self.results: dict = {}   # label -> per-cell EnsembleSummary
+
+    def _group_for(self, label, topo_path, env_name, load, sim, n):
+        """The cells that can ride this dispatch (self included)."""
+        from isotope_tpu.sim.config import OPEN_LOOP as _OPEN
+
+        me = [c for c in self.cells if c["label"] == label]
+        if load.kind != _OPEN or load.qps is None:
+            # closed-loop rate solves are per-cell host pilots; keep
+            # those cells on their own (still one fleet per cell)
+            return me
+        cap = sim.capacity_qps()
+        group = [
+            c for c in self.cells
+            if c["topo"] == topo_path
+            and c["env"] == env_name
+            and c["label"] not in self.completed
+            and c["load"].kind == load.kind
+            and c["load"].connections == load.connections
+            and c["load"].qps is not None
+            and _num_requests(
+                c["load"], cap, self.config.num_requests
+            ) == n
+        ]
+        return group if any(c["label"] == label for c in group) else me
+
+    def run(self, label, topo_path, env_name, load, sim, sharded,
+            use_sharded, n, block):
+        """This cell's EnsembleSummary (dispatching its whole
+        same-shape group on first touch)."""
+        import numpy as np
+
+        from isotope_tpu.sim.ensemble import (
+            EnsembleSpec,
+            EnsembleSummary,
+        )
+
+        if label in self.results:
+            telemetry.counter_inc("ensemble_collapsed_cases")
+            return self.results.pop(label)
+        spec = self.spec
+        n_seeds = spec.members
+        group = self._group_for(label, topo_path, env_name, load,
+                                sim, n)
+        member_keys = []
+        member_qps = []
+        seed_scale = (
+            spec.qps_scale
+            if spec.qps_scale is not None
+            else np.ones(n_seeds)
+        )
+        for c in group:
+            cell_key = jax.random.fold_in(self.key, c["idx"])
+            for s in spec.seeds:
+                member_keys.append(jax.random.fold_in(cell_key, s))
+            if c["load"].qps is not None:
+                member_qps.extend(
+                    float(c["load"].qps) * seed_scale
+                )
+        if len(group) == 1:
+            group_spec = spec
+            qps_arg = None if load.qps is None else np.asarray(
+                member_qps
+            )
+        else:
+            # qps jitter folds into the exact per-member rates; the
+            # physics jitters tile per cell
+            group_spec = EnsembleSpec(
+                seeds=tuple(range(len(member_keys))),
+                cpu_scale=(
+                    np.tile(spec.cpu_scale, len(group))
+                    if spec.cpu_scale is not None else None
+                ),
+                error_scale=(
+                    np.tile(spec.error_scale, len(group))
+                    if spec.error_scale is not None else None
+                ),
+            )
+            qps_arg = np.asarray(member_qps)
+        runner = sharded if (use_sharded and sharded is not None) \
+            else sim
+        ens = runner.run_ensemble(
+            load, n, jax.random.fold_in(self.key, group[0]["idx"]),
+            group_spec, block_size=block, trim=True,
+            member_keys=member_keys, member_qps=qps_arg,
+        )
+        # served cells leave the grouping pool: a later cell's group
+        # must never re-dispatch members whose results already landed
+        self.completed.update(c["label"] for c in group)
+        for i, c in enumerate(group):
+            sl = slice(i * n_seeds, (i + 1) * n_seeds)
+            self.results[c["label"]] = EnsembleSummary(
+                spec=spec,
+                summaries=jax.tree.map(
+                    lambda x: np.asarray(x)[sl], ens.summaries
+                ),
+                offered_qps=np.asarray(ens.offered_qps)[sl],
+                chunk=ens.chunk,
+            )
+        if len(group) > 1:
+            telemetry.counter_inc("ensemble_group_dispatches")
+            telemetry.gauge_set("ensemble_group_cells", len(group))
+            print(
+                f"ensemble: collapsed {len(group)} same-shape case(s) "
+                f"({len(member_keys)} members) into one dispatch",
+                file=sys.stderr,
+            )
+        return self.results.pop(label)
+
+
 def _vet_gate(mode: str, sim, topo, config, load, block, rungs,
-              policy) -> int:
+              policy, ensemble=None) -> int:
     """The ``--vet`` pre-flight: lint + audit + cost model for one case.
 
     Returns the ladder rung index the case should START on (the memory
@@ -313,6 +455,9 @@ def _vet_gate(mode: str, sim, topo, config, load, block, rungs,
     deterministic failure the sweep records like any other.  The
     VET-M* memory rules never block while the degradation ladder is
     armed: for them the rung pre-selection IS the recovery.
+    ``ensemble`` (the sweep's EnsembleSpec, when armed) adds the
+    fleet verdicts: VET-T023 spec lint + the VET-M004 member-capacity
+    check reporting the pre-computed chunk.
     """
     from isotope_tpu.analysis import (
         MEMORY_RULES,
@@ -326,6 +471,7 @@ def _vet_gate(mode: str, sim, topo, config, load, block, rungs,
         graph=topo.graph, entry=config.entry,
         suppress=default_suppressions(),
         rung_names=tuple(name for name, _ in rungs),
+        ensemble=ensemble,
     )
     for f in report.sorted():
         print(f"vet: {f.render()}", file=sys.stderr)
@@ -722,18 +868,27 @@ def run_experiment(
     # anything simulates; "auto" resolves per topology (the layout
     # search needs the compiled service count)
     mesh_req = resolve_mesh_request(config)
+    # scenario ensembles ([sim] ensemble / --ensemble): spec errors
+    # surface here, before anything simulates
+    ens_spec = config.ensemble_spec()
 
     # Labels are the identity of a run everywhere downstream — the
     # artifact filenames, the checkpoint restore key, the CSV rows.  A
     # colliding grid (two topology files with the same stem, or a
     # duplicated load row) would silently clobber artifacts and restore
     # the wrong record, so it must fail loudly up front.
-    grid_labels = [
-        _label(topo_path, env.name, load, config.labels)
-        for topo_path in config.topology_paths
-        for env in config.environments
-        for load in config.load_models()
+    grid_cells = [
+        {"topo": topo_path, "env": env.name, "load": load,
+         "label": _label(topo_path, env.name, load, config.labels),
+         "idx": i}
+        for i, (topo_path, env, load) in enumerate(
+            (t, e, ld)
+            for t in config.topology_paths
+            for e in config.environments
+            for ld in config.load_models()
+        )
     ]
+    grid_labels = [c["label"] for c in grid_cells]
     dupes = {lb for lb in grid_labels if grid_labels.count(lb) > 1}
     if dupes:
         raise ValueError(
@@ -768,6 +923,15 @@ def run_experiment(
         ckpt_file = open(ckpt_path, "a")
         for rec in done_records:
             done[rec["label"]] = rec
+
+    ens_groups = None
+    if ens_spec is not None:
+        completed = {
+            lb for lb, rec in done.items() if not rec.get("failed")
+        }
+        ens_groups = _EnsembleGroups(
+            config, ens_spec, key, grid_cells, completed
+        )
 
     try:
         run_index = 0
@@ -823,18 +987,77 @@ def run_experiment(
                                 run_key, block,
                                 collector=topo.collector, trim=True,
                             )
+                            protected = (
+                                topo.policy_tables is not None
+                                or topo.rollout_tables is not None
+                            )
                             start_rung = 0
                             if vet is not None:
                                 start_rung = _vet_gate(
                                     vet, sim, topo, config, load,
                                     block, rungs, policy,
+                                    # fleet verdicts only for cases a
+                                    # fleet will actually serve (the
+                                    # protected co-sim runs solo)
+                                    ensemble=(
+                                        ens_spec
+                                        if not protected
+                                        else None
+                                    ),
                                 )
                             tl_main = pol_main = roll_main = None
                             pol_blame = pol_attr = None
-                            protected = (
-                                topo.policy_tables is not None
-                                or topo.rollout_tables is not None
-                            )
+                            ens_summary = None
+                            if ens_groups is not None \
+                                    and not protected \
+                                    and start_rung == 0:
+                                # Monte Carlo fleet: the case's N seed
+                                # members run as ONE vmapped dispatch
+                                # (same-shape grid cells collapse into
+                                # it); the reported summary pools the
+                                # members and the distributional view
+                                # lands in <label>.ensemble.json.  A
+                                # fleet failure falls back to the solo
+                                # ladder below — never fails the case.
+                                # Memory-degraded cases (the vet
+                                # verdict pre-selected a ladder rung)
+                                # skip the fleet outright: even a
+                                # one-member chunk runs the full
+                                # block, and a TPU HBM overflow is
+                                # not reliably a catchable exception.
+                                try:
+                                    with telemetry.phase(
+                                        "ensemble.run"
+                                    ):
+                                        ens_summary = ens_groups.run(
+                                            label, topo_path,
+                                            env.name, load, sim,
+                                            sharded, use_sharded, n,
+                                            block,
+                                        )
+                                    telemetry.counter_inc(
+                                        "ensemble_cases"
+                                    )
+                                    telemetry.set_meta(
+                                        "ensemble",
+                                        str(ens_summary.members),
+                                    )
+                                except Exception as e:
+                                    telemetry.counter_inc(
+                                        "ensemble_fallbacks"
+                                    )
+                                    # the solo fallback serves this
+                                    # cell: keep later groups from
+                                    # re-dispatching its members
+                                    ens_groups.completed.add(label)
+                                    print(
+                                        f"warning: ensemble dispatch "
+                                        f"for {label} failed "
+                                        f"({type(e).__name__}: {e}); "
+                                        "falling back to the solo "
+                                        "run",
+                                        file=sys.stderr,
+                                    )
                             if protected:
                                 # policy/rollout co-sim: the PROTECTED
                                 # run IS the measurement (the control
@@ -854,13 +1077,17 @@ def run_experiment(
                                     topo.rollout_tables,
                                     attribution=attribution,
                                 )
+                            elif ens_summary is not None:
+                                summary = ens_summary.pooled()
+                                degraded_to = None
                             else:
                                 summary, degraded_to = run_ladder(
                                     rungs[start_rung:], policy,
                                     site_prefix="engine",
                                 )
                             if start_rung and degraded_to is None \
-                                    and not protected:
+                                    and not protected \
+                                    and ens_summary is None:
                                 # the pre-selected rung IS a
                                 # degradation: record it exactly as a
                                 # ladder descent would have (bench
@@ -989,12 +1216,26 @@ def run_experiment(
                         summary, load, labels=label,
                         response_size_bytes=topo.entry_response_size,
                     )
+                    if ens_summary is not None:
+                        # the pooled count spans N member WORLDS of
+                        # one wall-clock each: normalize the rate to
+                        # per-member so ActualQPS stays comparable to
+                        # RequestedQPS (and to pre-ensemble rows in
+                        # report.py's label-joined regression view);
+                        # counts/histograms stay pooled — they are
+                        # sample sizes, and errorPercent is a ratio
+                        doc["ActualQPS"] /= ens_summary.members
                     flat = convert_data(doc)
                     window = window_summary_from_summary(
                         summary,
                         service_names=topo.compiled.services.names,
                         replicas=topo.compiled.services.replicas,
                     )
+                    if ens_summary is not None:
+                        window = dataclasses.replace(
+                            window,
+                            qps=window.qps / ens_summary.members,
+                        )
                     flat["windowDiscarded"] = window.discarded
                     if use_sharded and topo.mesh_layout:
                         # the factorization that served the case is run
@@ -1030,6 +1271,18 @@ def run_experiment(
                         # comparing an lb row against a fifo twin
                         flat["_lb"] = True
                         telemetry.set_meta("lb", "on")
+                    ens_doc = None
+                    if ens_summary is not None:
+                        # the row POOLS N seed members — a tighter
+                        # estimate than a solo run of the same cell,
+                        # but a different measurement; the marker
+                        # keeps comparisons honest and the artifact
+                        # carries the distributional view
+                        ens_doc = ens_summary.to_doc(
+                            label=label,
+                            slo_s=config.ensemble_slo_s,
+                        )
+                        flat["_ensemble"] = ens_summary.members
                     flat.update(
                         {
                             "cpu_cores_" + name: round(v, 4)
@@ -1074,6 +1327,8 @@ def run_experiment(
                         rollouts=roll_doc,
                         rollouts_summary=roll_summary_out,
                         lb=lb_doc,
+                        ensemble=ens_doc,
+                        ensemble_summary=ens_summary,
                     )
                     results.append(result)
                     if out is not None:
@@ -1107,6 +1362,11 @@ def run_experiment(
                                 out / f"{label}.lb.json", "w"
                             ) as f:
                                 json.dump(lb_doc, f, indent=2)
+                        if ens_doc is not None:
+                            with open(
+                                out / f"{label}.ensemble.json", "w"
+                            ) as f:
+                                json.dump(ens_doc, f, indent=2)
                         if attr_summary is not None:
                             from isotope_tpu.metrics.export import (
                                 write_flamegraph,

@@ -8,12 +8,20 @@ program over a (request x hop) event tensor.
 """
 from isotope_tpu.sim.config import LoadModel, NetworkModel, SimParams
 from isotope_tpu.sim.engine import SimResults, Simulator, simulate
+from isotope_tpu.sim.ensemble import (
+    EnsembleSpec,
+    EnsembleSummary,
+    wilson_interval,
+)
 
 __all__ = [
+    "EnsembleSpec",
+    "EnsembleSummary",
     "LoadModel",
     "NetworkModel",
     "SimParams",
     "SimResults",
     "Simulator",
     "simulate",
+    "wilson_interval",
 ]

@@ -203,6 +203,17 @@ class SimParams:
     # leaves merge once), and single-device Simulator runs ignore it
     # (there is no collective to overlap).
     overlap: bool = False
+    # Scenario ensembles (sim/ensemble.py): the default Monte Carlo
+    # fleet size of ``Simulator.run_ensemble`` when no explicit
+    # EnsembleSpec is passed — N scenario variants (seeds, and
+    # optionally qps/cpu/error-rate perturbations) run as ONE jitted
+    # program per device with a leading member axis (jax.vmap), the
+    # way the TPU Ising idiom batches independent lattices.  0 (the
+    # default) leaves every existing entry point byte-identical: the
+    # solo paths never see the member axis, and member k of a
+    # seeds-only ensemble is bit-identical to a solo run with
+    # ``fold_in(key, seeds[k])`` (tests/test_ensemble.py).
+    ensemble: int = 0
 
     def __post_init__(self):
         if self.service_time not in (
@@ -246,6 +257,8 @@ class SimParams:
             raise ValueError("timeline_window_s must be positive")
         if self.timeline_max_windows < 1:
             raise ValueError("timeline_max_windows must be >= 1")
+        if self.ensemble < 0:
+            raise ValueError("ensemble must be >= 0 (0 = off)")
         # (sibling_copula_r + retry_copula_r < 1 is required only for
         # hops inside a multi-attempt call; the Simulator enforces it
         # when such calls exist)

@@ -1366,7 +1366,13 @@ class Simulator:
                 # into the traced program: it must never share an
                 # executable with the clean trace (empty when off)
                 faults.signature(),
-                repr(params), repr(tuple(chaos)), repr(self._churn),
+                # ensemble is the DEFAULT FLEET SIZE, not a traced
+                # constant (the member axis rides call shapes, keyed
+                # separately in _get_ensemble): normalize it out so an
+                # ensemble-armed engine shares every solo executable
+                # with its plain twin
+                repr(dataclasses.replace(params, ensemble=0)),
+                repr(tuple(chaos)), repr(self._churn),
                 repr(mtls), repr(t.names),
                 # policy tables bake into the traced control program;
                 # absent tables contribute the historical empty digest
@@ -1540,6 +1546,7 @@ class Simulator:
         # open-loop fallback — see _saturated)
         self._fns: Dict[Tuple[int, str, bool], "jax.stages.Wrapped"] = {}
         self._summary_fns: Dict[tuple, "jax.stages.Wrapped"] = {}
+        self._ensemble_fns: Dict[tuple, "jax.stages.Wrapped"] = {}
         self._rate_cache: Dict[tuple, float] = {}
         telemetry.counter_inc("simulators_built")
         telemetry.phase_add("engine.build", time.perf_counter() - _t_build)
@@ -2129,6 +2136,428 @@ class Simulator:
                 self._vis_arg(offered),
                 self._windows_arg(offered, sat),
             )
+
+    # -- scenario ensembles (sim/ensemble.py) ---------------------------
+
+    def _ensemble_member_fn(self, block: int, num_blocks: int,
+                            kind: str, connections: int, trim: bool,
+                            sat: bool, jittered: bool):
+        """The ONE-member block-scan program the fleet vmaps.
+
+        Body-identical to the plain ``_get_summary`` scan (same
+        fold_in layout, same summarize/reduce), so a seeds-only member
+        reproduces its solo ``run_summary`` twin bit-for-bit; the
+        jitter scales thread into ``_simulate_core`` only when
+        ``jittered`` (the seeds-only fleet trace stays the solo trace,
+        just batched)."""
+        from isotope_tpu.sim import summary as summary_mod
+
+        c = max(connections, 1)
+        per = block // c
+
+        def member_scan(key, offered_qps, pace_gap, nominal_gap,
+                        win_lo, win_hi, visits_pc, phase_windows,
+                        cpu_scale, err_scale):
+            telemetry.record_trace(
+                ("ensemble", self.signature[3], block, num_blocks,
+                 kind, connections, trim, sat, jittered),
+                tracing=isinstance(key, jax.core.Tracer),
+                requests=block * num_blocks,
+                hops=self.compiled.num_hops,
+            )
+
+            def body(carry, b):
+                t0, conn_t0, req_off = carry
+                kb = jax.random.fold_in(key, 1_000_000 + b)
+                res, t_end, conn_end = self._simulate_core(
+                    block, kind, connections, kb, offered_qps,
+                    pace_gap, offered_qps, nominal_gap, t0, conn_t0,
+                    req_off,
+                    sat_conns=connections if sat else 0,
+                    visits_pc=visits_pc,
+                    phase_windows=phase_windows,
+                    cpu_scale=cpu_scale if jittered else None,
+                    err_scale=err_scale if jittered else None,
+                )
+                s = summary_mod.summarize(
+                    res, None,
+                    window=(win_lo, win_hi) if trim else None,
+                )
+                return (t_end, conn_end, req_off + per), s
+
+            carry0 = (
+                jnp.float32(0.0),
+                jnp.zeros((c,), jnp.float32),
+                jnp.float32(0.0),
+            )
+            _, parts = jax.lax.scan(
+                body, carry0, jnp.arange(num_blocks)
+            )
+            return summary_mod.reduce_stacked(parts)
+
+        return member_scan
+
+    def _get_ensemble(self, block: int, num_blocks: int, kind: str,
+                      connections: int, trim: bool, sat: bool,
+                      chunk_members: int, jittered: bool,
+                      mode: str = "vmap"):
+        """One jitted fleet program over a ``chunk_members``-wide
+        member axis: ``vmap(member_scan)`` (true batch dim — the
+        accelerator idiom) or ``lax.map`` over members (serial inside
+        the program — the CPU idiom; see EnsembleSpec.mode).  The
+        ensemble dim (chunk width + jitter arming + mode) keys the
+        AOT executable cache — and ONLY those trace facts: the total
+        fleet size stays out, so every chunk of a fleet, and every
+        fleet auto-chunked to the same width, reuses ONE compile
+        (in-process and through the persistent XLA cache)."""
+        cache_key = (block, num_blocks, kind, connections, trim, sat,
+                     chunk_members, jittered, mode)
+        if cache_key not in self._ensemble_fns:
+            member = self._ensemble_member_fn(
+                block, num_blocks, kind, connections, trim, sat,
+                jittered,
+            )
+            if mode == "map":
+                def fleet(*xs):
+                    return jax.lax.map(lambda t: member(*t), xs)
+            else:
+                fleet = jax.vmap(member)
+            self._ensemble_fns[cache_key] = (
+                executable_cache.get_or_build(
+                    ("ensemble", self.signature) + cache_key,
+                    lambda: telemetry.time_first_call(
+                        jax.jit(fleet),
+                        "compile.jit_first_call",
+                    ),
+                )
+            )
+        return self._ensemble_fns[cache_key]
+
+    def _ensemble_args(self, load: LoadModel, num_requests: int,
+                       key: jax.Array, spec, tables,
+                       member_keys=None, block_size: int = 65_536,
+                       trim: bool = False,
+                       fixed_point_iters: int = 3,
+                       member_qps=None) -> dict:
+        """Host-side per-member planning: stacked fleet arguments.
+
+        One shared (block, num_blocks) shape serves every member (the
+        whole point: one compile per fleet); per-member offered rates,
+        trim windows, visit fixed points, and phase-window tables
+        stack along the leading member axis.  Closed-loop members
+        solve their equilibrium rate individually (with their own
+        folded key — the solo solver's exact pilot streams), at the
+        BASE cpu: a member cpu jitter perturbs the wait law and the
+        service draws exactly, but the rate solve and the retry-
+        feedback visit fixed point are base-cpu approximations.
+
+        ``member_qps`` overrides each member's target qps with an
+        EXACT per-member value (the runner's same-shape case collapse
+        packs several grid cells' fleets into one dispatch this way —
+        a relative qps_scale would re-round each cell's rate).
+        """
+        sat = self._saturated(load)
+        if sat and (spec.jittered or spec.qps_scale is not None):
+            raise ValueError(
+                "saturated -qps max ensembles support seed members "
+                "only (the finite-population wait tables are host-side"
+                " constants); pace the closed loop or jitter an "
+                "open-loop run"
+            )
+        if spec.qps_scale is not None and load.qps is None:
+            raise ValueError(
+                "qps jitter needs a finite target qps (load.qps is "
+                "None)"
+            )
+        n_mem = spec.members
+        if member_qps is not None:
+            member_qps = np.asarray(member_qps, np.float64)
+            if member_qps.shape != (n_mem,):
+                raise ValueError(
+                    f"member_qps must have shape ({n_mem},); got "
+                    f"{member_qps.shape}"
+                )
+            if sat:
+                raise ValueError(
+                    "member_qps cannot override a saturated -qps max "
+                    "load"
+                )
+        closed = load.kind != OPEN_LOOP
+        if member_keys is None:
+            if closed:
+                # the closed-loop rate solver consumes each member's
+                # key host-side (pilot streams) — materialize them
+                member_keys = [
+                    jax.random.fold_in(key, s) for s in spec.seeds
+                ]
+                keys_arr = jnp.stack(member_keys)
+            else:
+                # ONE vectorized derivation instead of N tiny
+                # dispatches (threefry is bit-identical under vmap —
+                # the member==solo pin covers this path)
+                keys_arr = jax.vmap(
+                    lambda s: jax.random.fold_in(key, s)
+                )(jnp.asarray(spec.seeds, jnp.uint32))
+        else:
+            member_keys = list(member_keys)
+            if len(member_keys) != n_mem:
+                raise ValueError(
+                    f"member_keys has {len(member_keys)} entries for "
+                    f"{n_mem} members"
+                )
+            keys_arr = jnp.stack(member_keys)
+        if load.kind == OPEN_LOOP:
+            conns = 0
+            block = max(1, min(block_size, num_requests))
+        else:
+            conns = load.connections
+            per = max(1, min(block_size, num_requests) // conns)
+            block = per * conns
+        num_blocks = max(1, -(-num_requests // block))
+        if trim:
+            from isotope_tpu.metrics.fortio import trim_window_bounds
+
+        offered = np.empty(n_mem, np.float64)
+        pace = np.empty(n_mem, np.float64)
+        nominal = np.empty(n_mem, np.float64)
+        win_lo = np.zeros(n_mem, np.float64)
+        win_hi = np.full(n_mem, np.inf, np.float64)
+        vis_rows = []
+        win_rows = []
+        # seeds-only fleets share one offered rate: build each
+        # distinct rate's visit/window/trim tables ONCE (the fleet's
+        # host planning must not cost O(members) table builds)
+        per_off: Dict[float, tuple] = {}
+        for m in range(n_mem):
+            scale = float(tables.qps_scale[m])
+            if member_qps is not None:
+                qps_m = float(member_qps[m])
+            elif load.qps is None:
+                qps_m = None
+            else:
+                qps_m = (
+                    float(load.qps)
+                    if scale == 1.0
+                    else float(load.qps) * scale
+                )
+            if load.kind == OPEN_LOOP:
+                off = qps_m
+                pc = 0.0
+                nom = 0.0
+            else:
+                load_m = (
+                    load
+                    if qps_m == load.qps
+                    else dataclasses.replace(load, qps=qps_m)
+                )
+                off = self.solve_closed_rate(
+                    load_m, num_requests, member_keys[m],
+                    fixed_point_iters,
+                )
+                pc = (
+                    conns / load_m.qps
+                    if load_m.qps is not None
+                    else 0.0
+                )
+                nom = conns / off
+            offered[m] = off
+            pace[m] = pc
+            nominal[m] = nom
+            if off not in per_off:
+                per_off[off] = (
+                    self._vis_arg(off),
+                    self._windows_arg(off, sat),
+                    trim_window_bounds(num_blocks * block, off)
+                    if trim else (0.0, np.inf),
+                )
+            vis_m, win_m, (lo, hi) = per_off[off]
+            vis_rows.append(vis_m)
+            win_rows.append(win_m)
+            if trim:
+                win_lo[m], win_hi[m] = lo, hi
+        return dict(
+            sat=sat,
+            kind=load.kind,
+            conns=conns,
+            block=block,
+            num_blocks=num_blocks,
+            keys=keys_arr,
+            offered=offered,
+            pace=pace,
+            nominal=nominal,
+            win_lo=win_lo,
+            win_hi=win_hi,
+            visits=jnp.stack(vis_rows),
+            windows=jnp.stack(win_rows),
+            cpu_scale=tables.cpu_scale,
+            err_scale=tables.err_scale,
+        )
+
+    @staticmethod
+    def _ensemble_stacked_args(args: dict):
+        """The member-axis-stacked argument tuple of the vmapped fleet
+        program, in ``member_scan`` order."""
+        return (
+            args["keys"],
+            jnp.asarray(args["offered"], jnp.float32),
+            jnp.asarray(args["pace"], jnp.float32),
+            jnp.asarray(args["nominal"], jnp.float32),
+            jnp.asarray(args["win_lo"], jnp.float32),
+            jnp.asarray(args["win_hi"], jnp.float32),
+            args["visits"],
+            args["windows"],
+            args["cpu_scale"],
+            args["err_scale"],
+        )
+
+    @staticmethod
+    def _ensemble_pad_args(stacked, n_mem: int, total: int):
+        """Pad every member-stacked argument to ``total`` members by
+        repeating the last member (the extras are dropped by
+        :meth:`_ensemble_concat` after the dispatch).  The ONE pad law
+        every chunked/sharded fleet path shares — the chunked ==
+        unchunked and sharded == emulated bit-equality pins depend on
+        each path padding identically."""
+        if total == n_mem:
+            return tuple(jnp.asarray(x) for x in stacked)
+
+        def pad(x):
+            x = jnp.asarray(x)
+            reps = jnp.repeat(x[-1:], total - n_mem, axis=0)
+            return jnp.concatenate([x, reps], axis=0)
+
+        return tuple(pad(x) for x in stacked)
+
+    @staticmethod
+    def _ensemble_concat(parts, n_mem: int):
+        """Concatenate per-chunk stacked summaries along the member
+        axis and drop the pad — the shared inverse of
+        :meth:`_ensemble_pad_args`."""
+        if len(parts) == 1:
+            return jax.tree.map(
+                lambda x: np.asarray(x)[:n_mem], parts[0]
+            )
+        return jax.tree.map(
+            lambda *xs: np.concatenate(
+                [np.asarray(x) for x in xs], axis=0
+            )[:n_mem],
+            *parts,
+        )
+
+    def ensemble_chunk_size(self, members: int, block: int) -> int:
+        """The auto member-chunk: how many fleet members fit one
+        device dispatch, from the vet cost model's plan-only peak-
+        bytes estimate vs device capacity — pre-computed the way the
+        VET-M* memory verdict pre-selects degradation-ladder rungs
+        (unknown capacity, e.g. CPU, runs the whole fleet at once)."""
+        from isotope_tpu.analysis import costmodel
+
+        cap = costmodel.device_capacity_bytes()
+        est = costmodel.estimate_run(self, block)
+        return costmodel.ensemble_chunk(
+            members, est.peak_bytes_at_block, cap
+        )
+
+    def run_ensemble(
+        self,
+        load: LoadModel,
+        num_requests: int,
+        key: jax.Array,
+        spec=None,  # Optional[ensemble.EnsembleSpec]
+        *,
+        block_size: int = 65_536,
+        trim: bool = False,
+        fixed_point_iters: int = 3,
+        chunk: Optional[int] = None,
+        member_keys=None,
+        member_qps=None,
+    ):
+        """Simulate a Monte Carlo fleet: N scenario variants in ONE
+        jitted program per device (sim/ensemble.py).
+
+        Each member is a full ``run_summary``-shaped run of
+        ``num_requests`` — member seeds derive their RNG via
+        ``fold_in(key, seed)`` (the runner's checkpoint idiom), so a
+        seeds-only member is bit-identical to the solo run with that
+        folded key.  The fleet batches behind a leading ``vmap`` axis:
+        one trace, one XLA compile, one dispatch per member-chunk.
+
+        ``spec`` defaults to a seeds-only fleet of
+        ``SimParams.ensemble`` members.  ``chunk`` (or ``spec.chunk``)
+        caps members per dispatch; None pre-computes the chunk from
+        the vet cost model (:meth:`ensemble_chunk_size`) so an
+        over-wide fleet is a planned split, not an OOM.  Chunked and
+        unchunked fleets are bit-equal (the member axis is
+        embarrassingly parallel; pinned by tests/test_ensemble.py).
+
+        ``member_keys`` overrides the seed derivation with explicit
+        per-member base keys — the runner's same-shape case collapse
+        packs several grid cells' fleets into one dispatch this way.
+
+        Returns an :class:`~isotope_tpu.sim.ensemble.EnsembleSummary`
+        (per-member RunSummary stack + quantile bands + SLO-violation
+        probabilities with Wilson CIs).  The per-service collector
+        series stay out of the fleet program (O(N * S * buckets)
+        leaves); run a solo collector pass for those.
+        """
+        from isotope_tpu.compiler.compile import compile_ensemble
+        from isotope_tpu.sim import ensemble as ens_mod
+
+        if spec is None:
+            if self.params.ensemble <= 0:
+                raise ValueError(
+                    "run_ensemble needs an EnsembleSpec (or "
+                    "SimParams.ensemble > 0 for the seeds-only "
+                    "default fleet)"
+                )
+            spec = ens_mod.EnsembleSpec.of(self.params.ensemble)
+        spec.check(allow_duplicate_seeds=member_keys is not None)
+        faults.check("engine.run")
+        self._check_lb_load(load)
+        tables = compile_ensemble(spec)
+        args = self._ensemble_args(
+            load, num_requests, key, spec, tables,
+            member_keys=member_keys, block_size=block_size, trim=trim,
+            fixed_point_iters=fixed_point_iters,
+            member_qps=member_qps,
+        )
+        n_mem = spec.members
+        chunk_sz = chunk if chunk is not None else spec.chunk
+        if chunk_sz is None:
+            chunk_sz = self.ensemble_chunk_size(n_mem, args["block"])
+        chunk_sz = max(1, min(int(chunk_sz), n_mem))
+        n_chunks = -(-n_mem // chunk_sz)
+        telemetry.counter_inc("ensemble_runs")
+        telemetry.gauge_set("ensemble_members", n_mem)
+        telemetry.gauge_set("ensemble_chunk", chunk_sz)
+        telemetry.gauge_set("engine_block_requests", args["block"])
+        telemetry.gauge_set("engine_num_blocks", args["num_blocks"])
+        telemetry.set_meta("ensemble_mode", tables.mode)
+        fn = self._get_ensemble(
+            args["block"], args["num_blocks"], args["kind"],
+            args["conns"], trim, args["sat"], chunk_sz,
+            tables.jittered, tables.mode,
+        )
+        padded = self._ensemble_pad_args(
+            self._ensemble_stacked_args(args), n_mem,
+            n_chunks * chunk_sz,
+        )
+        parts = []
+        with self._detail_ctx():
+            for ci in range(n_chunks):
+                sl = slice(ci * chunk_sz, (ci + 1) * chunk_sz)
+                parts.append(fn(*(x[sl] for x in padded)))
+                if n_chunks > 1:
+                    # serialize chunks: live memory stays bounded by
+                    # one chunk's event tensors (the point of chunking)
+                    jax.block_until_ready(parts[-1].count)
+        summaries = self._ensemble_concat(parts, n_mem)
+        return ens_mod.EnsembleSummary(
+            spec=spec,
+            summaries=summaries,
+            offered_qps=args["offered"],
+            chunk=chunk_sz,
+        )
 
     def plan_timeline_windows(
         self, total_requests: int, offered: float,
@@ -3150,6 +3579,8 @@ class Simulator:
         phase_windows: Optional[jax.Array] = None,
         policy_fx=None,  # Optional[policies.PolicyFx]
         rollout_fx=None,  # Optional[rollout.RolloutFx]
+        cpu_scale: Optional[jax.Array] = None,
+        err_scale: Optional[jax.Array] = None,
     ) -> Tuple[SimResults, jax.Array, jax.Array]:
         """``offered_qps`` drives the queueing model (the rate the whole
         fleet of services sees); ``arrival_qps`` paces this batch's
@@ -3166,7 +3597,16 @@ class Simulator:
         ``sat_conns > 0`` switches the wait law to the finite-population
         closed-network model (sim/closed.py) with that TOTAL connection
         count — the ``-qps max`` mode where the open-loop M/M/k law
-        misrepresents the C-bounded sojourn tail (ORACLE.md)."""
+        misrepresents the C-bounded sojourn tail (ORACLE.md).
+
+        ``cpu_scale`` / ``err_scale`` are the ensemble members'
+        per-member physics perturbations (sim/ensemble.py): traced
+        scalars so one vmapped fleet program serves every jitter draw.
+        ``cpu_scale`` multiplies the sampled service times and divides
+        every station's mu inside the wait law (canary arm included);
+        ``err_scale`` multiplies the per-hop error rates (clipped to
+        [0, 1]).  ``None`` (every solo entry point) leaves the traced
+        program byte-identical to the pre-ensemble one."""
         H = self.compiled.num_hops
         telemetry.fence_reset()
         any_copula = self._copula_active or self._retry_active
@@ -3483,9 +3923,13 @@ class Simulator:
         # mixture); fifo rows pass through mmk_params untouched.  The
         # saturated -qps max path keeps its finite-population law (lb
         # runs reject it loudly at the entry points).
+        # per-member cpu perturbation (ensembles): demand scales by s,
+        # so every station's service rate scales by 1/s — the one
+        # knob that moves BOTH the wait law and the service draws
+        mu = self._mu if cpu_scale is None else self._mu / cpu_scale
         if lbd is not None and not sat_conns:
             qp = self._lb_mod.wait_params(
-                self._lb, lbd, lam_pc, self._mu, eff_replicas_pc,
+                self._lb, lbd, lam_pc, mu, eff_replicas_pc,
                 self._k_max,
             )
             if rollout_fx is not None:
@@ -3493,20 +3937,23 @@ class Simulator:
                 # over its own replicas: stickiness respects version
                 # weights (each version's endpoint set is its own pool)
                 qp_can = self._lb_mod.wait_params(
-                    self._lb, lbd, lam_can, self._canary_mu,
+                    self._lb, lbd, lam_can,
+                    self._canary_mu if cpu_scale is None
+                    else self._canary_mu / cpu_scale,
                     self._can_reps_pc, self._k_max,
                 )
         else:
             qp = queueing.mmk_params(
                 lam_pc,
-                self._mu,
+                mu,
                 eff_replicas_pc,
                 self._k_max,
             )
             if rollout_fx is not None:
                 qp_can = queueing.mmk_params(
                     lam_can,
-                    self._canary_mu,
+                    self._canary_mu if cpu_scale is None
+                    else self._canary_mu / cpu_scale,
                     self._can_reps_pc,
                     self._k_max,
                 )
@@ -3723,6 +4170,11 @@ class Simulator:
         unstable_phase = jnp.where(svc_down_pc, False, qp.unstable)
 
         svc_time = self._sample_service_time(k_svc, (n, H))
+        if cpu_scale is not None:
+            # multiplicative rescale keeps the configured service-time
+            # SHAPE while moving the member's mean CPU demand (the
+            # same trick the canary cpu override uses below)
+            svc_time = svc_time * cpu_scale
         if can_coin is not None and self._canary_cpu_varies:
             # canary cpu_time override: a multiplicative rescale keeps
             # the configured service-time SHAPE (exp/lognormal/pareto)
@@ -3733,19 +4185,32 @@ class Simulator:
                 svc_time,
             )
 
-        # None == "statically no 500s" (all error rates are zero)
+        # None == "statically no 500s" (all error rates are zero) —
+        # a multiplicative member err_scale preserves zeros, so the
+        # static gate stays sound under ensembles
+        if err_scale is None:
+            err_rate_h = self._hop_err_rate
+        else:
+            err_rate_h = jnp.clip(
+                self._hop_err_rate * err_scale, 0.0, 1.0
+            )
         if u_err is None:
             err_coin = None
         elif can_coin is not None:
             # per-arm error rates: a canary hop draws against its own
             # override (baseline-substituted where none was declared)
+            can_err_h = (
+                self._canary_err_h
+                if err_scale is None
+                else jnp.clip(self._canary_err_h * err_scale, 0.0, 1.0)
+            )
             err_coin = u_err < jnp.where(
                 can_coin,
-                self._canary_err_h[None, :],
-                self._hop_err_rate[None, :],
+                can_err_h[None, :],
+                err_rate_h[None, :],
             )  # (N, H)
         else:
-            err_coin = u_err < self._hop_err_rate  # (N, H)
+            err_coin = u_err < err_rate_h  # (N, H)
         if shed_coin is not None:
             # breaker sheds ride the errorRate path exactly: fast 500,
             # script skipped, nothing sent downstream, and — matching

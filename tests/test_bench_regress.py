@@ -507,3 +507,65 @@ def test_layout_score_not_a_rate_key(tmp_path, monkeypatch):
                           "_mesh_layout_score": 1.0e-7})
     monkeypatch.delenv("BENCH_REGRESS_LAYOUT_GATE", raising=False)
     assert run_gate(tmp_path, monkeypatch, new, base) == 0
+
+
+def _ens_extra(rate, members, **kw):
+    d = {"ensembleN": rate, "ensembleN_best": rate,
+         "ensembleN_ensemble_members": members,
+         "ensembleN_ensemble_traces": 1,
+         "ensembleN_ensemble_solo_rate": rate / 2.0,
+         "ensembleN_ensemble_speedup": 2.0}
+    d.update(kw)
+    return d
+
+
+def test_ensemble_gate_off_by_default(tmp_path, monkeypatch):
+    base = capture(2.0e9, _ens_extra(3.2e7, 8))
+    # per-member rate halves via a member-count doubling at flat
+    # aggregate — invisible without the gate
+    new = capture(2.0e9, _ens_extra(3.2e7, 16))
+    monkeypatch.delenv("BENCH_REGRESS_ENSEMBLE_THRESHOLD",
+                       raising=False)
+    assert run_gate(tmp_path, monkeypatch, new, base) == 0
+
+
+def test_ensemble_gate_fails_on_per_member_regression(tmp_path,
+                                                      monkeypatch,
+                                                      capsys):
+    base = capture(2.0e9, _ens_extra(3.2e7, 8))
+    new = capture(2.0e9, _ens_extra(3.2e7, 16))
+    monkeypatch.setenv("BENCH_REGRESS_ENSEMBLE_THRESHOLD", "0.15")
+    assert run_gate(tmp_path, monkeypatch, new, base) == 1
+    assert "ensembleN.per_member" in capsys.readouterr().out
+
+
+def test_ensemble_gate_passes_within_threshold(tmp_path, monkeypatch):
+    base = capture(2.0e9, _ens_extra(3.2e7, 8))
+    new = capture(2.0e9, _ens_extra(3.1e7, 8))
+    monkeypatch.setenv("BENCH_REGRESS_ENSEMBLE_THRESHOLD", "0.15")
+    assert run_gate(tmp_path, monkeypatch, new, base) == 0
+
+
+def test_ensemble_gate_skips_pre_ensemble_baseline(tmp_path,
+                                                   monkeypatch):
+    base = capture(2.0e9, {"svc1000": 2.0e9, "svc1000_best": 2.1e9})
+    new = capture(2.0e9, _ens_extra(3.2e7, 128,
+                                    svc1000=2.0e9,
+                                    svc1000_best=2.1e9))
+    monkeypatch.setenv("BENCH_REGRESS_ENSEMBLE_THRESHOLD", "0.15")
+    assert run_gate(tmp_path, monkeypatch, new, base) == 0
+
+
+def test_ensemble_evidence_keys_not_compared_as_rates(tmp_path,
+                                                      monkeypatch):
+    # a speedup/solo-rate/member-count drop must never read as a rate
+    # regression (they are evidence keys, like *_spread)
+    base = capture(2.0e9, _ens_extra(3.2e7, 128))
+    new = capture(2.0e9, {"ensembleN": 3.2e7, "ensembleN_best": 3.2e7,
+                          "ensembleN_ensemble_members": 128,
+                          "ensembleN_ensemble_traces": 1,
+                          "ensembleN_ensemble_solo_rate": 1.0e6,
+                          "ensembleN_ensemble_speedup": 0.5})
+    monkeypatch.delenv("BENCH_REGRESS_ENSEMBLE_THRESHOLD",
+                       raising=False)
+    assert run_gate(tmp_path, monkeypatch, new, base) == 0

@@ -1,0 +1,549 @@
+"""Scenario ensembles (ISSUE 14): vmapped/mapped Monte Carlo fleets.
+
+The pins the feature's contract rests on:
+
+- member k of a seeds-only fleet is BIT-IDENTICAL to the solo
+  ``run_summary`` with ``fold_in(key, seeds[k])`` (both batching
+  modes, open and closed loop);
+- ``ensemble`` off (the default SimParams) leaves the solo paths
+  byte-identical;
+- the sharded fleet == its emulated host-loop twin, bit-for-bit (no
+  cross-member collectives exist to reorder float sums);
+- member-chunked dispatches == the unchunked fleet;
+- the Wilson CI math against the closed form;
+- the runner's isotope-ensemble/v1 artifact round-trips, and the
+  same-shape case collapse dispatches one fleet for a whole qps
+  group.
+
+Shape discipline: the open-loop fleets share ONE (512-request,
+256-block) program shape per (width, mode, jitter) so the module pays
+a handful of compiles, not one per test.
+"""
+import dataclasses
+import json
+
+import jax
+import jax.tree_util as jtu
+import numpy as np
+import pytest
+
+from isotope_tpu.compiler import compile_ensemble, compile_graph
+from isotope_tpu.models.graph import ServiceGraph
+from isotope_tpu.sim import LoadModel, SimParams
+from isotope_tpu.sim.engine import Simulator
+from isotope_tpu.sim.ensemble import (
+    EnsembleSpec,
+    doc_member_quantiles,
+    norm_ppf,
+    parse_jitter_spec,
+    wilson_interval,
+)
+
+YAML = """
+defaults:
+  responseSize: 1 KiB
+services:
+- name: entry
+  isEntrypoint: true
+  errorRate: 1%
+  script:
+  - - call: x
+    - call: y
+  - call: z
+- name: x
+  numReplicas: 2
+- name: y
+  script:
+  - call: z
+- name: z
+"""
+
+OPEN = LoadModel(kind="open", qps=2000.0)
+KEY = jax.random.PRNGKey(7)
+N, BLOCK = 512, 256  # two blocks: the scan carry is exercised
+
+
+@pytest.fixture(scope="module")
+def compiled():
+    return compile_graph(ServiceGraph.from_yaml(YAML))
+
+
+@pytest.fixture(scope="module")
+def sim(compiled):
+    return Simulator(compiled)
+
+
+@pytest.fixture(scope="module")
+def ens3(sim):
+    """The module's canonical 3-member seeds-only fleet (map mode)."""
+    return sim.run_ensemble(
+        OPEN, N, KEY, EnsembleSpec.of(3, mode="map"), block_size=BLOCK
+    )
+
+
+@pytest.fixture(scope="module")
+def solos3(sim):
+    """The three solo twins of ``ens3``'s members."""
+    return [
+        sim.run_summary(
+            OPEN, N, jax.random.fold_in(KEY, k), block_size=BLOCK
+        )
+        for k in range(3)
+    ]
+
+
+def _leaves_equal(a, b):
+    la, lb = jtu.tree_leaves(a), jtu.tree_leaves(b)
+    assert len(la) == len(lb)
+    return all(
+        np.array_equal(np.asarray(x), np.asarray(y), equal_nan=True)
+        for x, y in zip(la, lb)
+    )
+
+
+# -- member == solo bit-equality ---------------------------------------
+
+
+def test_member_bit_equals_solo_map(ens3, solos3):
+    for k in range(3):
+        assert _leaves_equal(solos3[k], ens3.member(k)), k
+
+
+def test_member_bit_equals_solo_vmap(sim, solos3):
+    ens = sim.run_ensemble(
+        OPEN, N, KEY, EnsembleSpec.of(3, mode="vmap"),
+        block_size=BLOCK,
+    )
+    for k in range(3):
+        assert _leaves_equal(solos3[k], ens.member(k)), k
+
+
+def test_member_bit_equals_solo_closed(sim):
+    load = LoadModel(kind="closed", qps=1500.0, connections=8)
+    ens = sim.run_ensemble(
+        load, 256, KEY, EnsembleSpec.of(2), block_size=128
+    )
+    solo = sim.run_summary(
+        load, 256, jax.random.fold_in(KEY, 1), block_size=128
+    )
+    assert _leaves_equal(solo, ens.member(1))
+
+
+def test_member_seeds_are_fold_indices(sim, solos3):
+    # explicit non-contiguous seeds: member order follows the spec
+    # (same width/shape/mode as ens3 — the compiled fleet is reused)
+    spec = EnsembleSpec(seeds=(5, 1, 2), mode="map")
+    ens = sim.run_ensemble(OPEN, N, KEY, spec, block_size=BLOCK)
+    assert _leaves_equal(solos3[1], ens.member(1))
+    assert not _leaves_equal(solos3[0], ens.member(0))  # seed 5 != 0
+
+
+# -- ensemble off == byte-identical ------------------------------------
+
+
+def test_ensemble_off_solo_paths_byte_identical(sim, compiled,
+                                                solos3):
+    armed = Simulator(compiled, SimParams(ensemble=4))
+    # the ensemble knob is not a traced constant: the armed engine
+    # must share the solo signature (and so the compiled executable)
+    assert armed.signature == sim.signature
+    b = armed.run_summary(
+        OPEN, N, jax.random.fold_in(KEY, 0), block_size=BLOCK
+    )
+    assert _leaves_equal(solos3[0], b)
+
+
+def test_default_params_ensemble_off():
+    assert SimParams().ensemble == 0
+    with pytest.raises(ValueError, match="ensemble"):
+        SimParams(ensemble=-1)
+
+
+# -- chunking -----------------------------------------------------------
+
+
+def test_chunked_equals_unchunked(sim, ens3):
+    chunked = sim.run_ensemble(
+        OPEN, N, KEY, EnsembleSpec.of(3, mode="map"),
+        block_size=BLOCK, chunk=2,
+    )
+    assert chunked.chunk == 2
+    assert _leaves_equal(ens3.summaries, chunked.summaries)
+
+
+def test_ensemble_chunk_balanced():
+    from isotope_tpu.analysis import costmodel
+
+    # 33 members over a 17-member budget (capacity 20 at the 0.85
+    # fill): two chunks of 17 + 16, not 17 + 16 + a padded third
+    assert costmodel.ensemble_chunk(33, 1.0, 20.0) == 17
+    # 33 over a 15-member budget: 3 balanced chunks of 11
+    assert costmodel.ensemble_chunk(33, 1.0, 15.0 / 0.85 + 1e-9) == 11
+    # fits -> whole fleet; unknown capacity -> whole fleet
+    assert costmodel.ensemble_chunk(8, 1.0, 1e9) == 8
+    assert costmodel.ensemble_chunk(8, 1.0, None) == 8
+
+
+def test_vet_m004_reports_auto_chunk():
+    from isotope_tpu.analysis import costmodel
+
+    est = costmodel.CostEstimate(
+        block_requests=256, trace_requests=8, jaxpr=None,
+        peak_bytes_at_block=1e6, flops_at_block=1.0, critical_path=1,
+        segments=[], capacity_bytes=4e6,
+    )
+    findings = costmodel.ensemble_findings(est, members=16)
+    assert [f.rule for f in findings] == ["VET-M004"]
+    assert "chunks of" in findings[0].message
+    # fits: silent
+    assert costmodel.ensemble_findings(est, members=2) == []
+
+
+# -- sharded == emulated twin ------------------------------------------
+
+
+def test_sharded_fleet_bit_equals_emulated_twin(compiled):
+    from isotope_tpu.parallel import (
+        EmulatedMesh,
+        MeshSpec,
+        ShardedSimulator,
+        build_mesh,
+    )
+
+    sh = ShardedSimulator(compiled, build_mesh(MeshSpec(data=4, svc=2)))
+    spec = EnsembleSpec.of(9)  # 9 over 8 shards: padding exercised
+    dev = sh.run_ensemble(OPEN, 256, KEY, spec, block_size=128)
+    emu = sh.run_ensemble_emulated(OPEN, 256, KEY, spec,
+                                   block_size=128)
+    assert _leaves_equal(dev.summaries, emu.summaries)
+    # the EmulatedMesh twin (same shard count, no devices) replays
+    # the same member partition bit-for-bit; its shard_map entry
+    # points reject loudly
+    esh = ShardedSimulator(
+        compiled, EmulatedMesh(MeshSpec(data=4, svc=2))
+    )
+    twin = esh.run_ensemble_emulated(OPEN, 256, KEY, spec,
+                                     block_size=128)
+    assert _leaves_equal(dev.summaries, twin.summaries)
+    with pytest.raises(ValueError, match="emulated"):
+        esh.run_ensemble(OPEN, 256, KEY, spec, block_size=128)
+    # over-wide fleets split into sequential per-shard ROUNDS (the
+    # mesh edition of member chunking): chunk=1 forces 2 rounds of
+    # width-1 dispatches, bit-equal to the one-round fleet — on the
+    # device path AND its emulated twin
+    narrow_spec = EnsembleSpec.of(9, chunk=1)
+    narrow = sh.run_ensemble(OPEN, 256, KEY, narrow_spec,
+                             block_size=128)
+    assert narrow.chunk == 1
+    assert _leaves_equal(dev.summaries, narrow.summaries)
+    narrow_twin = esh.run_ensemble_emulated(
+        OPEN, 256, KEY, narrow_spec, block_size=128
+    )
+    assert _leaves_equal(dev.summaries, narrow_twin.summaries)
+
+
+# -- per-member physics perturbations ----------------------------------
+
+
+def test_cpu_and_error_scales_move_member_physics(sim):
+    spec = EnsembleSpec(
+        seeds=(0, 1),
+        cpu_scale=np.array([0.25, 4.0]),
+        error_scale=np.array([1e-6, 50.0]),
+        mode="map",
+    )
+    ens = sim.run_ensemble(OPEN, N, KEY, spec, block_size=BLOCK)
+    lat = np.asarray(ens.summaries.latency_sum)
+    errs = np.asarray(ens.summaries.error_count)
+    assert lat[1] > lat[0]
+    assert errs[1] > errs[0]
+
+
+def test_qps_scale_moves_member_offered(sim, ens3):
+    # qps jitter reshapes the traced ARGS only (jittered stays False:
+    # the plain width-3 fleet program serves it)
+    spec = EnsembleSpec(
+        seeds=(0, 1, 2), qps_scale=np.array([0.5, 2.0, 1.0]),
+        mode="map",
+    )
+    ens = sim.run_ensemble(OPEN, N, KEY, spec, block_size=BLOCK)
+    assert not spec.jittered
+    assert ens.offered_qps[0] == pytest.approx(1000.0)
+    assert ens.offered_qps[1] == pytest.approx(4000.0)
+    # member 2 runs at the base rate with seed 2: bit-equal to ens3's
+    assert _leaves_equal(ens3.member(2), ens.member(2))
+
+
+def test_jitter_spec_deterministic():
+    a = EnsembleSpec.from_jitter(4, qps_jitter=0.1, cpu_jitter=0.2,
+                                 jitter_seed=3)
+    b = EnsembleSpec.from_jitter(4, qps_jitter=0.1, cpu_jitter=0.2,
+                                 jitter_seed=3)
+    assert np.array_equal(a.qps_scale, b.qps_scale)
+    assert np.array_equal(a.cpu_scale, b.cpu_scale)
+    assert a.error_scale is None
+
+
+# -- spec validation + vet rules ---------------------------------------
+
+
+def test_spec_validation():
+    with pytest.raises(ValueError, match="duplicate"):
+        EnsembleSpec(seeds=(1, 1, 2)).check()
+    with pytest.raises(ValueError, match="zero members"):
+        EnsembleSpec(seeds=()).check()
+    EnsembleSpec(seeds=(1, 1)).check(allow_duplicate_seeds=True)
+    with pytest.raises(ValueError, match="shape"):
+        EnsembleSpec(seeds=(0, 1), cpu_scale=np.ones(3))
+    with pytest.raises(ValueError, match="positive"):
+        EnsembleSpec(seeds=(0,), qps_scale=np.array([-1.0]))
+    with pytest.raises(ValueError, match="mode"):
+        EnsembleSpec(seeds=(0,), mode="tensor")
+    with pytest.raises(ValueError, match="chunk"):
+        EnsembleSpec(seeds=(0,), chunk=0)
+
+
+def test_parse_jitter_spec():
+    j = parse_jitter_spec("qps=0.1, cpu=0.05,error=0.2,seed=9")
+    assert j == {"qps_jitter": 0.1, "cpu_jitter": 0.05,
+                 "error_jitter": 0.2, "jitter_seed": 9}
+    assert parse_jitter_spec(None)["qps_jitter"] == 0.0
+    with pytest.raises(ValueError, match="axis"):
+        parse_jitter_spec("latency=3")
+    with pytest.raises(ValueError, match="axis=value"):
+        parse_jitter_spec("qps")
+
+
+def test_lint_ensemble_vet_t023():
+    from isotope_tpu.analysis import topo_lint
+
+    dup = topo_lint.lint_ensemble(EnsembleSpec(seeds=(3, 3, 4)))
+    assert [f.rule for f in dup] == ["VET-T023"]
+    assert "duplicate" in dup[0].message
+    zero = topo_lint.lint_ensemble(EnsembleSpec(seeds=()))
+    assert [f.rule for f in zero] == ["VET-T023"]
+    assert topo_lint.lint_ensemble(EnsembleSpec.of(4)) == []
+    assert topo_lint.lint_ensemble(None) == []
+
+
+def test_vet_simulator_ensemble_verdicts(sim, monkeypatch):
+    from isotope_tpu.analysis import costmodel, vet_simulator
+
+    monkeypatch.setenv(costmodel.ENV_DEVICE_BYTES, "1000000")
+    report = vet_simulator(
+        sim, OPEN, block_requests=256, trace=False,
+        ensemble=EnsembleSpec.of(64),
+    )
+    rules = {f.rule for f in report.findings}
+    assert "VET-M004" in rules
+    assert report.meta["ensemble"]["members"] == 64
+    assert 1 <= report.meta["ensemble"]["chunk"] < 64
+    bad = vet_simulator(
+        sim, OPEN, block_requests=256, trace=False,
+        ensemble=EnsembleSpec(seeds=(1, 1)),
+    )
+    assert "VET-T023" in {f.rule for f in bad.findings}
+
+
+def test_run_rejects_bad_specs(sim):
+    with pytest.raises(ValueError, match="duplicate"):
+        sim.run_ensemble(
+            OPEN, 64, KEY, EnsembleSpec(seeds=(1, 1)), block_size=64
+        )
+    with pytest.raises(ValueError, match="EnsembleSpec"):
+        sim.run_ensemble(OPEN, 64, KEY, None, block_size=64)
+    sat = LoadModel(kind="closed", qps=None, connections=8)
+    with pytest.raises(ValueError, match="saturated"):
+        sim.run_ensemble(
+            sat, 64, KEY,
+            EnsembleSpec(seeds=(0, 1),
+                         cpu_scale=np.array([1.0, 2.0])),
+            block_size=64,
+        )
+
+
+# -- CI math ------------------------------------------------------------
+
+
+def test_wilson_interval_closed_form():
+    # closed form at k=3, n=10, z=1.959964:
+    #   center = (p + z^2/2n) / (1 + z^2/n), half = z/(1+z^2/n) *
+    #   sqrt(p(1-p)/n + z^2/4n^2)
+    z = 1.959963984540054
+    p, n = 0.3, 10.0
+    denom = 1.0 + z * z / n
+    center = (p + z * z / (2 * n)) / denom
+    half = z / denom * np.sqrt(p * 0.7 / n + z * z / (4 * n * n))
+    lo, hi = wilson_interval(3, 10)
+    assert lo == pytest.approx(center - half, abs=1e-9)
+    assert hi == pytest.approx(center + half, abs=1e-9)
+    # never degenerate at the extremes, never outside [0, 1]
+    lo0, hi0 = wilson_interval(0, 20)
+    assert lo0 == 0.0 and 0.0 < hi0 < 0.3
+    lo1, hi1 = wilson_interval(20, 20)
+    assert 0.7 < lo1 < 1.0 and hi1 == 1.0
+    assert wilson_interval(0, 0) == (0.0, 1.0)
+
+
+def test_norm_ppf_reference_values():
+    # scipy.stats.norm.ppf reference constants (|rel err| < 1.2e-9)
+    assert norm_ppf(0.975) == pytest.approx(1.959963984540054,
+                                            abs=1e-7)
+    assert norm_ppf(0.5) == pytest.approx(0.0, abs=1e-12)
+    assert norm_ppf(0.995) == pytest.approx(2.5758293035489004,
+                                            abs=1e-7)
+    assert norm_ppf(0.001) == pytest.approx(-3.090232306167813,
+                                            abs=1e-6)
+    try:  # cross-check against scipy when the env has it
+        from scipy.stats import norm
+
+        for q in (0.025, 0.2, 0.7, 0.9999):
+            assert norm_ppf(q) == pytest.approx(norm.ppf(q),
+                                                abs=1e-7)
+    except ImportError:
+        pass
+
+
+def test_slo_violation_counts(ens3):
+    p99 = ens3.member_quantiles((0.99,))[:, 0]
+    cut = float(np.median(p99))
+    est = ens3.slo_violation(cut, quantile=0.99)
+    assert est["violations"] == int((p99 > cut).sum())
+    assert est["ci_lo"] <= est["p_violation"] <= est["ci_hi"]
+    band = ens3.quantile_band(0.99)
+    assert band["min_s"] <= band["mid_s"] <= band["max_s"]
+
+
+# -- artifacts ----------------------------------------------------------
+
+
+def test_doc_round_trip(ens3):
+    doc = json.loads(json.dumps(
+        ens3.to_doc(label="t", slo_s=0.01)
+    ))
+    assert doc["schema"] == "isotope-ensemble/v1"
+    assert doc["members"] == 3
+    mq = doc_member_quantiles(doc)
+    assert np.allclose(mq, ens3.member_quantiles())
+    spec2 = EnsembleSpec.from_dict(doc["spec"])
+    assert spec2.seeds == ens3.spec.seeds
+    with pytest.raises(ValueError, match="isotope-ensemble"):
+        doc_member_quantiles({"schema": "nope"})
+
+
+def test_compile_ensemble_tables():
+    t = compile_ensemble(
+        EnsembleSpec.from_jitter(4, cpu_jitter=0.1, mode="map")
+    )
+    assert t.members == 4 and t.jittered and t.mode == "map"
+    plain = compile_ensemble(EnsembleSpec.of(4, mode="map"))
+    assert not plain.jittered
+    assert np.allclose(np.asarray(plain.cpu_scale), 1.0)
+
+
+# -- runner integration -------------------------------------------------
+
+
+def _config(tmp_path, **kw):
+    from isotope_tpu.runner.config import (
+        DEFAULT_ENVIRONMENTS,
+        ExperimentConfig,
+    )
+
+    p = tmp_path / "t.yaml"
+    p.write_text(YAML)
+    return ExperimentConfig(
+        topology_paths=(str(p),),
+        environments=(DEFAULT_ENVIRONMENTS["NONE"],),
+        qps=(500.0,),
+        connections=(8,),
+        duration_s=2.0,
+        load_kind="open",
+        num_requests=256,
+        **kw,
+    )
+
+
+def test_runner_ensemble_artifact_and_resume(tmp_path):
+    from isotope_tpu.runner.run import run_experiment
+
+    cfg = _config(tmp_path, ensemble=3, ensemble_slo_s=0.25)
+    out = str(tmp_path / "out")
+    (res,) = run_experiment(cfg, out_dir=out)
+    assert not res.failed
+    assert res.flat["_ensemble"] == 3
+    assert res.ensemble is not None
+    path = tmp_path / "out" / f"{res.label}.ensemble.json"
+    doc = json.loads(path.read_text())
+    assert doc == json.loads(json.dumps(res.ensemble))
+    assert doc["slo"]["slo_s"] == pytest.approx(0.25)
+    assert len(doc["member_counts"]) == 3
+    # the pooled row aggregates every member's requests ...
+    assert float(res.fortio_json["DurationHistogram"]["Count"]) == \
+        sum(doc["member_counts"])
+    # ... but the RATE is per-member: N member worlds of one
+    # wall-clock each must not read as N-fold throughput (qps 500
+    # open loop -> ActualQPS ~500, not ~1500)
+    assert 250.0 < float(res.flat["ActualQPS"]) < 1000.0
+    # resume restores from the checkpoint without re-dispatching
+    (again,) = run_experiment(cfg, out_dir=out)
+    assert again.flat == res.flat
+
+
+def test_runner_same_shape_collapse_bit_equal(tmp_path):
+    """Two qps cells capped to one shape collapse into ONE fleet
+    dispatch whose per-cell members bit-equal the uncollapsed
+    dispatches."""
+    from isotope_tpu import telemetry
+    from isotope_tpu.runner.run import run_experiment
+
+    telemetry.reset()
+    # num_requests caps both cells at 256 requests -> same shape
+    cfg = _config(tmp_path, ensemble=2)
+    cfg = dataclasses.replace(cfg, qps=(500.0, 700.0))
+    before = telemetry.counter_get("ensemble_group_dispatches")
+    results = run_experiment(cfg, out_dir=str(tmp_path / "out"))
+    assert len(results) == 2 and not any(r.failed for r in results)
+    assert telemetry.counter_get("ensemble_group_dispatches") \
+        == before + 1
+    # uncollapsed twin of cell 1 (run_index 1, qps 700): member keys
+    # fold the checkpoint law fold_in(fold_in(seed_key, idx), seed)
+    compiled = compile_graph(ServiceGraph.from_yaml(YAML))
+    sim = Simulator(compiled)
+    seed_key = jax.random.PRNGKey(cfg.seed)
+    cell_key = jax.random.fold_in(seed_key, 1)
+    load = LoadModel(kind="open", qps=700.0, connections=8,
+                     duration_s=2.0)
+    solo = sim.run_ensemble(
+        load, 256, cell_key, EnsembleSpec.of(2),
+        block_size=sim.default_block_size(), trim=True,
+    )
+    got = results[1].ensemble_summary
+    assert _leaves_equal(solo.summaries, got.summaries)
+
+
+def test_toml_ensemble_keys(tmp_path):
+    from isotope_tpu.runner.config import load_toml
+
+    topo = tmp_path / "t.yaml"
+    topo.write_text(YAML)
+    cfg_path = tmp_path / "sweep.toml"
+    cfg_path.write_text(
+        'topology_paths = ["t.yaml"]\n'
+        "[client]\n"
+        'qps = [500]\n'
+        "[sim]\n"
+        "ensemble = 8\n"
+        'ensemble_jitter = "qps=0.1,cpu=0.05,error=0.2,seed=3"\n'
+        'ensemble_slo = "250ms"\n'
+    )
+    cfg = load_toml(cfg_path)
+    assert cfg.ensemble == 8
+    assert cfg.ensemble_qps_jitter == 0.1
+    assert cfg.ensemble_cpu_jitter == 0.05
+    assert cfg.ensemble_error_jitter == 0.2
+    assert cfg.ensemble_jitter_seed == 3
+    assert cfg.ensemble_slo_s == pytest.approx(0.25)
+    spec = cfg.ensemble_spec()
+    assert spec.members == 8 and spec.jittered
+    assert cfg.sim_params().ensemble == 8

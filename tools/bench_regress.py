@@ -111,7 +111,9 @@ def _cases(doc: dict, prefer_best: bool = False) -> dict:
         if k.endswith(("_inflight", "_spread", "_census", "_best",
                        "_compile_s", "_warmup_windows",
                        "_timeline_overhead", "_mesh_layout_score",
-                       "_rollout", "_lb")):
+                       "_rollout", "_lb", "_ensemble_members",
+                       "_ensemble_traces", "_ensemble_solo_rate",
+                       "_ensemble_speedup")):
             continue  # evidence / variance keys, not rates
         cases[k] = float(v)
     if prefer_best:
@@ -303,6 +305,51 @@ def timeline_failures(new_doc: dict) -> list:
     return failures
 
 
+def ensemble_failures(prev_doc: dict, new_doc: dict) -> list:
+    """Opt-in gate (``BENCH_REGRESS_ENSEMBLE_THRESHOLD=<ratio>``): a
+    fleet case whose PER-MEMBER throughput (case rate divided by its
+    ``<case>_ensemble_members``) regressed beyond the threshold vs the
+    previous capture fails.
+
+    The aggregate rate alone can hide a per-member regression behind a
+    member-count change (double the members, tank each member 40%, still
+    "faster") — normalizing by the fleet width keeps the comparison
+    per-scenario-honest.  Captures without the members key on either
+    side are skipped (pre-ensemble baselines).
+    """
+    raw = os.environ.get("BENCH_REGRESS_ENSEMBLE_THRESHOLD")
+    if raw is None or raw == "":
+        return []
+    thr = float(raw)
+    prev_extra = prev_doc.get("extra", {})
+    new_extra = new_doc.get("extra", {})
+    prev_rates = _cases(prev_doc)
+    new_rates = _cases(new_doc)
+    failures = []
+    for k, new_m in sorted(new_extra.items()):
+        if not k.endswith("_ensemble_members") or not isinstance(
+            new_m, (int, float)
+        ):
+            continue
+        case = k[: -len("_ensemble_members")]
+        old_m = prev_extra.get(k)
+        if not isinstance(old_m, (int, float)) or old_m <= 0 \
+                or new_m <= 0:
+            continue
+        if case not in prev_rates or case not in new_rates:
+            continue
+        old_pm = prev_rates[case] / float(old_m)
+        new_pm = new_rates[case] / float(new_m)
+        bad = old_pm > 0 and new_pm < old_pm * (1.0 - thr)
+        verdict = "REGRESSION" if bad else "OK"
+        print(f"bench_regress: {case}.per_member: {old_pm:.4g} -> "
+              f"{new_pm:.4g} "
+              f"({(new_pm / old_pm - 1) * 100:+.1f}%) {verdict}")
+        if bad:
+            failures.append(f"{case}.per_member")
+    return failures
+
+
 def layout_failures(prev_doc: dict, new_doc: dict) -> list:
     """Opt-in gate (``BENCH_REGRESS_LAYOUT_GATE=1``): the automatic
     mesh-layout search (parallel/layout.py — bench embeds the chosen
@@ -469,6 +516,7 @@ def main() -> int:
     failures.extend(blame_failures(prev_doc, new_doc))
     failures.extend(spread_failures(prev_doc, new_doc))
     failures.extend(timeline_failures(new_doc))
+    failures.extend(ensemble_failures(prev_doc, new_doc))
     failures.extend(layout_failures(prev_doc, new_doc))
     if failures:
         print(f"bench_regress: FAIL vs {prev_path}: "
