@@ -8,7 +8,8 @@ DURATION ?= 120s
 
 .PHONY: test lint vet-smoke bench telemetry-smoke resilience-smoke \
 	attribution-smoke sparse-smoke timeline-smoke multihost-smoke \
-	policies-smoke rollout-smoke lb-smoke ensemble-smoke examples \
+	policies-smoke rollout-smoke lb-smoke ensemble-smoke \
+	chaosfleet-smoke examples \
 	canonical tree star multitier auxiliary-services star-auxiliary \
 	latency cpu_mem dot clean
 
@@ -200,6 +201,14 @@ lb-smoke:
 # fleet's aggregate wall-clock beats the sequential dispatch loop.
 ensemble-smoke:
 	$(PY) tools/ensemble_smoke.py
+
+# chaos-fleet end-to-end check (PR 15): protected fleet over a
+# retry-storm topology with per-member kill timing, member k bit-equal
+# to its solo run_policies, importance splitting resolving a
+# forced-rare outage at <= 10% of the brute-force budget, and the
+# worst member's jittered schedule replaying solo bit-for-bit
+chaosfleet-smoke:
+	$(PY) tools/chaosfleet_smoke.py
 
 examples:
 	$(PY) tools/gen_examples.py

@@ -67,6 +67,7 @@ CASE_ORDER = [
     "closed64",
     "svc1000",
     "ensembleN",
+    "svc1000_chaosfleet",
     "realistic50",
     "rollout50",
     "svc10k",
@@ -458,6 +459,108 @@ def run_case(name: str) -> dict:
         out[f"{name}_ensemble_speedup"] = round(
             med / max(solo_best, 1e-9), 3
         )
+    elif name == "svc1000_chaosfleet":
+        # chaos fleets (PR 15): svc1000 under a retry-storm policy
+        # block, dispatched as a PROTECTED Monte Carlo fleet with
+        # per-member kill timing/magnitude (run_policies_ensemble +
+        # ChaosJitterSpec) — every member survives a DIFFERENT bad
+        # day behind one jitted program.  Evidence: member count,
+        # engine-trace delta (one compile serves the fleet), the
+        # worst member's severity, and a short importance-splitting
+        # estimate of a forced-rare outage (severity threshold well
+        # past the typical member).  The `<case>_chaosfleet_*` keys
+        # are EXCLUDED from bench_regress's rate comparison (like the
+        # ensembleN evidence) and covered by the clean-case gate.
+        from isotope_tpu.compiler import compile_policies
+        from isotope_tpu.resilience.faults import ChaosJitterSpec
+        from isotope_tpu.sim import splitting as split_mod
+        from isotope_tpu.sim.config import ChaosEvent, SimParams
+        from isotope_tpu.sim.ensemble import EnsembleSpec
+
+        with open("examples/topologies/1000-svc_2000-end.yaml") as f:
+            doc = yaml.safe_load(f)
+        doc.setdefault("policies", {})["defaults"] = {
+            "retry_budget": {"budget_percent": "25%"},
+        }
+        g = ServiceGraph.decode(doc)
+        compiled_g = compile_graph(g)
+        svc_name = compiled_g.services.names[1]
+        chaos = (ChaosEvent(svc_name, 0.05, 0.25, replicas_down=1),)
+        sim = Simulator(
+            compiled_g, SimParams(timeline=True), chaos=chaos,
+            policies=compile_policies(g, compiled_g),
+        )
+        jitter = ChaosJitterSpec(time=0.3, magnitude=0.5, seed=0)
+        members = int(os.environ.get("BENCH_CHAOSFLEET_MEMBERS", "8"))
+        spec = EnsembleSpec.of(members)
+        load_e = LoadModel(kind="open", qps=10_000.0)
+        n_e = int(os.environ.get(
+            "BENCH_CHAOSFLEET_REQUESTS", "8192" if on_tpu else "512"
+        ))
+        b_e = min(n_e, 4_096 if on_tpu else 512)
+        traces0 = telemetry.counter_get("engine_traces")
+        last_fleet = {}
+
+        def fleet_runner(s_, l_, n_, k_, b_):
+            ens = s_.run_policies_ensemble(
+                l_, n_, k_, spec, block_size=b_, window_s=0.05,
+                member_chaos=jitter,
+            )
+            last_fleet["ens"] = ens
+            return ens.pooled()
+
+        med, spread, best, first_s = measure(
+            sim, load_e, n_e, b_e, warm=2, iters=2,
+            runner=fleet_runner,
+        )
+        out[f"{name}_chaosfleet_members"] = members
+        out[f"{name}_chaosfleet_traces"] = int(
+            telemetry.counter_get("engine_traces") - traces0
+        )
+        sev = last_fleet["ens"].severity()
+        out[f"{name}_chaosfleet_worst_severity"] = round(
+            float(sev.max()), 6
+        )
+        # forced-rare outage estimate: peak error share past a
+        # threshold the typical member never reaches
+        sspec = split_mod.SplitSpec(
+            levels=3, members=members, keep=0.25,
+            threshold=max(float(sev.max()) * 2.0, 0.2),
+            severity="err_peak", seed=0,
+        )
+        reps = compiled_g.services.replicas_by_name()
+        from isotope_tpu.resilience.faults import jitter_chaos_events
+
+        def evaluate(chaos_seeds, work_seeds):
+            import numpy as _np
+
+            mkeys = [
+                jax.random.fold_in(jax.random.PRNGKey(9), int(w))
+                for w in work_seeds
+            ]
+            mc = [
+                jitter_chaos_events(chaos, jitter, row, reps)
+                for row in _np.asarray(chaos_seeds)
+            ]
+            ens = sim.run_policies_ensemble(
+                load_e, n_e, jax.random.PRNGKey(9),
+                EnsembleSpec.of(len(mkeys)), block_size=b_e,
+                window_s=0.05, member_keys=mkeys, member_chaos=mc,
+            )
+            return split_mod.severity_scores(
+                sspec, ens.summaries, ens.timelines
+            )
+
+        try:
+            sdoc = split_mod.subset_estimate(
+                evaluate, sspec, chaos_components=len(chaos)
+            )
+            out[f"{name}_chaosfleet_split_p"] = sdoc["p"]
+            out[f"{name}_chaosfleet_split_evals"] = sdoc[
+                "evaluations"
+            ]
+        except Exception as e:  # pragma: no cover - capture survival
+            out[f"{name}_chaosfleet_split_error"] = str(e)[:200]
     elif name == "realistic50":
         sim = Simulator(
             compile_graph(

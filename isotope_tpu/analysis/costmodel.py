@@ -544,11 +544,35 @@ def timeline_findings(estimate: CostEstimate) -> List[Finding]:
     )]
 
 
+def protected_carry_bytes(sim, num_windows: int,
+                          roll: bool = False) -> float:
+    """Per-member bytes of a PROTECTED fleet's stacked scan carry
+    (engine ``_protected_member_fn``): the flight-recorder windowed
+    accumulator plus the policy / rollout control state, observation
+    channels, and actuation series — the terms a plain fleet does not
+    carry and VET-T025 accounts for.  All f32."""
+    s = max(sim.compiled.num_services, 1)
+    w = max(int(num_windows), 1)
+    total = timeline_bytes(sim, num_windows=w)
+    if getattr(sim, "_policies", None) is not None:
+        # PolicyState (~6 S-vectors + clocks) + (S, W) observation
+        # channel + PolicySummary series (6 (S, W) + (W,) + 3 (S,))
+        total += 4.0 * (7 * s + s * w + 6 * s * w + w + 3 * s)
+    if roll and getattr(sim, "_rollouts", None) is not None:
+        # RolloutState (~6 S-vectors) + (S, 2, W, 4) observation
+        # accumulator + RolloutSummary series (6 (S, W) + (W,) +
+        # 3 (S, 2, W))
+        total += 4.0 * (6 * s + s * 2 * w * 4 + 6 * s * w + w
+                        + 3 * s * 2 * w)
+    return total
+
+
 def ensemble_chunk(
     members: int,
     peak_bytes_per_member: float,
     capacity_bytes: Optional[float],
     fill: float = CAPACITY_FILL,
+    carry_bytes_per_member: float = 0.0,
 ) -> int:
     """Members per device dispatch for a Monte Carlo fleet
     (sim/ensemble.py): the vmapped member axis multiplies every event
@@ -564,6 +588,10 @@ def ensemble_chunk(
     substantiate.  Pre-computed at plan time the way the VET-M memory
     verdict pre-selects degradation-ladder rungs.  CPU-era heuristic:
     the real-TPU retune rides the ROADMAP calibration-debt item.
+
+    ``carry_bytes_per_member`` adds a protected fleet's stacked
+    control carry (:func:`protected_carry_bytes`) to each member's
+    footprint — the VET-T025 accounting.
     """
     members = max(int(members), 1)
     if (
@@ -573,7 +601,10 @@ def ensemble_chunk(
     ):
         return members
     budget = fill * float(capacity_bytes)
-    per_dispatch = int(budget // float(peak_bytes_per_member))
+    per_member = float(peak_bytes_per_member) + max(
+        float(carry_bytes_per_member), 0.0
+    )
+    per_dispatch = int(budget // per_member)
     if per_dispatch >= members:
         return members
     per_dispatch = max(per_dispatch, 1)
@@ -606,6 +637,39 @@ def ensemble_findings(
         f"{cap:.3g} B capacity); the fleet will run in member chunks "
         f"of {chunk} — shrink the block or the fleet to run it in "
         "one dispatch",
+    )]
+
+
+def protected_ensemble_findings(
+    estimate: CostEstimate,
+    members: int,
+    carry_bytes: float,
+) -> List[Finding]:
+    """The VET-T025 verdict: a PROTECTED fleet whose members' event
+    tensors PLUS stacked control carries (timeline accumulator,
+    policy / rollout state and series — :func:`protected_carry_bytes`)
+    exceed the device budget.  WARN, never blocking: the engine
+    pre-computes the carry-aware member chunk and splits the fleet
+    (``Simulator.protected_ensemble_chunk``)."""
+    cap = estimate.capacity_bytes
+    members = int(members)
+    if members <= 1 or cap is None or cap <= 0:
+        return []
+    peak = estimate.peak_bytes_at_block
+    budget = CAPACITY_FILL * cap
+    need = members * (peak + max(carry_bytes, 0.0))
+    if need <= budget:
+        return []
+    chunk = ensemble_chunk(
+        members, peak, cap, carry_bytes_per_member=carry_bytes
+    )
+    return [Finding(
+        "VET-T025", SEV_WARN,
+        f"protected fleet of {members} members needs {need:.3g} B "
+        f"including {carry_bytes:.3g} B/member of stacked control "
+        f"carry (> the {budget:.3g} B budget); the fleet will run in "
+        f"member chunks of {chunk} — shrink the block, the window "
+        "count, or the fleet to run it in one dispatch",
     )]
 
 

@@ -74,6 +74,14 @@ RULES: Dict[str, str] = {
                 "not extra Monte Carlo samples)",
     "VET-M004": "ensemble members x peak-bytes exceed device capacity; "
                 "the fleet runs in pre-computed member chunks",
+    # -- chaos fleets (sim/splitting.py, PR 15) ----------------------------
+    "VET-T024": "importance-splitting config is undecodable, keeps no "
+                "(or every) member per level, or budgets fewer than "
+                "one survivor per level",
+    "VET-T025": "protected fleet members x (peak-bytes + stacked "
+                "policy/rollout/timeline carry) exceed device "
+                "capacity; the fleet runs in carry-aware member "
+                "chunks",
 }
 
 

@@ -477,6 +477,55 @@ def lint_ensemble(spec) -> List[Finding]:
     return findings
 
 
+def lint_split(spec) -> List[Finding]:
+    """Importance-splitting misconfiguration rules (VET-T024) over a
+    :class:`~isotope_tpu.sim.splitting.SplitSpec` (or its raw
+    ``--ensemble-split`` string).
+
+    Errors on an undecodable spec, a survivor fraction outside
+    (0, 1) (``keep >= 1`` keeps every member — the levels never climb
+    toward the rare event; ``keep <= 0`` keeps none), and a budget of
+    fewer than one survivor per level (``keep * members < 1``: the
+    level quantile falls on an empty survivor set).  The estimator
+    raises the same defects loudly at run entry
+    (sim/splitting.py ``SplitSpec``)."""
+    findings: List[Finding] = []
+    if spec is None:
+        return findings
+    if isinstance(spec, str):
+        from isotope_tpu.sim.splitting import parse_split_spec
+
+        try:
+            spec = parse_split_spec(spec)
+        except (ValueError, TypeError) as e:
+            findings.append(Finding(
+                "VET-T024", SEV_ERROR,
+                f"undecodable importance-splitting spec: {e}",
+                path="sim.ensemble_split",
+            ))
+            return findings
+        if spec is None:
+            return findings
+    if spec.keep * spec.members < 1.0:
+        findings.append(Finding(
+            "VET-T024", SEV_ERROR,
+            f"splitting budget has fewer than one survivor per level "
+            f"(keep {spec.keep:g} x members {spec.members} < 1): the "
+            "level quantile falls on an empty survivor set — raise "
+            "members or keep",
+            path="sim.ensemble_split",
+        ))
+    if spec.levels <= 1:
+        findings.append(Finding(
+            "VET-T024", SEV_WARN,
+            "a single splitting level degenerates to plain Monte "
+            f"Carlo at the first threshold (resolving floor ~1/"
+            f"{spec.members}); raise levels for rarer events",
+            path="sim.ensemble_split",
+        ))
+    return findings
+
+
 def lint_compiled(compiled, params=None) -> List[Finding]:
     """Shape rules needing the unrolled hop tree (VET-T007/T008).
 

@@ -97,6 +97,8 @@ def vet_simulator(
     suppress=(),
     rung_names=("scan", "half-block", "cpu-eager"),
     ensemble=None,
+    protected: bool = False,
+    split_spec=None,
 ) -> Report:
     """Full vet of one built Simulator under one load.
 
@@ -111,6 +113,11 @@ def vet_simulator(
     lints the fleet spec (VET-T023) and runs the member-capacity
     verdict (VET-M004: members x peak-bytes vs device budget,
     reporting the auto-chunk the engine would pre-select).
+    ``protected=True`` runs the protected-fleet variant instead
+    (VET-T025: the stacked policy/rollout/timeline carry counts
+    toward each member's footprint).  ``split_spec`` (a SplitSpec or
+    its raw string) lints the importance-splitting config
+    (VET-T024).
     """
     report = Report(suppress=suppress)
     with telemetry.phase("vet.total"):
@@ -145,16 +152,44 @@ def vet_simulator(
 
                 ensemble = EnsembleSpec.of(ensemble)
             report.extend(topo_lint.lint_ensemble(ensemble))
-            report.extend(costmodel.ensemble_findings(
-                est, ensemble.members,
-            ))
+            carry = 0.0
+            if protected:
+                # size the carry from the windows this LOAD would
+                # actually plan (duration / window width, clamped the
+                # way the run-time planner clamps) — the worst-case
+                # timeline_max_windows would overstate the carry and
+                # misreport the chunk the engine really picks
+                from isotope_tpu.metrics.timeline import plan_windows
+
+                w, _, _ = plan_windows(
+                    getattr(load, "duration_s", 0.0) or 1.0,
+                    sim.params.timeline_window_s,
+                    sim.params.timeline_max_windows,
+                    sim.compiled.num_services,
+                    log=lambda m: None,
+                )
+                carry = costmodel.protected_carry_bytes(
+                    sim, w,
+                    roll=getattr(sim, "_rollouts", None) is not None,
+                )
+                report.extend(costmodel.protected_ensemble_findings(
+                    est, ensemble.members, carry,
+                ))
+            else:
+                report.extend(costmodel.ensemble_findings(
+                    est, ensemble.members,
+                ))
             report.meta["ensemble"] = {
                 "members": ensemble.members,
+                "protected": bool(protected),
                 "chunk": costmodel.ensemble_chunk(
                     ensemble.members, est.peak_bytes_at_block,
                     est.capacity_bytes,
+                    carry_bytes_per_member=carry,
                 ),
             }
+        if split_spec is not None:
+            report.extend(topo_lint.lint_split(split_spec))
         report.meta["cost"] = {
             "block_requests": est.block_requests,
             "flops_at_block": est.flops_at_block,

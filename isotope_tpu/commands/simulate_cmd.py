@@ -127,6 +127,22 @@ def _add_ensemble_args(parser) -> None:
         "--ensemble-slo", default=None, metavar="LATENCY",
         help="SLO latency (e.g. '250ms') the ensemble artifact's "
              "P(p99 > SLO) estimate targets")
+    parser.add_argument(
+        "--ensemble-chaos-jitter", default=None, metavar="SPEC",
+        help="per-member chaos schedules (chaos fleets): jitter each "
+             "member's kill timing / target / magnitude as key=value "
+             "pairs, e.g. 'time=0.2,magnitude=0.5,target=0.3[,seed"
+             "=K]' — every fleet member survives a DIFFERENT bad "
+             "day (needs a [chaos] schedule; composes with "
+             "--policies, not yet with --rollouts)")
+    parser.add_argument(
+        "--ensemble-split", default=None, metavar="SPEC",
+        help="importance splitting (multilevel/RESTART) over the "
+             "chaos+workload RNG for rare-outage tails plain Monte "
+             "Carlo cannot resolve, e.g. 'levels=4,members=64,keep="
+             "0.25,threshold=0.5,sev=err_peak[,horizon=0.25]'; the "
+             "estimate lands behind <label>.ensemble.json's "
+             "'splitting' key")
 
 
 def _ensemble_config_kwargs(args) -> dict:
@@ -146,6 +162,16 @@ def _ensemble_config_kwargs(args) -> dict:
         out["ensemble_slo_s"] = dur.parse_duration_seconds(
             args.ensemble_slo
         )
+    if getattr(args, "ensemble_chaos_jitter", None) is not None:
+        from isotope_tpu.resilience.faults import parse_chaos_jitter
+
+        parse_chaos_jitter(args.ensemble_chaos_jitter)  # fail fast
+        out["ensemble_chaos_jitter"] = args.ensemble_chaos_jitter
+    if getattr(args, "ensemble_split", None) is not None:
+        from isotope_tpu.sim.splitting import parse_split_spec
+
+        parse_split_spec(args.ensemble_split)  # fail fast
+        out["ensemble_split"] = args.ensemble_split
     return out
 
 
@@ -293,7 +319,7 @@ def register(sub) -> None:
     _add_ensemble_args(s)
     s.add_argument("--ensemble-out", metavar="FILE", default=None,
                    help="write the ensemble's distributional summary "
-                        "as JSON (isotope-ensemble/v1)")
+                        "as JSON (isotope-ensemble/v2)")
     _add_mesh_args(s)
     _add_resilience_args(s)
     _add_vet_arg(s)

@@ -26,10 +26,12 @@ from isotope_tpu.compiler.cache import (
     persistent_cache_dir,
 )
 from isotope_tpu.compiler.compile import (
+    ChaosFx,
     CycleError,
     EnsembleTables,
     HopBudgetExceededError,
     NoEntrypointError,
+    compile_chaos_members,
     compile_ensemble,
     compile_graph,
     compile_lb,
@@ -44,10 +46,12 @@ __all__ = [
     "ScanBucketPlan",
     "ServiceTable",
     "UnrolledLevelPlan",
+    "ChaosFx",
     "CycleError",
     "EnsembleTables",
     "HopBudgetExceededError",
     "NoEntrypointError",
+    "compile_chaos_members",
     "compile_ensemble",
     "compile_graph",
     "compile_lb",

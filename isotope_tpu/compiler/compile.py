@@ -242,6 +242,71 @@ def compile_ensemble(spec) -> EnsembleTables:
     )
 
 
+class ChaosFx(NamedTuple):
+    """Per-member stacked chaos phase tables (chaos fleets).
+
+    The engine's chaos tables — effective replicas, outage flags, and
+    the policy layer's chaos-downed deltas, all ``(P*Cc, S)`` per
+    phase-combo row — are trace-time CONSTANTS on solo runs.  A fleet
+    whose members each survive a *different* bad day needs them per
+    member; this tuple carries the ``(N,)``-leading stacked versions
+    as TRACED arguments into ``Simulator._simulate_core(chaos_fx=...)``
+    so one compiled fleet program serves every member's schedule.
+    Shape alignment (same P, same window count W) is guaranteed by
+    ``resilience/faults.jitter_chaos_events`` preserving the solo
+    schedule's cut structure and asserted at build time.
+    """
+
+    eff_replicas_pc: "object"   # (N, P*Cc, S) i32
+    svc_down_pc: "object"       # (N, P*Cc, S) bool
+    downed_pc: "object"         # (N, P*Cc, S) f32 | None (policies)
+
+
+def compile_chaos_members(sim, member_events, with_pol: bool = False):
+    """Build each member's host-side planner Simulator (its own phase
+    reach multipliers, retry-feedback fixed point, and drain windows)
+    plus the stacked :class:`ChaosFx` device tables.
+
+    ``member_events`` is one jittered ``ChaosEvent`` tuple per member
+    (``resilience/faults.jitter_chaos_events``); ``with_pol`` also
+    stacks the policy chaos-down tables (protected fleets read them,
+    plain fleets do not — skip the transfer).  Returns
+    ``(planners, ChaosFx)``.  Raises when a member's schedule breaks
+    the shape-aligned contract (different cut count than the base
+    schedule) — the loud version of the structural invariant the
+    stacked tables rely on.
+    """
+    import jax.numpy as jnp
+    import numpy as np
+
+    planners = [sim._member_planner(evts) for evts in member_events]
+    P = int(np.asarray(sim._phase_starts).shape[0])
+    W = sim._num_windows
+    for m, pl in enumerate(planners):
+        if (int(np.asarray(pl._phase_starts).shape[0]) != P
+                or pl._num_windows != W
+                or pl._num_combos != sim._num_combos):
+            raise ValueError(
+                f"member {m}'s jittered chaos schedule has a "
+                "different phase-cut structure than the base schedule "
+                f"({np.asarray(pl._phase_starts).shape[0]} cuts vs "
+                f"{P}); per-member chaos requires shape-aligned "
+                "schedules (same event count, distinct solo cuts)"
+            )
+    telemetry.counter_inc("chaos_fleets_compiled")
+    fx = ChaosFx(
+        eff_replicas_pc=jnp.stack(
+            [pl._eff_replicas_pc for pl in planners]
+        ),
+        svc_down_pc=jnp.stack([pl._svc_down_pc for pl in planners]),
+        downed_pc=(
+            jnp.stack([pl._downed_pc for pl in planners])
+            if with_pol and sim._policies is not None else None
+        ),
+    )
+    return planners, fx
+
+
 def compile_graph(
     graph: ServiceGraph,
     entry: Optional[str] = None,

@@ -60,6 +60,13 @@ class ServiceTable:
     def index_of(self, name: str) -> int:
         return self.names.index(name)
 
+    def replicas_by_name(self) -> "dict[str, int]":
+        """``{service name: replica count}`` — the host-side view the
+        chaos-schedule jitter clamps magnitudes against."""
+        return {
+            n: int(r) for n, r in zip(self.names, self.replicas)
+        }
+
 
 @dataclasses.dataclass(frozen=True)
 class HopLevel:

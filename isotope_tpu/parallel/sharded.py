@@ -630,7 +630,7 @@ class ShardedSimulator:
 
     def _plan_ensemble(self, load, num_requests: int, key, spec,
                        block_size: int, trim: bool, member_keys,
-                       member_qps=None):
+                       member_qps=None, member_chaos=None):
         """Resolve (spec, tables, stacked args, members-per-shard) for
         one fleet dispatch.  Each member is a FULL run of
         ``num_requests`` — the mesh parallelizes the member axis, not
@@ -650,10 +650,19 @@ class ShardedSimulator:
         spec.check(allow_duplicate_seeds=member_keys is not None)
         self.sim._check_lb_load(load)
         tables = compile_ensemble(spec)
+        if member_chaos is not None and self.sim._saturated(load):
+            raise ValueError(
+                "per-member chaos does not support saturated -qps "
+                "max loads (host-constant finite-population tables)"
+            )
+        member_events, planners, chaos_fx = (
+            self.sim._resolve_member_chaos(member_chaos, spec.seeds)
+        )
+        chaos_args = self.sim._chaos_fx_args(chaos_fx, with_pol=False)
         args = self.sim._ensemble_args(
             load, num_requests, key, spec, tables,
             member_keys=member_keys, block_size=block_size, trim=trim,
-            member_qps=member_qps,
+            member_qps=member_qps, planners=planners,
         )
         per_shard = -(-spec.members // self.n_shards)
         # member chunking, mesh edition: per_shard members ride EACH
@@ -669,10 +678,11 @@ class ShardedSimulator:
         width = max(1, min(int(width), per_shard))
         rounds = -(-per_shard // width)
         width = -(-per_shard // rounds)  # balanced rounds
-        return spec, tables, args, width, rounds
+        return (spec, tables, args, width, rounds, chaos_args,
+                member_events)
 
     def _ensemble_padded(self, args, n_mem: int, width: int,
-                         rounds: int):
+                         rounds: int, chaos_args=()):
         """The member-stacked fleet arguments padded (the engine's
         shared pad law) so every (round, shard) slot holds ``width``
         members — round r dispatches the contiguous member slice
@@ -680,8 +690,8 @@ class ShardedSimulator:
         is exactly the order the emulated twin's flat chunk loop
         walks."""
         return self.sim._ensemble_pad_args(
-            self.sim._ensemble_stacked_args(args), n_mem,
-            rounds * width * self.n_shards,
+            self.sim._ensemble_stacked_args(args) + tuple(chaos_args),
+            n_mem, rounds * width * self.n_shards,
         )
 
     def _ensemble_out_specs(self, axes) -> RunSummary:
@@ -709,6 +719,7 @@ class ShardedSimulator:
         trim: bool = False,
         member_keys=None,
         member_qps=None,
+        member_chaos=None,
     ):
         """The Monte Carlo fleet sharded over the mesh: the member
         axis distributes over the FLATTENED device list (every mesh
@@ -727,17 +738,24 @@ class ShardedSimulator:
         laptop twin of a pod-scale fleet.
         """
         self._require_mesh("run_ensemble")
-        spec, tables, args, width, rounds = self._plan_ensemble(
+        (spec, tables, args, width, rounds, chaos_args,
+         member_events) = self._plan_ensemble(
             load, num_requests, key, spec, block_size, trim,
-            member_keys, member_qps,
+            member_keys, member_qps, member_chaos,
         )
         n_mem = spec.members
         telemetry.counter_inc("sharded_ensemble_runs")
         telemetry.gauge_set("ensemble_members", n_mem)
         telemetry.gauge_set("ensemble_members_per_shard", width)
         telemetry.gauge_set("ensemble_rounds", rounds)
-        fn = self._get_ensemble_fn(args, width, tables, trim)
-        padded = self._ensemble_padded(args, n_mem, width, rounds)
+        fn = self._get_ensemble_fn(
+            args, width, tables, trim,
+            member_chaos=len(chaos_args) > 0,
+            n_extra=len(chaos_args),
+        )
+        padded = self._ensemble_padded(
+            args, n_mem, width, rounds, chaos_args
+        )
         faults.check("sharded.compute")
         if self.dcn_axes:
             faults.check("sharded.dcn_collective")
@@ -758,18 +776,20 @@ class ShardedSimulator:
             summaries=summaries,
             offered_qps=args["offered"],
             chunk=width,
+            member_chaos=member_events,
         )
 
     def _get_ensemble_fn(self, args, width: int, tables,
-                         trim: bool):
+                         trim: bool, member_chaos: bool = False,
+                         n_extra: int = 0):
         """Jitted shard_map of the vmapped member program; the member
-        axis (per-shard round width) and jitter arming key the
-        cache."""
+        axis (per-shard round width), jitter arming, and per-member
+        chaos arming key the cache."""
         axes = tuple(self.mesh.axis_names)
         cache_key = (args["block"], args["num_blocks"], args["kind"],
                      args["conns"], trim,
                      args["sat"], width, tables.jittered,
-                     tables.mode)
+                     tables.mode, member_chaos)
         full_key = (
             ("sharded-ensemble", self.sim.signature,
              (axes,
@@ -780,6 +800,7 @@ class ShardedSimulator:
         member = self.sim._ensemble_member_fn(
             args["block"], args["num_blocks"], args["kind"],
             args["conns"], trim, args["sat"], tables.jittered,
+            member_chaos=member_chaos,
         )
         if tables.mode == "map":
             def local(*xs):
@@ -789,7 +810,7 @@ class ShardedSimulator:
         mapped = _shard_map(
             local,
             mesh=self.mesh,
-            in_specs=tuple(P(axes) for _ in range(10)),
+            in_specs=tuple(P(axes) for _ in range(10 + n_extra)),
             out_specs=self._ensemble_out_specs(axes),
         )
         return executable_cache.get_or_build(
@@ -810,6 +831,7 @@ class ShardedSimulator:
         trim: bool = False,
         member_keys=None,
         member_qps=None,
+        member_chaos=None,
     ):
         """The fleet's single-device twin: each shard's member slice
         runs through the SAME vmapped member program (the engine's
@@ -819,9 +841,10 @@ class ShardedSimulator:
         over an :class:`~isotope_tpu.parallel.mesh.EmulatedMesh` (any
         host count on one CPU) and serves as the fleet's OOM
         degradation rung."""
-        spec, tables, args, width, rounds = self._plan_ensemble(
+        (spec, tables, args, width, rounds, chaos_args,
+         member_events) = self._plan_ensemble(
             load, num_requests, key, spec, block_size, trim,
-            member_keys, member_qps,
+            member_keys, member_qps, member_chaos,
         )
         n_mem = spec.members
         telemetry.counter_inc("sharded_ensemble_emulated_runs")
@@ -829,8 +852,11 @@ class ShardedSimulator:
             args["block"], args["num_blocks"], args["kind"],
             args["conns"], trim, args["sat"], width,
             tables.jittered, tables.mode,
+            member_chaos=len(chaos_args) > 0,
         )
-        padded = self._ensemble_padded(args, n_mem, width, rounds)
+        padded = self._ensemble_padded(
+            args, n_mem, width, rounds, chaos_args
+        )
         parts = []
         with telemetry.phase("sharded.emulated"):
             # the flat width-chunk walk visits members in exactly the
@@ -849,6 +875,343 @@ class ShardedSimulator:
             summaries=summaries,
             offered_qps=args["offered"],
             chunk=width,
+            member_chaos=member_events,
+        )
+
+    # -- protected ensembles: chaos fleets (sim/ensemble.py) ------------
+
+    @staticmethod
+    def _filled_specs(cls, spec, none_fields=()):
+        """A NamedTuple out-spec with ``spec`` on every leaf (None
+        fields stay None — e.g. RunSummary.metrics stays out of fleet
+        programs)."""
+        return cls(**{
+            f: (None if f in none_fields else spec)
+            for f in cls._fields
+        })
+
+    def _protected_ens_out_specs(self, axes, roll: bool):
+        """The protected fleet's output pytree: every leaf carries a
+        leading member axis sharded over the flattened mesh."""
+        from isotope_tpu.metrics.timeline import TimelineSummary
+
+        member = P(axes)
+        out = (
+            self._filled_specs(RunSummary, member, ("metrics",)),
+            self._filled_specs(TimelineSummary, member),
+        )
+        if roll:
+            from isotope_tpu.sim.rollout import RolloutSummary
+
+            out = out + (
+                self._filled_specs(RolloutSummary, member),
+            )
+        if self.sim._policies is not None:
+            from isotope_tpu.sim.policies import PolicySummary
+
+            out = out + (
+                self._filled_specs(PolicySummary, member),
+            )
+        return out
+
+    def _plan_protected_ensemble(self, load, num_requests, key, spec,
+                                 block_size, trim, window_s,
+                                 member_keys, member_qps,
+                                 member_chaos, roll: bool):
+        """Resolve one protected fleet dispatch: spec/tables/args plus
+        the timeline plan and the stacked chaos rows — shared by the
+        mesh path and the emulated twin so their member programs are
+        the identical trace."""
+        from isotope_tpu.compiler.compile import compile_ensemble
+        from isotope_tpu.metrics import timeline as timeline_mod
+        from isotope_tpu.sim import ensemble as ens_mod
+
+        sim = self.sim
+        if spec is None:
+            if sim.params.ensemble <= 0:
+                raise ValueError(
+                    "protected fleets need an EnsembleSpec (or "
+                    "SimParams.ensemble > 0)"
+                )
+            spec = ens_mod.EnsembleSpec.of(sim.params.ensemble)
+        spec.check(allow_duplicate_seeds=member_keys is not None)
+        if sim._saturated(load):
+            raise ValueError(
+                "protected fleets do not support saturated -qps max "
+                "loads (static finite-population tables)"
+            )
+        sim._check_lb_load(load)
+        tables = compile_ensemble(spec)
+        member_events, planners, chaos_fx = sim._resolve_member_chaos(
+            member_chaos, spec.seeds, with_pol=True
+        )
+        args = sim._ensemble_args(
+            load, num_requests, key, spec, tables,
+            member_keys=member_keys, block_size=block_size,
+            trim=trim, member_qps=member_qps, planners=planners,
+        )
+        tl_plan = sim.plan_timeline_windows(
+            args["num_blocks"] * args["block"],
+            float(args["offered"][0]), window_s,
+        )
+        chaos_args = sim._chaos_fx_args(chaos_fx, with_pol=True)
+        if chaos_fx is not None:
+            tspec = timeline_mod.build_spec(
+                self.compiled, tl_plan[0], tl_plan[1]
+            )
+            chaos_args = chaos_args + (jnp.stack([
+                pl._policy_downed_windows(tspec, base_split=roll)
+                for pl in planners
+            ]),)
+        per_shard = -(-spec.members // self.n_shards)
+        width = spec.chunk
+        if width is None:
+            width = sim.protected_ensemble_chunk(
+                per_shard, args["block"], tl_plan, roll
+            )
+        width = max(1, min(int(width), per_shard))
+        rounds = -(-per_shard // width)
+        width = -(-per_shard // rounds)  # balanced rounds
+        return (spec, tables, args, tl_plan, chaos_args,
+                member_events, width, rounds)
+
+    def _protected_ens_summary(self, spec, args, out, width,
+                               member_events, roll: bool):
+        """Assemble the EnsembleSummary from the concatenated
+        protected fleet output tuple (the engine's unpack order)."""
+        from isotope_tpu.sim import ensemble as ens_mod
+
+        summary, tl = out[0], out[1]
+        rest = list(out[2:])
+        roll_stack = rest.pop(0) if roll else None
+        pol_stack = (
+            rest.pop(0) if self.sim._policies is not None else None
+        )
+        return ens_mod.EnsembleSummary(
+            spec=spec,
+            summaries=summary,
+            offered_qps=args["offered"],
+            chunk=width,
+            member_chaos=member_events,
+            timelines=tl,
+            policies=pol_stack,
+            rollouts=roll_stack,
+        )
+
+    def run_policies_ensemble(
+        self, load, num_requests, key, spec=None, *,
+        block_size: int = 65_536, trim: bool = False,
+        window_s=None, member_keys=None, member_qps=None,
+        member_chaos=None,
+    ):
+        """The protected policy fleet sharded over the mesh: the
+        member axis distributes over the FLATTENED device list and
+        each device maps its local member slice through the
+        single-device protected member program — no cross-member (or
+        cross-shard) collectives exist, so per-member physics and
+        bits are :meth:`Simulator.run_policies_ensemble`'s, and the
+        whole fleet is bit-equal to
+        :meth:`run_policies_ensemble_emulated` (pinned).  Unlike the
+        request-sharded :meth:`run_policies` there is NO svc=1 mesh
+        restriction: members are whole worlds."""
+        self._require_mesh("run_policies_ensemble")
+        if self.sim._policies is None:
+            raise ValueError(
+                "policy fleets need compiled policy tables "
+                "(ShardedSimulator(..., policies=...))"
+            )
+        if not self.sim.params.timeline:
+            raise ValueError(
+                "policy fleets need SimParams(timeline=True)"
+            )
+        faults.check("policies.stuck_breaker")
+        faults.check("policies.autoscaler_lag")
+        return self._run_protected_ensemble_device(
+            load, num_requests, key, spec, block_size, trim,
+            window_s, member_keys, member_qps, member_chaos,
+            roll=False,
+        )
+
+    def run_rollouts_ensemble(
+        self, load, num_requests, key, spec=None, *,
+        block_size: int = 65_536, trim: bool = False,
+        window_s=None, member_keys=None, member_qps=None,
+        member_chaos=None,
+    ):
+        """The progressive-delivery fleet sharded over the mesh (see
+        :meth:`run_policies_ensemble` — member-axis sharding, zero
+        collectives, bit-equal emulated twin)."""
+        self._require_mesh("run_rollouts_ensemble")
+        if self.sim._rollouts is None:
+            raise ValueError(
+                "rollout fleets need compiled rollout tables "
+                "(ShardedSimulator(..., rollouts=...))"
+            )
+        if not self.sim.params.timeline:
+            raise ValueError(
+                "rollout fleets need SimParams(timeline=True)"
+            )
+        if self.sim._policies is not None:
+            faults.check("policies.stuck_breaker")
+            faults.check("policies.autoscaler_lag")
+        return self._run_protected_ensemble_device(
+            load, num_requests, key, spec, block_size, trim,
+            window_s, member_keys, member_qps, member_chaos,
+            roll=True,
+        )
+
+    def _run_protected_ensemble_device(self, load, num_requests, key,
+                                       spec, block_size, trim,
+                                       window_s, member_keys,
+                                       member_qps, member_chaos,
+                                       roll: bool):
+        (spec, tables, args, tl_plan, chaos_args, member_events,
+         width, rounds) = self._plan_protected_ensemble(
+            load, num_requests, key, spec, block_size, trim,
+            window_s, member_keys, member_qps, member_chaos, roll,
+        )
+        n_mem = spec.members
+        telemetry.counter_inc(
+            "sharded_rollout_fleet_runs" if roll
+            else "sharded_policy_fleet_runs"
+        )
+        telemetry.gauge_set("ensemble_members", n_mem)
+        telemetry.gauge_set("ensemble_members_per_shard", width)
+        telemetry.gauge_set("ensemble_rounds", rounds)
+        member_chaos_on = len(chaos_args) > 0
+        axes = tuple(self.mesh.axis_names)
+        cache_key = ("prot-ens", args["block"], args["num_blocks"],
+                     args["kind"], args["conns"], trim, tl_plan,
+                     roll, width, tables.jittered, tables.mode,
+                     member_chaos_on)
+        full_key = (
+            ("sharded-ensemble", self.sim.signature,
+             (axes,
+              tuple(int(self.mesh.shape[a]) for a in axes),
+              tuple(d.id for d in self.mesh.devices.flat)))
+            + cache_key
+        )
+        member = self.sim._protected_member_fn(
+            args["block"], args["num_blocks"], args["kind"],
+            args["conns"], trim, tl_plan, roll, tables.jittered,
+            member_chaos_on,
+        )
+        if tables.mode == "map":
+            def local(*xs):
+                return jax.lax.map(lambda t: member(*t), xs)
+        else:
+            local = jax.vmap(member)
+        n_args = 10 + len(chaos_args)
+        mapped = _shard_map(
+            local,
+            mesh=self.mesh,
+            in_specs=tuple(P(axes) for _ in range(n_args)),
+            out_specs=self._protected_ens_out_specs(axes, roll),
+        )
+        fn = executable_cache.get_or_build(
+            full_key,
+            lambda: telemetry.time_first_call(
+                jax.jit(mapped), "compile.jit_first_call",
+            ),
+        )
+        padded = self.sim._ensemble_pad_args(
+            self.sim._ensemble_stacked_args(args) + chaos_args,
+            n_mem, rounds * width * self.n_shards,
+        )
+        faults.check("sharded.compute")
+        if self.dcn_axes:
+            faults.check("sharded.dcn_collective")
+        per_round = width * self.n_shards
+        parts = []
+        for r in range(rounds):
+            sl = slice(r * per_round, (r + 1) * per_round)
+            parts.append(fn(*(x[sl] for x in padded)))
+            if rounds > 1:
+                jax.block_until_ready(parts[-1][0].count)
+        out = self.sim._ensemble_concat(parts, n_mem)
+        return self._protected_ens_summary(
+            spec, args, out, width, member_events, roll
+        )
+
+    def run_policies_ensemble_emulated(
+        self, load, num_requests, key, spec=None, *,
+        block_size: int = 65_536, trim: bool = False,
+        window_s=None, member_keys=None, member_qps=None,
+        member_chaos=None,
+    ):
+        """The protected fleet's single-device twin: each shard's
+        member slice runs through the engine's own protected fleet
+        program serially, then concatenates on host — bit-equal to
+        :meth:`run_policies_ensemble` (no collectives exist in the
+        fleet program), works over an
+        :class:`~isotope_tpu.parallel.mesh.EmulatedMesh`, and serves
+        as the fleet's OOM degradation rung."""
+        if self.sim._policies is None:
+            raise ValueError(
+                "policy fleets need compiled policy tables "
+                "(ShardedSimulator(..., policies=...))"
+            )
+        return self._run_protected_ensemble_emulated(
+            load, num_requests, key, spec, block_size, trim,
+            window_s, member_keys, member_qps, member_chaos,
+            roll=False,
+        )
+
+    def run_rollouts_ensemble_emulated(
+        self, load, num_requests, key, spec=None, *,
+        block_size: int = 65_536, trim: bool = False,
+        window_s=None, member_keys=None, member_qps=None,
+        member_chaos=None,
+    ):
+        """The rollout fleet's single-device twin (see
+        :meth:`run_policies_ensemble_emulated`)."""
+        if self.sim._rollouts is None:
+            raise ValueError(
+                "rollout fleets need compiled rollout tables "
+                "(ShardedSimulator(..., rollouts=...))"
+            )
+        return self._run_protected_ensemble_emulated(
+            load, num_requests, key, spec, block_size, trim,
+            window_s, member_keys, member_qps, member_chaos,
+            roll=True,
+        )
+
+    def _run_protected_ensemble_emulated(self, load, num_requests,
+                                         key, spec, block_size, trim,
+                                         window_s, member_keys,
+                                         member_qps, member_chaos,
+                                         roll: bool):
+        (spec, tables, args, tl_plan, chaos_args, member_events,
+         width, rounds) = self._plan_protected_ensemble(
+            load, num_requests, key, spec, block_size, trim,
+            window_s, member_keys, member_qps, member_chaos, roll,
+        )
+        n_mem = spec.members
+        telemetry.counter_inc(
+            "sharded_rollout_fleet_emulated_runs" if roll
+            else "sharded_policy_fleet_emulated_runs"
+        )
+        fn = self.sim._get_protected_ensemble(
+            args["block"], args["num_blocks"], args["kind"],
+            args["conns"], trim, tl_plan, roll, width,
+            tables.jittered, tables.mode, len(chaos_args) > 0,
+        )
+        padded = self.sim._ensemble_pad_args(
+            self.sim._ensemble_stacked_args(args) + chaos_args,
+            n_mem, rounds * width * self.n_shards,
+        )
+        parts = []
+        with telemetry.phase("sharded.emulated"):
+            # the flat width-chunk walk visits members in exactly the
+            # device path's (round, shard) order — contiguous slices
+            for c in range(rounds * self.n_shards):
+                sl = slice(c * width, (c + 1) * width)
+                out = fn(*(x[sl] for x in padded))
+                jax.block_until_ready(out[0].count)
+                parts.append(out)
+        out = self.sim._ensemble_concat(parts, n_mem)
+        return self._protected_ens_summary(
+            spec, args, out, width, member_events, roll
         )
 
     # -- attributed runs (metrics/attribution.py) -----------------------

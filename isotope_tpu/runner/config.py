@@ -188,6 +188,19 @@ class ExperimentConfig:
     # the SLO latency (seconds) the ensemble artifact's P(violation)
     # estimate is computed against; None omits the estimate
     ensemble_slo_s: Optional[float] = None
+    # per-member chaos schedules (chaos fleets, PR 15): a
+    # resilience/faults.ChaosJitterSpec spec string
+    # ("time=0.2,magnitude=0.5,target=0.3,seed=K") jittering each
+    # fleet member's kill timing / target / magnitude; None keeps the
+    # base schedule on every member (--ensemble-chaos-jitter /
+    # TOML [sim] ensemble_chaos_jitter)
+    ensemble_chaos_jitter: Optional[str] = None
+    # importance splitting (sim/splitting.py): a SplitSpec string
+    # ("levels=4,members=64,keep=0.25,threshold=0.5,sev=err_peak")
+    # arming the rare-outage estimator per ensemble case; the result
+    # lands behind `<label>.ensemble.json`'s schema-versioned
+    # "splitting" key (--ensemble-split / TOML [sim] ensemble_split)
+    ensemble_split: Optional[str] = None
 
     def sim_params(self) -> SimParams:
         return SimParams(
@@ -216,6 +229,27 @@ class ExperimentConfig:
             error_jitter=self.ensemble_error_jitter,
             jitter_seed=self.ensemble_jitter_seed,
         )
+
+    def chaos_jitter_spec(self):
+        """The sweep's per-member chaos jitter
+        (:class:`~isotope_tpu.resilience.faults.ChaosJitterSpec`), or
+        None when off or no chaos schedule exists to jitter."""
+        if not self.ensemble_chaos_jitter or not self.chaos:
+            return None
+        from isotope_tpu.resilience.faults import parse_chaos_jitter
+
+        with config_path("sim.ensemble_chaos_jitter"):
+            return parse_chaos_jitter(self.ensemble_chaos_jitter)
+
+    def split_spec(self):
+        """The sweep's importance-splitting config
+        (:class:`~isotope_tpu.sim.splitting.SplitSpec`), or None."""
+        if not self.ensemble_split:
+            return None
+        from isotope_tpu.sim.splitting import parse_split_spec
+
+        with config_path("sim.ensemble_split"):
+            return parse_split_spec(self.ensemble_split)
 
     def load_models(self):
         for conn in self.connections:
@@ -462,4 +496,19 @@ def _ensemble_kwargs(sim: dict) -> dict:
             out["ensemble_slo_s"] = dur.parse_duration_seconds(
                 sim["ensemble_slo"]
             )
+    if "ensemble_chaos_jitter" in sim:
+        # parse eagerly: a typo'd spec must fail at config load
+        from isotope_tpu.resilience.faults import parse_chaos_jitter
+
+        with config_path("sim.ensemble_chaos_jitter"):
+            parse_chaos_jitter(str(sim["ensemble_chaos_jitter"]))
+        out["ensemble_chaos_jitter"] = str(
+            sim["ensemble_chaos_jitter"]
+        )
+    if "ensemble_split" in sim:
+        from isotope_tpu.sim.splitting import parse_split_spec
+
+        with config_path("sim.ensemble_split"):
+            parse_split_spec(str(sim["ensemble_split"]))
+        out["ensemble_split"] = str(sim["ensemble_split"])
     return out

@@ -103,10 +103,12 @@ class RunResult:
     # the topology declares no lb entries
     lb: Optional[dict] = None
     # scenario ensembles (sim/ensemble.py): the ensemble.json doc
-    # (isotope-ensemble/v1: per-member quantiles, quantile bands,
-    # SLO-violation probability with Wilson CI) and the raw
-    # EnsembleSummary; None when the ensemble axis was off or the
-    # fleet dispatch fell back to the solo path
+    # (isotope-ensemble/v2: per-member quantiles, quantile bands,
+    # SLO-violation probability with Wilson CI, and — for chaos
+    # fleets — severity ranking, worst-member pointer, and the
+    # importance-splitting block) and the raw EnsembleSummary; None
+    # when the ensemble axis was off or the fleet dispatch fell back
+    # to the solo path
     ensemble: Optional[dict] = None
     ensemble_summary: Optional[object] = None
 
@@ -446,7 +448,8 @@ class _EnsembleGroups:
 
 
 def _vet_gate(mode: str, sim, topo, config, load, block, rungs,
-              policy, ensemble=None) -> int:
+              policy, ensemble=None, protected: bool = False,
+              split_spec=None) -> int:
     """The ``--vet`` pre-flight: lint + audit + cost model for one case.
 
     Returns the ladder rung index the case should START on (the memory
@@ -472,6 +475,8 @@ def _vet_gate(mode: str, sim, topo, config, load, block, rungs,
         suppress=default_suppressions(),
         rung_names=tuple(name for name, _ in rungs),
         ensemble=ensemble,
+        protected=protected,
+        split_spec=split_spec,
     )
     for f in report.sorted():
         print(f"vet: {f.render()}", file=sys.stderr)
@@ -715,19 +720,13 @@ def _protected_run(sim, sharded, use_sharded, load, n, key, block,
             "needs; use svc=1)",
             file=sys.stderr,
         )
-    if timeline is not None:
-        win = float(timeline)
-    else:
-        # a window that never completes is a control loop that never
-        # observes: without an explicit --timeline width, size the
-        # default so a run spans >= ~8 windows
-        win = min(
-            config.timeline_window_s,
-            max(load.duration_s / 8.0, 1e-3),
-        )
-    rate = load.qps if load.qps is not None else sim.capacity_qps()
-    shards = getattr(runner, "n_shards", 1)
-    block = max(256, min(block, int(max(rate * win / shards, 1.0))))
+    # a window that never completes is a control loop that never
+    # observes: without an explicit --timeline width the shared law
+    # sizes the default so a run spans >= ~8 windows
+    win, block = _protected_window_block(
+        sim, load, block, config, timeline,
+        shards=getattr(runner, "n_shards", 1),
+    )
     kwargs = dict(trim=True, window_s=win)
     is_sharded = runner is not sim
     if not is_sharded:
@@ -782,6 +781,157 @@ def _protected_run(sim, sharded, use_sharded, load, n, key, block,
             attr_summary = None
     return (summary, tl_main, roll_main, pol_main, blame_doc,
             attr_summary, degraded_to)
+
+
+def _protected_window_block(sim, load, block, config, timeline,
+                            shards: int = 1):
+    """The protected runners' shared window/block sizing: cap the
+    block near ONE recorder window of requests (the control loops
+    actuate at block boundaries).  ONE copy serves `_protected_run`
+    (which passes the request-sharded executor's shard count) and the
+    fleet path (shards=1 — the member program is the solo program),
+    so fleet member 0 reproduces the solo protected run's shape on
+    one device by construction."""
+    if timeline is not None:
+        win = float(timeline)
+    else:
+        win = min(
+            config.timeline_window_s,
+            max(load.duration_s / 8.0, 1e-3),
+        )
+    rate = load.qps if load.qps is not None else sim.capacity_qps()
+    return win, max(
+        256, min(block, int(max(rate * win / max(shards, 1), 1.0)))
+    )
+
+
+def _protected_ensemble_run(sim, sharded, use_sharded, load, n,
+                            run_key, block, config, timeline,
+                            tables_roll, ens_spec, chaos_jitter):
+    """The protected Monte Carlo fleet for one case (PR 15): N
+    members of ``run_policies`` / ``run_rollouts`` behind one jitted
+    program per device — the PROTECTED physics measured
+    distributionally instead of once.  Member 0 is the CONTROL
+    member: it rides the RUN key itself AND (under ``chaos_jitter``)
+    keeps the solo chaos schedule, so it is bit-equal to the solo
+    protected run the pre-fleet runner would have executed (members
+    1..N-1 fold their seeds and survive their own jittered bad days).
+    ``chaos_jitter`` applies to policy fleets only — the rollout
+    kill-split tables are trace constants."""
+    roll = tables_roll is not None
+    win, block = _protected_window_block(
+        sim, load, block, config, timeline
+    )
+    member_keys = [run_key] + [
+        jax.random.fold_in(run_key, s) for s in ens_spec.seeds[1:]
+    ]
+    member_chaos = None
+    if chaos_jitter is not None and not roll \
+            and getattr(sim, "_chaos_events", ()):
+        from isotope_tpu.resilience import faults as faults_mod
+
+        base_events = tuple(sim._chaos_events)
+        reps = sim.compiled.services.replicas_by_name()
+        member_chaos = [base_events] + [
+            faults_mod.jitter_chaos_events(
+                base_events, chaos_jitter,
+                faults_mod.member_event_seeds(
+                    chaos_jitter, s, len(base_events)
+                ),
+                reps,
+            )
+            for s in ens_spec.seeds[1:]
+        ]
+    runner = sharded if (use_sharded and sharded is not None) else sim
+    method = getattr(
+        runner,
+        "run_rollouts_ensemble" if roll else "run_policies_ensemble",
+    )
+    with telemetry.phase("ensemble.run"):
+        ens = method(
+            load, n, run_key, ens_spec, block_size=block, trim=True,
+            window_s=win, member_keys=member_keys,
+            member_chaos=member_chaos,
+        )
+        jax.block_until_ready(ens.summaries.count)
+    telemetry.counter_inc("protected_fleet_cases")
+    return ens
+
+
+def _splitting_pass(sim, sharded, use_sharded, topo, load, n,
+                    run_key, block, config, timeline, protected,
+                    tables_roll, split, chaos_jitter):
+    """Best-effort importance-splitting estimate for one case
+    (sim/splitting.py): one SHORT-HORIZON fleet dispatch per level,
+    members ranked by the severity statistic, the worst quantile
+    cloned-and-continued with re-folded keys.  The estimate lands
+    behind the ensemble artifact's schema-versioned ``splitting``
+    key; a splitting failure never fails a case whose metrics
+    already landed."""
+    import numpy as np
+
+    from isotope_tpu.sim import splitting as split_mod
+    from isotope_tpu.sim.ensemble import EnsembleSpec
+
+    runner = sharded if (use_sharded and sharded is not None) else sim
+    n_short = max(256, int(n * split.horizon))
+    roll = tables_roll is not None
+    chaos = tuple(config.chaos)
+    jitter = chaos_jitter if (chaos and not roll) else None
+    # a distinct key lane: splitting fleets must not replay the
+    # measurement members' streams
+    base = jax.random.fold_in(run_key, 777_000_001)
+    kwargs = {}
+    blk = block
+    if protected:
+        win, blk = _protected_window_block(
+            sim, load, block, config, timeline
+        )
+        method = getattr(
+            runner,
+            "run_rollouts_ensemble" if roll
+            else "run_policies_ensemble",
+        )
+        kwargs["window_s"] = win
+    else:
+        method = runner.run_ensemble
+    if jitter is not None:
+        reps = topo.compiled.services.replicas_by_name()
+        from isotope_tpu.resilience import faults as faults_mod
+
+    def evaluate(chaos_seeds, work_seeds):
+        n_m = len(work_seeds)
+        espec = EnsembleSpec.of(n_m)
+        mkeys = [
+            jax.random.fold_in(base, int(w)) for w in work_seeds
+        ]
+        mc = None
+        if jitter is not None:
+            mc = [
+                faults_mod.jitter_chaos_events(chaos, jitter, row,
+                                               reps)
+                for row in np.asarray(chaos_seeds)
+            ]
+        out = method(
+            load, n_short, base, espec, block_size=blk, trim=False,
+            member_keys=mkeys, member_chaos=mc, **kwargs,
+        )
+        return split_mod.severity_scores(
+            split, out.summaries, out.timelines
+        )
+
+    try:
+        with telemetry.phase("splitting.pass"):
+            doc = split_mod.subset_estimate(
+                evaluate, split,
+                chaos_components=max(len(chaos), 1),
+            )
+        telemetry.counter_inc("splitting_passes")
+        return doc
+    except Exception as e:  # pragma: no cover - best-effort surface
+        telemetry.counter_inc("splitting_pass_failures")
+        print(f"warning: splitting pass failed: {e}", file=sys.stderr)
+        return None
 
 
 def _record_vet_memory_ratio() -> None:
@@ -996,18 +1146,19 @@ def run_experiment(
                                 start_rung = _vet_gate(
                                     vet, sim, topo, config, load,
                                     block, rungs, policy,
-                                    # fleet verdicts only for cases a
-                                    # fleet will actually serve (the
-                                    # protected co-sim runs solo)
-                                    ensemble=(
-                                        ens_spec
-                                        if not protected
-                                        else None
-                                    ),
+                                    # fleet verdicts for every case a
+                                    # fleet serves — protected fleets
+                                    # get the carry-aware VET-T025
+                                    # variant
+                                    ensemble=ens_spec,
+                                    protected=protected,
+                                    split_spec=config.ensemble_split,
                                 )
                             tl_main = pol_main = roll_main = None
                             pol_blame = pol_attr = None
                             ens_summary = None
+                            prot_fleet = False
+                            prot_worst = None
                             if ens_groups is not None \
                                     and not protected \
                                     and start_rung == 0:
@@ -1060,23 +1211,94 @@ def run_experiment(
                                     )
                             if protected:
                                 # policy/rollout co-sim: the PROTECTED
-                                # run IS the measurement (the control
-                                # loops change the physics), so it
-                                # replaces the plain ladder run —
-                                # failures walk its own supervisor
-                                # ladder (half-block → single-device
-                                # emulation) with degraded_to recorded
-                                (summary, tl_main, roll_main,
-                                 pol_main, pol_blame, pol_attr,
-                                 degraded_to) = _protected_run(
-                                    sim, sharded, use_sharded,
-                                    load, n, run_key, block,
-                                    config, topo.collector,
-                                    policy, timeline,
-                                    topo.policy_tables,
-                                    topo.rollout_tables,
-                                    attribution=attribution,
-                                )
+                                # run IS the measurement.  With the
+                                # ensemble axis armed it dispatches as
+                                # a FLEET (PR 15 — the pre-fleet
+                                # protected-solo fallback is deleted):
+                                # member 0 rides the run key, so it is
+                                # bit-equal to the solo protected run,
+                                # and the worst member's artifacts
+                                # become the postmortem.  Attributed
+                                # cases keep the solo path (fleet
+                                # blame is a ROADMAP residual), as do
+                                # memory-degraded ones.
+                                degraded_to = None
+                                if ens_spec is not None \
+                                        and start_rung == 0 \
+                                        and attribution is None:
+                                    try:
+                                        ens_summary = \
+                                            _protected_ensemble_run(
+                                                sim, sharded,
+                                                use_sharded, load, n,
+                                                run_key, block,
+                                                config, timeline,
+                                                topo.rollout_tables,
+                                                ens_spec,
+                                                config
+                                                .chaos_jitter_spec(),
+                                            )
+                                        prot_fleet = True
+                                        summary = \
+                                            ens_summary.pooled()
+                                        prot_worst = (
+                                            ens_summary
+                                            .worst_member()
+                                        )
+                                        tl_main = (
+                                            ens_summary
+                                            .member_timeline(
+                                                prot_worst
+                                            )
+                                        )
+                                        if ens_summary.policies \
+                                                is not None:
+                                            pol_main = (
+                                                ens_summary
+                                                .member_policies(
+                                                    prot_worst
+                                                )
+                                            )
+                                        if ens_summary.rollouts \
+                                                is not None:
+                                            roll_main = (
+                                                ens_summary
+                                                .member_rollouts(
+                                                    prot_worst
+                                                )
+                                            )
+                                        telemetry.counter_inc(
+                                            "ensemble_cases"
+                                        )
+                                        telemetry.set_meta(
+                                            "ensemble",
+                                            str(ens_summary.members),
+                                        )
+                                    except Exception as e:
+                                        telemetry.counter_inc(
+                                            "ensemble_fallbacks"
+                                        )
+                                        print(
+                                            f"warning: protected "
+                                            f"fleet dispatch for "
+                                            f"{label} failed "
+                                            f"({type(e).__name__}: "
+                                            f"{e}); falling back to "
+                                            "the solo protected run",
+                                            file=sys.stderr,
+                                        )
+                                if not prot_fleet:
+                                    (summary, tl_main, roll_main,
+                                     pol_main, pol_blame, pol_attr,
+                                     degraded_to) = _protected_run(
+                                        sim, sharded, use_sharded,
+                                        load, n, run_key, block,
+                                        config, topo.collector,
+                                        policy, timeline,
+                                        topo.policy_tables,
+                                        topo.rollout_tables,
+                                        attribution=attribution,
+                                    )
                             elif ens_summary is not None:
                                 summary = ens_summary.pooled()
                                 degraded_to = None
@@ -1162,7 +1384,11 @@ def run_experiment(
                     if protected:
                         # the protected run already reduced the
                         # timeline next to the control series — no
-                        # separate recorder pass needed
+                        # separate recorder pass needed.  Fleet-served
+                        # cases report the MOST-SEVERE member's
+                        # artifacts, stamped with its member index and
+                        # seed, so a rare failure the fleet found is
+                        # immediately replayable solo.
                         from isotope_tpu.metrics import (
                             timeline as timeline_mod,
                         )
@@ -1191,6 +1417,45 @@ def run_experiment(
                                 topo.compiled, roll_main,
                                 topo.rollout_tables,
                             )
+                        if prot_fleet:
+                            stamp = {
+                                "member": int(prot_worst),
+                                # member 0 is the CONTROL member: it
+                                # rides the RUN key itself, so the
+                                # replay recipe is the solo run, not
+                                # a folded seed
+                                "member_seed": (
+                                    None if prot_worst == 0 else int(
+                                        ens_spec.seeds[prot_worst]
+                                    )
+                                ),
+                                "member_key": (
+                                    "run_key" if prot_worst == 0
+                                    else "fold_in(run_key, "
+                                         "member_seed)"
+                                ),
+                                "fleet_members": (
+                                    ens_summary.members
+                                ),
+                                "worst_member": True,
+                            }
+                            if ens_summary.member_chaos is not None:
+                                stamp["member_chaos"] = [
+                                    {
+                                        "service": ev.service,
+                                        "start_s": float(ev.start_s),
+                                        "end_s": float(ev.end_s),
+                                        "replicas_down": (
+                                            ev.replicas_down
+                                        ),
+                                        "drain": ev.drain,
+                                    }
+                                    for ev in ens_summary
+                                    .member_chaos[prot_worst]
+                                ]
+                            for d in (tl_doc, pol_doc, roll_doc):
+                                if d is not None:
+                                    d.update(stamp)
                     elif timeline is not None:
                         tl_doc, tl_summary = _timeline_pass(
                             sim, sharded, use_sharded, topo, load, n,
@@ -1278,11 +1543,33 @@ def run_experiment(
                         # but a different measurement; the marker
                         # keeps comparisons honest and the artifact
                         # carries the distributional view
+                        split_doc = None
+                        if config.ensemble_split:
+                            # importance splitting (sim/splitting.py):
+                            # resolve the rare-outage tail the fleet's
+                            # Wilson interval cannot, one short-
+                            # horizon fleet dispatch per level
+                            split_doc = _splitting_pass(
+                                sim, sharded, use_sharded, topo,
+                                load, n, run_key, block, config,
+                                timeline, protected,
+                                topo.rollout_tables,
+                                config.split_spec(),
+                                config.chaos_jitter_spec(),
+                            )
                         ens_doc = ens_summary.to_doc(
                             label=label,
                             slo_s=config.ensemble_slo_s,
+                            splitting=split_doc,
                         )
                         flat["_ensemble"] = ens_summary.members
+                        if prot_fleet:
+                            flat["_protected_fleet"] = True
+                            if ens_doc.get("worst_member") == 0:
+                                # the control member rides the RUN
+                                # key, not a folded seed — the
+                                # replay recipe is the solo run
+                                ens_doc["worst_member_seed"] = None
                     flat.update(
                         {
                             "cpu_cores_" + name: round(v, 4)

@@ -13,6 +13,7 @@ from isotope_tpu.sim.ensemble import (
     EnsembleSummary,
     wilson_interval,
 )
+from isotope_tpu.sim.splitting import SplitSpec, subset_estimate
 
 __all__ = [
     "EnsembleSpec",
@@ -22,6 +23,8 @@ __all__ = [
     "SimParams",
     "SimResults",
     "Simulator",
+    "SplitSpec",
     "simulate",
+    "subset_estimate",
     "wilson_interval",
 ]

@@ -687,6 +687,9 @@ class Simulator:
         # whole subtree's reach).
         name_to_idx = {n: i for i, n in enumerate(t.names)}
         self._churn = tuple(churn)
+        # the raw chaos schedule is kept for the chaos-fleet planners
+        # (per-member jittered schedules, sim/ensemble.py)
+        self._chaos_events = tuple(chaos)
         hop_mult = None
         if churn:
             entry_of_svc = np.full(compiled.num_services, -1, np.int64)
@@ -2139,9 +2142,94 @@ class Simulator:
 
     # -- scenario ensembles (sim/ensemble.py) ---------------------------
 
+    def _member_planner(self, events) -> "Simulator":
+        """A host-side sibling Simulator carrying ONE fleet member's
+        jittered chaos schedule: its phase reach multipliers, retry-
+        feedback fixed point, drain windows, and closed-loop rate
+        solves are exactly what the solo run with that schedule would
+        use, so member k with the solo schedule reproduces its solo
+        run bit-for-bit.  Only the HOST tables are read off planners;
+        the traced fleet program belongs to ``self`` with the
+        planner's chaos rows riding as stacked arguments."""
+        return Simulator(
+            self.compiled, self.params, chaos=events,
+            churn=self._churn, mtls=self._mtls,
+            policies=self._policies, rollouts=self._rollouts,
+            lb=self._lb,
+        )
+
+    def _check_member_chaos(self) -> None:
+        """Reject the combinations whose chaos tables cannot ride as
+        traced per-member arguments (they stay host/trace constants)."""
+        if not self.has_chaos:
+            raise ValueError(
+                "per-member chaos needs a base chaos schedule to "
+                "jitter (Simulator(..., chaos=[...]))"
+            )
+        if any(not ev.drain for ev in self._chaos_events):
+            raise ValueError(
+                "per-member chaos does not support ungraceful kills "
+                "(drain=False): the resident-request reset tables are "
+                "per-event host constants"
+            )
+        if self._rollouts is not None:
+            raise ValueError(
+                "per-member chaos does not compose with rollout runs "
+                "yet: the canary-first kill-split tables are trace "
+                "constants (ROADMAP residual)"
+            )
+        if self._lb is not None and getattr(self._lb, "active", False) \
+                and getattr(self._lb, "any_panic", False):
+            raise ValueError(
+                "per-member chaos does not compose with lb panic "
+                "routing yet: the healthy-pool tables are trace "
+                "constants (ROADMAP residual)"
+            )
+
+    def _resolve_member_chaos(self, member_chaos, seeds,
+                              with_pol: bool = False):
+        """Normalize the ``member_chaos`` fleet argument.
+
+        Accepts a :class:`~isotope_tpu.resilience.faults.ChaosJitterSpec`
+        (per-member schedules derived from the member seeds via the
+        fold_in discipline), or an explicit per-member list of
+        ``ChaosEvent`` sequences (the splitting estimator's re-folded
+        clones).  ``with_pol`` stacks the policy chaos-down tables too
+        (protected fleets only — plain fleets never read them).
+        Returns ``(member_events, planners, chaos_fx)`` —
+        ``(None, None, None)`` when off."""
+        if member_chaos is None:
+            return None, None, None
+        from isotope_tpu.compiler.compile import compile_chaos_members
+
+        self._check_member_chaos()
+        if isinstance(member_chaos, faults.ChaosJitterSpec):
+            reps = self.compiled.services.replicas_by_name()
+            E = len(self._chaos_events)
+            member_events = [
+                faults.jitter_chaos_events(
+                    self._chaos_events, member_chaos,
+                    faults.member_event_seeds(member_chaos, s, E),
+                    reps,
+                )
+                for s in seeds
+            ]
+        else:
+            member_events = [tuple(evts) for evts in member_chaos]
+            if len(member_events) != len(seeds):
+                raise ValueError(
+                    f"member_chaos has {len(member_events)} schedules "
+                    f"for {len(seeds)} members"
+                )
+        planners, fx = compile_chaos_members(
+            self, member_events, with_pol=with_pol
+        )
+        return member_events, planners, fx
+
     def _ensemble_member_fn(self, block: int, num_blocks: int,
                             kind: str, connections: int, trim: bool,
-                            sat: bool, jittered: bool):
+                            sat: bool, jittered: bool,
+                            member_chaos: bool = False):
         """The ONE-member block-scan program the fleet vmaps.
 
         Body-identical to the plain ``_get_summary`` scan (same
@@ -2157,13 +2245,18 @@ class Simulator:
 
         def member_scan(key, offered_qps, pace_gap, nominal_gap,
                         win_lo, win_hi, visits_pc, phase_windows,
-                        cpu_scale, err_scale):
+                        cpu_scale, err_scale, *chaos_rows):
             telemetry.record_trace(
                 ("ensemble", self.signature[3], block, num_blocks,
-                 kind, connections, trim, sat, jittered),
+                 kind, connections, trim, sat, jittered,
+                 member_chaos),
                 tracing=isinstance(key, jax.core.Tracer),
                 requests=block * num_blocks,
                 hops=self.compiled.num_hops,
+            )
+            cfx = (
+                self._member_chaos_fx(chaos_rows)
+                if member_chaos else None
             )
 
             def body(carry, b):
@@ -2178,6 +2271,7 @@ class Simulator:
                     phase_windows=phase_windows,
                     cpu_scale=cpu_scale if jittered else None,
                     err_scale=err_scale if jittered else None,
+                    chaos_fx=cfx,
                 )
                 s = summary_mod.summarize(
                     res, None,
@@ -2197,10 +2291,34 @@ class Simulator:
 
         return member_scan
 
+    @staticmethod
+    def _member_chaos_fx(chaos_rows):
+        """ONE member's :class:`~isotope_tpu.compiler.compile.ChaosFx`
+        from the trailing positional chaos arguments of a fleet member
+        program (eff rows, outage rows[, policy downed rows])."""
+        from isotope_tpu.compiler.compile import ChaosFx
+
+        return ChaosFx(
+            eff_replicas_pc=chaos_rows[0],
+            svc_down_pc=chaos_rows[1],
+            downed_pc=chaos_rows[2] if len(chaos_rows) > 2 else None,
+        )
+
+    @staticmethod
+    def _chaos_fx_args(fx, with_pol: bool):
+        """The stacked trailing chaos arguments matching
+        :meth:`_member_chaos_fx`'s unpack order."""
+        if fx is None:
+            return ()
+        out = (fx.eff_replicas_pc, fx.svc_down_pc)
+        if with_pol:
+            out = out + (fx.downed_pc,)
+        return out
+
     def _get_ensemble(self, block: int, num_blocks: int, kind: str,
                       connections: int, trim: bool, sat: bool,
                       chunk_members: int, jittered: bool,
-                      mode: str = "vmap"):
+                      mode: str = "vmap", member_chaos: bool = False):
         """One jitted fleet program over a ``chunk_members``-wide
         member axis: ``vmap(member_scan)`` (true batch dim — the
         accelerator idiom) or ``lax.map`` over members (serial inside
@@ -2211,11 +2329,11 @@ class Simulator:
         fleet auto-chunked to the same width, reuses ONE compile
         (in-process and through the persistent XLA cache)."""
         cache_key = (block, num_blocks, kind, connections, trim, sat,
-                     chunk_members, jittered, mode)
+                     chunk_members, jittered, mode, member_chaos)
         if cache_key not in self._ensemble_fns:
             member = self._ensemble_member_fn(
                 block, num_blocks, kind, connections, trim, sat,
-                jittered,
+                jittered, member_chaos=member_chaos,
             )
             if mode == "map":
                 def fleet(*xs):
@@ -2238,7 +2356,7 @@ class Simulator:
                        member_keys=None, block_size: int = 65_536,
                        trim: bool = False,
                        fixed_point_iters: int = 3,
-                       member_qps=None) -> dict:
+                       member_qps=None, planners=None) -> dict:
         """Host-side per-member planning: stacked fleet arguments.
 
         One shared (block, num_blocks) shape serves every member (the
@@ -2255,6 +2373,12 @@ class Simulator:
         EXACT per-member value (the runner's same-shape case collapse
         packs several grid cells' fleets into one dispatch this way —
         a relative qps_scale would re-round each cell's rate).
+
+        ``planners`` (chaos fleets) supplies one host-side sibling
+        Simulator per member carrying that member's jittered chaos
+        schedule: rate solves, visit fixed points, and drain windows
+        come off the member's OWN planner, so the stacked host
+        arguments describe each member's bad day exactly.
         """
         sat = self._saturated(load)
         if sat and (spec.jittered or spec.qps_scale is not None):
@@ -2282,6 +2406,11 @@ class Simulator:
                     "member_qps cannot override a saturated -qps max "
                     "load"
                 )
+        if planners is not None and len(planners) != n_mem:
+            raise ValueError(
+                f"planners has {len(planners)} entries for {n_mem} "
+                "members"
+            )
         closed = load.kind != OPEN_LOOP
         if member_keys is None:
             if closed:
@@ -2326,9 +2455,12 @@ class Simulator:
         win_rows = []
         # seeds-only fleets share one offered rate: build each
         # distinct rate's visit/window/trim tables ONCE (the fleet's
-        # host planning must not cost O(members) table builds)
+        # host planning must not cost O(members) table builds).
+        # Per-member-chaos fleets key per member TOO — each planner's
+        # tables describe a different schedule.
         per_off: Dict[float, tuple] = {}
         for m in range(n_mem):
+            host = self if planners is None else planners[m]
             scale = float(tables.qps_scale[m])
             if member_qps is not None:
                 qps_m = float(member_qps[m])
@@ -2350,7 +2482,7 @@ class Simulator:
                     if qps_m == load.qps
                     else dataclasses.replace(load, qps=qps_m)
                 )
-                off = self.solve_closed_rate(
+                off = host.solve_closed_rate(
                     load_m, num_requests, member_keys[m],
                     fixed_point_iters,
                 )
@@ -2363,14 +2495,15 @@ class Simulator:
             offered[m] = off
             pace[m] = pc
             nominal[m] = nom
-            if off not in per_off:
-                per_off[off] = (
-                    self._vis_arg(off),
-                    self._windows_arg(off, sat),
+            cache_k = off if planners is None else (m, off)
+            if cache_k not in per_off:
+                per_off[cache_k] = (
+                    host._vis_arg(off),
+                    host._windows_arg(off, sat),
                     trim_window_bounds(num_blocks * block, off)
                     if trim else (0.0, np.inf),
                 )
-            vis_m, win_m, (lo, hi) = per_off[off]
+            vis_m, win_m, (lo, hi) = per_off[cache_k]
             vis_rows.append(vis_m)
             win_rows.append(win_m)
             if trim:
@@ -2471,6 +2604,7 @@ class Simulator:
         chunk: Optional[int] = None,
         member_keys=None,
         member_qps=None,
+        member_chaos=None,
     ):
         """Simulate a Monte Carlo fleet: N scenario variants in ONE
         jitted program per device (sim/ensemble.py).
@@ -2494,6 +2628,16 @@ class Simulator:
         per-member base keys — the runner's same-shape case collapse
         packs several grid cells' fleets into one dispatch this way.
 
+        ``member_chaos`` arms per-member chaos schedules (chaos
+        fleets): a :class:`~isotope_tpu.resilience.faults.ChaosJitterSpec`
+        jitters the base schedule's kill timing / target / magnitude
+        per member (derived from the member seeds), or an explicit
+        per-member list of ``ChaosEvent`` sequences runs exact
+        schedules (the splitting estimator's clones).  Member k with
+        the solo schedule stays bit-identical to its solo run; the
+        stacked chaos rows ride as traced arguments so the whole
+        fleet still compiles once.
+
         Returns an :class:`~isotope_tpu.sim.ensemble.EnsembleSummary`
         (per-member RunSummary stack + quantile bands + SLO-violation
         probabilities with Wilson CIs).  The per-service collector
@@ -2515,11 +2659,20 @@ class Simulator:
         faults.check("engine.run")
         self._check_lb_load(load)
         tables = compile_ensemble(spec)
+        if member_chaos is not None and self._saturated(load):
+            raise ValueError(
+                "per-member chaos does not support saturated -qps max "
+                "loads (the finite-population tables are host "
+                "constants per schedule); pace the closed loop"
+            )
+        member_events, planners, chaos_fx = self._resolve_member_chaos(
+            member_chaos, spec.seeds
+        )
         args = self._ensemble_args(
             load, num_requests, key, spec, tables,
             member_keys=member_keys, block_size=block_size, trim=trim,
             fixed_point_iters=fixed_point_iters,
-            member_qps=member_qps,
+            member_qps=member_qps, planners=planners,
         )
         n_mem = spec.members
         chunk_sz = chunk if chunk is not None else spec.chunk
@@ -2537,10 +2690,12 @@ class Simulator:
             args["block"], args["num_blocks"], args["kind"],
             args["conns"], trim, args["sat"], chunk_sz,
             tables.jittered, tables.mode,
+            member_chaos=chaos_fx is not None,
         )
         padded = self._ensemble_pad_args(
-            self._ensemble_stacked_args(args), n_mem,
-            n_chunks * chunk_sz,
+            self._ensemble_stacked_args(args)
+            + self._chaos_fx_args(chaos_fx, with_pol=False),
+            n_mem, n_chunks * chunk_sz,
         )
         parts = []
         with self._detail_ctx():
@@ -2557,6 +2712,7 @@ class Simulator:
             summaries=summaries,
             offered_qps=args["offered"],
             chunk=chunk_sz,
+            member_chaos=member_events,
         )
 
     def plan_timeline_windows(
@@ -3109,6 +3265,435 @@ class Simulator:
             )
         return self._summary_fns[cache_key]
 
+    # -- protected ensembles: chaos fleets (sim/ensemble.py) ------------
+
+    def _protected_member_fn(self, block: int, num_blocks: int,
+                             kind: str, connections: int, trim: bool,
+                             tl_plan: Tuple[int, float], roll: bool,
+                             jittered: bool, member_chaos: bool):
+        """The ONE-member PROTECTED block-scan program the fleet maps:
+        the :meth:`_get_protected` body (policy / rollout state riding
+        the scan carry next to the flight recorder) with the fleet
+        calling convention of :meth:`_ensemble_member_fn` — so a
+        seeds-only member reproduces its solo ``run_policies`` /
+        ``run_rollouts`` twin bit-for-bit, and the whole fleet batches
+        under one vmap / ``lax.map``.  No collector (per-service
+        series stay out of fleet programs) and no attribution (the
+        blame pass stays a solo follow-up — ROADMAP residual).
+
+        ``member_chaos`` appends the member's stacked chaos rows
+        (eff replicas, outage flags, policy chaos-down deltas, and the
+        recorder-window down table the autoscaler's alive-capacity
+        denominator reads) as trailing traced arguments."""
+        from isotope_tpu.metrics import timeline as timeline_mod
+        from isotope_tpu.sim import summary as summary_mod
+
+        with_pol = self._policies is not None
+        tag = "rollouts-fleet" if roll else "policies-fleet"
+        c = max(connections, 1)
+        per = block // c
+        tspec = timeline_mod.build_spec(
+            self.compiled, tl_plan[0], tl_plan[1]
+        )
+        S = self.compiled.num_services
+        W = tspec.num_windows
+        packed = self.params.packed_carries
+        if roll:
+            from isotope_tpu.sim import rollout as rollout_mod
+
+            rdtab = rollout_mod.device_tables(self._rollouts)
+        if with_pol:
+            from isotope_tpu.sim import policies as policies_mod
+
+            pdtab = policies_mod.device_tables(self._policies)
+            downed_w_const = self._policy_downed_windows(
+                tspec, base_split=roll
+            )
+            stuck = faults.stuck_breaker()
+            lag = faults.autoscaler_lag()
+            retry_mask = jnp.asarray(self.compiled.hop_attempt > 0)
+
+        def member_scan(key, offered_qps, pace_gap, nominal_gap,
+                        win_lo, win_hi, visits_pc, phase_windows,
+                        cpu_scale, err_scale, *chaos_rows):
+            telemetry.record_trace(
+                (tag, self.signature[3], block, num_blocks, kind,
+                 connections, trim, tl_plan, with_pol, jittered,
+                 member_chaos),
+                tracing=isinstance(key, jax.core.Tracer),
+                requests=block, hops=self.compiled.num_hops,
+            )
+            if member_chaos:
+                cfx = self._member_chaos_fx(chaos_rows)
+                downed_w = chaos_rows[3] if with_pol else None
+            else:
+                cfx = None
+                downed_w = downed_w_const if with_pol else None
+
+            def body(carry, b):
+                ((t0, conn_t0, req_off), tl_acc, robs_acc,
+                 rstate, roll_acc, pobs_acc, pstate, pol_acc) = carry
+                rfx = rollout_mod.effects(rstate) if roll else None
+                pfx = (
+                    policies_mod.effects(pstate)
+                    if with_pol else None
+                )
+                kb = jax.random.fold_in(key, 1_000_000 + b)
+                res, t_end, conn_end = self._simulate_core(
+                    block, kind, connections, kb, offered_qps,
+                    pace_gap, offered_qps, nominal_gap, t0,
+                    conn_t0, req_off,
+                    visits_pc=visits_pc,
+                    phase_windows=phase_windows,
+                    policy_fx=pfx,
+                    rollout_fx=rfx,
+                    cpu_scale=cpu_scale if jittered else None,
+                    err_scale=err_scale if jittered else None,
+                    chaos_fx=cfx,
+                )
+                s = summary_mod.summarize(
+                    res, None,
+                    window=(win_lo, win_hi) if trim else None,
+                )
+                tl_acc = timeline_mod.accumulate(
+                    tl_acc,
+                    timeline_mod.timeline_block(
+                        res, tspec, packed=packed
+                    ),
+                )
+                t_done = (
+                    jnp.min(conn_end)
+                    if kind == CLOSED_LOOP
+                    else t_end
+                )
+                if roll:
+                    robs_acc = (
+                        robs_acc
+                        + rollout_mod.observe_block(res, tspec)
+                    )
+                    rstate, rdelta = rollout_mod.advance(
+                        rstate, rdtab, robs_acc, t_done, tspec
+                    )
+                    roll_acc = rollout_mod.accumulate_summary(
+                        roll_acc, rdelta
+                    )
+                if with_pol:
+                    pobs_acc = (
+                        pobs_acc
+                        + policies_mod.observe_block(
+                            res, tspec, retry_mask
+                        )
+                    )
+                    pstate, pdelta = policies_mod.advance(
+                        pstate, pdtab, tl_acc, pobs_acc, t_done,
+                        tspec, stuck_breaker=stuck,
+                        downed_w=downed_w,
+                    )
+                    pol_acc = policies_mod.accumulate_summary(
+                        pol_acc, pdelta
+                    )
+                return (
+                    (t_end, conn_end, req_off + per),
+                    tl_acc, robs_acc, rstate, roll_acc,
+                    pobs_acc, pstate, pol_acc,
+                ), s
+
+            carry0 = (
+                (
+                    jnp.float32(0.0),
+                    jnp.zeros((c,), jnp.float32),
+                    jnp.float32(0.0),
+                ),
+                timeline_mod.zeros_summary(tspec, packed=packed),
+                jnp.zeros((S, 2, W, 4)) if roll else None,
+                rollout_mod.init_state(rdtab) if roll else None,
+                (
+                    rollout_mod.zeros_summary(tspec, S)
+                    if roll else None
+                ),
+                jnp.zeros((S, W)) if with_pol else None,
+                (
+                    policies_mod.init_state(pdtab, lag_periods=lag)
+                    if with_pol else None
+                ),
+                (
+                    policies_mod.zeros_summary(tspec, S)
+                    if with_pol else None
+                ),
+            )
+            (
+                (_, tl_final, robs_final, _, roll_final, _, _,
+                 pol_final),
+                ys,
+            ) = jax.lax.scan(body, carry0, jnp.arange(num_blocks))
+            if roll:
+                roll_final = rollout_mod.attach_observations(
+                    roll_final, robs_final
+                )
+            out = (summary_mod.reduce_stacked(ys), tl_final)
+            if roll:
+                out = out + (roll_final,)
+            if with_pol:
+                out = out + (pol_final,)
+            return out
+
+        return member_scan
+
+    def _get_protected_ensemble(self, block: int, num_blocks: int,
+                                kind: str, connections: int,
+                                trim: bool, tl_plan: Tuple[int, float],
+                                roll: bool, chunk_members: int,
+                                jittered: bool, mode: str,
+                                member_chaos: bool):
+        """One jitted PROTECTED fleet program over a
+        ``chunk_members``-wide member axis (the :meth:`_get_ensemble`
+        batching applied to the protected member scan).  The control
+        state is per member — each member's breakers / budgets / HPA /
+        rollout controller react to ITS OWN bad day — which is exactly
+        why the stacked carry batches for free under vmap."""
+        cache_key = ("prot-ens", block, num_blocks, kind, connections,
+                     trim, tl_plan, roll, chunk_members, jittered,
+                     mode, member_chaos)
+        if cache_key not in self._ensemble_fns:
+            member = self._protected_member_fn(
+                block, num_blocks, kind, connections, trim, tl_plan,
+                roll, jittered, member_chaos,
+            )
+            if mode == "map":
+                def fleet(*xs):
+                    return jax.lax.map(lambda t: member(*t), xs)
+            else:
+                fleet = jax.vmap(member)
+            self._ensemble_fns[cache_key] = (
+                executable_cache.get_or_build(
+                    ("ensemble", self.signature) + cache_key,
+                    lambda: telemetry.time_first_call(
+                        jax.jit(fleet),
+                        "compile.jit_first_call",
+                    ),
+                )
+            )
+        return self._ensemble_fns[cache_key]
+
+    def protected_ensemble_chunk(self, members: int, block: int,
+                                 tl_plan: Tuple[int, float],
+                                 roll: bool) -> int:
+        """The protected fleet's auto member-chunk: the plain fleet's
+        capacity split (:meth:`ensemble_chunk_size`) extended with the
+        stacked per-member control carry — timeline accumulator plus
+        policy / rollout state and series — the VET-T025 accounting."""
+        from isotope_tpu.analysis import costmodel
+
+        cap = costmodel.device_capacity_bytes()
+        est = costmodel.estimate_run(self, block)
+        carry = costmodel.protected_carry_bytes(
+            self, tl_plan[0], roll=roll,
+        )
+        return costmodel.ensemble_chunk(
+            members, est.peak_bytes_at_block, cap,
+            carry_bytes_per_member=carry,
+        )
+
+    def run_policies_ensemble(
+        self,
+        load: LoadModel,
+        num_requests: int,
+        key: jax.Array,
+        spec=None,  # Optional[ensemble.EnsembleSpec]
+        *,
+        block_size: int = 65_536,
+        trim: bool = False,
+        window_s: Optional[float] = None,
+        fixed_point_iters: int = 3,
+        chunk: Optional[int] = None,
+        member_keys=None,
+        member_qps=None,
+        member_chaos=None,
+    ):
+        """A Monte Carlo fleet of PROTECTED runs: N members of
+        :meth:`run_policies` behind one jitted program per device —
+        each member's policy control loops (breakers, retry budgets,
+        HPA) ride its own scan carry and react to its own streams
+        (and, under ``member_chaos``, its own jittered failure
+        schedule).  A seeds-only member is bit-identical to the solo
+        ``run_policies`` with its folded key (pinned).
+
+        Returns an :class:`~isotope_tpu.sim.ensemble.EnsembleSummary`
+        with the per-member ``TimelineSummary`` and ``PolicySummary``
+        stacks attached (``timelines`` / ``policies``), severity
+        ranking, and the worst-member postmortem accessors."""
+        if self._policies is None:
+            raise ValueError(
+                "policy fleets need compiled policy tables "
+                "(Simulator(..., policies=...))"
+            )
+        if not self.params.timeline:
+            raise ValueError(
+                "policy fleets need SimParams(timeline=True) — the "
+                "flight recorder is the control loop's observation side"
+            )
+        faults.check("policies.stuck_breaker")
+        faults.check("policies.autoscaler_lag")
+        return self._run_protected_ensemble(
+            load, num_requests, key, spec, roll=False,
+            block_size=block_size, trim=trim, window_s=window_s,
+            fixed_point_iters=fixed_point_iters, chunk=chunk,
+            member_keys=member_keys, member_qps=member_qps,
+            member_chaos=member_chaos,
+        )
+
+    def run_rollouts_ensemble(
+        self,
+        load: LoadModel,
+        num_requests: int,
+        key: jax.Array,
+        spec=None,
+        *,
+        block_size: int = 65_536,
+        trim: bool = False,
+        window_s: Optional[float] = None,
+        fixed_point_iters: int = 3,
+        chunk: Optional[int] = None,
+        member_keys=None,
+        member_qps=None,
+        member_chaos=None,
+    ):
+        """A Monte Carlo fleet of :meth:`run_rollouts` runs — the
+        progressive-delivery controller advanced per member in the
+        stacked scan carry (plus the PR 9 policy loops when policy
+        tables are also compiled).  ``member_chaos`` is rejected here
+        (the canary-first kill-split tables are trace constants —
+        ROADMAP residual); seeds-only and physics-jittered fleets run.
+        """
+        if self._rollouts is None:
+            raise ValueError(
+                "rollout fleets need compiled rollout tables "
+                "(Simulator(..., rollouts=...))"
+            )
+        if not self.params.timeline:
+            raise ValueError(
+                "rollout fleets need SimParams(timeline=True) — the "
+                "flight recorder is the control loop's observation side"
+            )
+        if self._policies is not None:
+            faults.check("policies.stuck_breaker")
+            faults.check("policies.autoscaler_lag")
+        return self._run_protected_ensemble(
+            load, num_requests, key, spec, roll=True,
+            block_size=block_size, trim=trim, window_s=window_s,
+            fixed_point_iters=fixed_point_iters, chunk=chunk,
+            member_keys=member_keys, member_qps=member_qps,
+            member_chaos=member_chaos,
+        )
+
+    def _run_protected_ensemble(self, load, num_requests, key, spec,
+                                *, roll: bool, block_size: int,
+                                trim: bool, window_s: Optional[float],
+                                fixed_point_iters: int,
+                                chunk: Optional[int], member_keys,
+                                member_qps, member_chaos):
+        """Shared tail of the protected fleet runners — the
+        :meth:`run_ensemble` planning/dispatch pipeline over the
+        protected member program."""
+        from isotope_tpu.compiler.compile import compile_ensemble
+        from isotope_tpu.metrics import timeline as timeline_mod
+        from isotope_tpu.sim import ensemble as ens_mod
+
+        if spec is None:
+            if self.params.ensemble <= 0:
+                raise ValueError(
+                    "protected fleets need an EnsembleSpec (or "
+                    "SimParams.ensemble > 0 for the seeds-only "
+                    "default fleet)"
+                )
+            spec = ens_mod.EnsembleSpec.of(self.params.ensemble)
+        spec.check(allow_duplicate_seeds=member_keys is not None)
+        if self._saturated(load):
+            raise ValueError(
+                "protected fleets do not support saturated -qps max "
+                "loads (static finite-population tables; see "
+                "run_policies)"
+            )
+        faults.check("engine.run")
+        self._check_lb_load(load)
+        tables = compile_ensemble(spec)
+        member_events, planners, chaos_fx = self._resolve_member_chaos(
+            member_chaos, spec.seeds, with_pol=True
+        )
+        args = self._ensemble_args(
+            load, num_requests, key, spec, tables,
+            member_keys=member_keys, block_size=block_size, trim=trim,
+            fixed_point_iters=fixed_point_iters,
+            member_qps=member_qps, planners=planners,
+        )
+        n_mem = spec.members
+        tl_plan = self.plan_timeline_windows(
+            args["num_blocks"] * args["block"],
+            float(args["offered"][0]), window_s,
+        )
+        chaos_args = self._chaos_fx_args(chaos_fx, with_pol=True)
+        if chaos_fx is not None:
+            # the recorder-window chaos-down table the autoscaler's
+            # alive-capacity denominator reads, per member
+            tspec = timeline_mod.build_spec(
+                self.compiled, tl_plan[0], tl_plan[1]
+            )
+            chaos_args = chaos_args + (jnp.stack([
+                pl._policy_downed_windows(tspec, base_split=roll)
+                for pl in planners
+            ]),)
+        chunk_sz = chunk if chunk is not None else spec.chunk
+        if chunk_sz is None:
+            chunk_sz = self.protected_ensemble_chunk(
+                n_mem, args["block"], tl_plan, roll
+            )
+        chunk_sz = max(1, min(int(chunk_sz), n_mem))
+        n_chunks = -(-n_mem // chunk_sz)
+        telemetry.counter_inc(
+            "rollout_fleet_runs" if roll else "policy_fleet_runs"
+        )
+        telemetry.gauge_set("ensemble_members", n_mem)
+        telemetry.gauge_set("ensemble_chunk", chunk_sz)
+        telemetry.gauge_set("engine_block_requests", args["block"])
+        telemetry.gauge_set("engine_num_blocks", args["num_blocks"])
+        telemetry.set_meta("ensemble_mode", tables.mode)
+        fn = self._get_protected_ensemble(
+            args["block"], args["num_blocks"], args["kind"],
+            args["conns"], trim, tl_plan, roll, chunk_sz,
+            tables.jittered, tables.mode, chaos_fx is not None,
+        )
+        padded = self._ensemble_pad_args(
+            self._ensemble_stacked_args(args) + chaos_args,
+            n_mem, n_chunks * chunk_sz,
+        )
+        parts = []
+        with self._detail_ctx():
+            for ci in range(n_chunks):
+                sl = slice(ci * chunk_sz, (ci + 1) * chunk_sz)
+                parts.append(fn(*(x[sl] for x in padded)))
+                if n_chunks > 1:
+                    jax.block_until_ready(parts[-1][0].count)
+        out = self._ensemble_concat(parts, n_mem)
+        # unpack by construction (the _get_protected ordering):
+        # roll -> (summary, tl, roll[, pol]); policies-only ->
+        # (summary, tl, pol)
+        summary, tl = out[0], out[1]
+        rest = list(out[2:])
+        roll_stack = rest.pop(0) if roll else None
+        pol_stack = (
+            rest.pop(0) if self._policies is not None else None
+        )
+        return ens_mod.EnsembleSummary(
+            spec=spec,
+            summaries=summary,
+            offered_qps=args["offered"],
+            chunk=chunk_sz,
+            member_chaos=member_events,
+            timelines=tl,
+            policies=pol_stack,
+            rollouts=roll_stack,
+        )
+
     def _attribution_tables(self):
         """Blame-sweep index tables (metrics/attribution.py), built
         lazily — a Simulator that never runs attributed pays nothing."""
@@ -3581,6 +4166,7 @@ class Simulator:
         rollout_fx=None,  # Optional[rollout.RolloutFx]
         cpu_scale: Optional[jax.Array] = None,
         err_scale: Optional[jax.Array] = None,
+        chaos_fx=None,  # Optional[compile.ChaosFx] (ONE member's rows)
     ) -> Tuple[SimResults, jax.Array, jax.Array]:
         """``offered_qps`` drives the queueing model (the rate the whole
         fleet of services sees); ``arrival_qps`` paces this batch's
@@ -3606,7 +4192,17 @@ class Simulator:
         every station's mu inside the wait law (canary arm included);
         ``err_scale`` multiplies the per-hop error rates (clipped to
         [0, 1]).  ``None`` (every solo entry point) leaves the traced
-        program byte-identical to the pre-ensemble one."""
+        program byte-identical to the pre-ensemble one.
+
+        ``chaos_fx`` (chaos fleets, compiler/compile.ChaosFx) swaps
+        the trace-constant chaos phase tables — effective replicas,
+        outage flags, policy chaos-down deltas — for ONE member's
+        traced rows, so every fleet member survives its own jittered
+        failure schedule under one compiled program.  Combinations
+        whose chaos tables stay host constants (ungraceful kills,
+        rollout canary-split tables, lb panic pools, saturated
+        finite-population tables) are rejected at the fleet entry
+        points, not here."""
         H = self.compiled.num_hops
         telemetry.fence_reset()
         any_copula = self._copula_active or self._retry_active
@@ -3843,7 +4439,11 @@ class Simulator:
         if visits_pc is None:
             visits_pc = self._visits_pc
         lam_pc = offered_qps * visits_pc
-        eff_replicas_pc = self._eff_replicas_pc
+        eff_replicas_pc = (
+            self._eff_replicas_pc
+            if chaos_fx is None
+            else chaos_fx.eff_replicas_pc
+        )
         if policy_fx is not None:
             pol = self._policies
             if pol.any_breaker:
@@ -3858,11 +4458,14 @@ class Simulator:
                 # Under a rollout the kill takes CANARY replicas first,
                 # so the HPA-scaled BASELINE arm only absorbs the
                 # remainder of the delta.
-                downed = (
-                    self._downed_base_pc
-                    if rollout_fx is not None and self.has_chaos
-                    else self._downed_pc
-                )
+                if chaos_fx is not None:
+                    downed = chaos_fx.downed_pc
+                else:
+                    downed = (
+                        self._downed_base_pc
+                        if rollout_fx is not None and self.has_chaos
+                        else self._downed_pc
+                    )
                 eff_replicas_pc = jnp.maximum(
                     policy_fx.replicas[None, :] - downed, 1.0
                 ).astype(jnp.int32)
@@ -3957,7 +4560,11 @@ class Simulator:
                     self._can_reps_pc,
                     self._k_max,
                 )
-        svc_down_pc = self._svc_down_pc
+        svc_down_pc = (
+            self._svc_down_pc
+            if chaos_fx is None
+            else chaos_fx.svc_down_pc
+        )
         if rollout_fx is not None and self.has_chaos:
             # baseline-arm outage flags (canary downs selected per hop
             # below); utilization reporting follows the baseline arm

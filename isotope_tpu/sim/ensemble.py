@@ -34,7 +34,12 @@ from typing import Optional, Sequence, Tuple
 import numpy as np
 
 #: artifact schema tag (runner/run.py writes ``<label>.ensemble.json``)
-DOC_SCHEMA = "isotope-ensemble/v1"
+#: — v2 adds the schema-versioned ``splitting`` block (importance
+#: splitting, sim/splitting.py), the protected-fleet severity/worst-
+#: member block, and the per-member chaos marker; v1 documents remain
+#: readable (see :func:`doc_member_quantiles`)
+DOC_SCHEMA = "isotope-ensemble/v2"
+DOC_SCHEMAS = ("isotope-ensemble/v1", "isotope-ensemble/v2")
 
 #: quantiles reported per member in the artifact / tables
 DOC_QUANTILES = (0.5, 0.9, 0.99)
@@ -335,13 +340,62 @@ class EnsembleSummary:
     summaries: object  # RunSummary with (N,)-leading leaves
     offered_qps: np.ndarray  # (N,) per-member offered rate actually run
     chunk: int               # members per device dispatch actually used
+    # -- chaos fleets (PR 15) -------------------------------------------
+    # per-member jittered ChaosEvent tuples (None = every member ran
+    # the base schedule); protected fleets additionally stack the
+    # flight-recorder timelines and the policy / rollout actuation
+    # series per member (None on plain fleets)
+    member_chaos: Optional[list] = None
+    timelines: Optional[object] = None   # TimelineSummary, (N,)-leading
+    policies: Optional[object] = None    # PolicySummary, (N,)-leading
+    rollouts: Optional[object] = None    # RolloutSummary, (N,)-leading
 
     @property
     def members(self) -> int:
         return self.spec.members
 
+    @property
+    def protected(self) -> bool:
+        return self.policies is not None or self.rollouts is not None
+
     def member(self, k: int):
         return member_summary(self.summaries, k)
+
+    def member_timeline(self, k: int):
+        if self.timelines is None:
+            raise ValueError("this fleet carried no timelines")
+        return member_summary(self.timelines, k)
+
+    def member_policies(self, k: int):
+        if self.policies is None:
+            raise ValueError("this fleet carried no policy series")
+        return member_summary(self.policies, k)
+
+    def member_rollouts(self, k: int):
+        if self.rollouts is None:
+            raise ValueError("this fleet carried no rollout series")
+        return member_summary(self.rollouts, k)
+
+    def severity(self, mode: str = "err_peak",
+                 slo_s: Optional[float] = None) -> np.ndarray:
+        """(N,) per-member severity scores (sim/splitting.py): the
+        statistic fleets are ranked by — peak per-window client error
+        share when the recorder rode the fleet, run-long error share
+        otherwise, or SLO-violation depth (``p99``)."""
+        from isotope_tpu.sim.splitting import (
+            SplitSpec,
+            severity_scores,
+        )
+
+        spec = SplitSpec(severity=mode, slo_s=slo_s)
+        return severity_scores(spec, self.summaries, self.timelines)
+
+    def worst_member(self, mode: str = "err_peak",
+                     slo_s: Optional[float] = None) -> int:
+        """The most-severe member — the fleet's postmortem subject
+        (the runner dumps its policies/rollout/timeline artifacts
+        with a member + seed stamp so the bad day replays solo)."""
+        return int(np.argmax(self.severity(mode, slo_s)))
 
     def member_quantiles(self, qs=DOC_QUANTILES, window: bool = True
                          ) -> np.ndarray:
@@ -382,14 +436,21 @@ class EnsembleSummary:
         }
 
     def slo_violation(self, slo_s: float, quantile: float = 0.99,
-                      confidence: float = 0.95) -> dict:
+                      confidence: float = 0.95,
+                      splitting: Optional[dict] = None) -> dict:
         """P(member's latency quantile exceeds ``slo_s``) with a
-        Wilson confidence interval over the member count."""
+        Wilson confidence interval over the member count.
+
+        At ZERO observed violations the Wilson interval degenerates
+        to ``[0, upper]`` — the exact regime importance splitting
+        exists for — so when a ``splitting`` block
+        (sim/splitting.py) is available its estimate is reported
+        alongside instead of leaving only the one-sided bound."""
         per_member = self.member_quantiles((quantile,))[:, 0]
         n = self.members
         k = int((per_member > float(slo_s)).sum())
         lo, hi = wilson_interval(k, n, confidence)
-        return {
+        out = {
             "slo_s": float(slo_s),
             "quantile": float(quantile),
             "members": int(n),
@@ -399,6 +460,18 @@ class EnsembleSummary:
             "ci_lo": lo,
             "ci_hi": hi,
         }
+        if k == 0 and splitting is not None:
+            out["p_splitting"] = float(splitting.get("p", 0.0))
+            out["splitting_ci"] = [
+                float(splitting.get("ci_lo", 0.0)),
+                float(splitting.get("ci_hi", hi)),
+            ]
+            out["note"] = (
+                "zero observed violations: the Wilson interval is "
+                "one-sided; p_splitting is the importance-splitting "
+                "estimate of the tail"
+            )
+        return out
 
     def error_rate_stats(self) -> dict:
         """Across-member client error-share distribution."""
@@ -420,8 +493,15 @@ class EnsembleSummary:
 
     def to_doc(self, label: str = "",
                slo_s: Optional[float] = None,
-               qs: Sequence[float] = DOC_QUANTILES) -> dict:
-        """The ``isotope-ensemble/v1`` artifact document."""
+               qs: Sequence[float] = DOC_QUANTILES,
+               splitting: Optional[dict] = None) -> dict:
+        """The ``isotope-ensemble/v2`` artifact document.
+
+        ``splitting`` attaches a rare-event estimate block
+        (``isotope-splitting/v1``, sim/splitting.py) behind the
+        schema-versioned ``splitting`` key; protected fleets
+        additionally record per-member severity and the worst
+        member's identity (the postmortem pointer)."""
         mq = self.member_quantiles(qs)
         counts = np.asarray(self.summaries.count, np.float64)
         errs = np.asarray(self.summaries.error_count, np.float64)
@@ -443,15 +523,29 @@ class EnsembleSummary:
             "quantile_band_p99": self.quantile_band(0.99),
             "error_share": self.error_rate_stats(),
         }
+        if self.protected or self.timelines is not None:
+            sev = self.severity()
+            worst = int(np.argmax(sev))
+            doc["protected"] = self.protected
+            doc["severity"] = [float(x) for x in sev]
+            doc["worst_member"] = worst
+            # valid for fold_in-derived fleets; callers that supplied
+            # explicit member_keys (the runner's control member 0)
+            # must override this with their own key recipe
+            doc["worst_member_seed"] = int(self.spec.seeds[worst])
+        if self.member_chaos is not None:
+            doc["member_chaos"] = True
         if slo_s is not None:
-            doc["slo"] = self.slo_violation(slo_s)
+            doc["slo"] = self.slo_violation(slo_s, splitting=splitting)
+        if splitting is not None:
+            doc["splitting"] = splitting
         return doc
 
 
 def doc_member_quantiles(doc: dict) -> np.ndarray:
     """Round-trip reader: the (N, Q) per-member quantile table out of
-    an ``isotope-ensemble/v1`` document (runner artifact)."""
-    if doc.get("schema") != DOC_SCHEMA:
+    an ``isotope-ensemble/v1`` or ``v2`` document (runner artifact)."""
+    if doc.get("schema") not in DOC_SCHEMAS:
         raise ValueError(
             f"not an {DOC_SCHEMA} document: {doc.get('schema')!r}"
         )

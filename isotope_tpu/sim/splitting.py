@@ -1,0 +1,333 @@
+"""Importance splitting: rare-outage probability estimation.
+
+Plain Monte Carlo cannot resolve the 1-in-10^5 outage tails resilience
+engineering cares about — a 32-member fleet of a p = 1e-4 event sees
+zero violations almost always, and the Wilson interval degenerates to
+``[0, upper]``.  This module implements a multilevel splitting /
+RESTART-style estimator (Au & Beck subset simulation) over the fleet's
+RNG: run a short-horizon fleet, rank members by a severity statistic
+from the recorder windows, clone-and-continue the worst quantile with
+re-folded keys across K levels — one fleet dispatch (one jitted
+program) per level — and combine the level conditionals into a
+rare-event probability with a variance estimate.
+
+The randomness of a fleet member decomposes into independent
+components the proposal kernel can resample separately:
+
+- the CHAOS seeds — ONE PER CHAOS EVENT, driving that event's
+  jittered timing / target / magnitude (resilience/faults.py
+  ``ChaosJitterSpec``), the components that usually *cause* an
+  outage;
+- the WORK seed — the workload RNG (arrival gaps, error coins, wait
+  draws).
+
+Each level ``l`` conditions on ``severity >= T_l`` (the survivor
+quantile of the previous level).  Survivors seed one
+Metropolis-with-prior-proposal step per clone: the proposal redraws
+each chaos component independently with probability ``chaos_prob``
+and the work seed with probability ``work_prob``; it is accepted iff
+its severity clears ``T_l``, otherwise the clone keeps its parent's
+draw.  Because the proposal IS the prior restricted component-wise,
+the acceptance test alone leaves the conditional distribution
+invariant — no likelihood ratios needed.  Mixing depends on the
+component COUNT (a one-component chain can only jump or stay, and a
+population of stuck chains biases the level quantiles); per-event
+chaos seeds are what make the kernel local enough to climb.
+
+The product estimator ``p = prod_l p_l * p_final`` is consistent; the
+reported variance uses the independence approximation
+``cv^2 ~= sum_l (1 - p_l) / (p_l N)`` (it understates the true
+variance when chains correlate — stated, like every CPU-era constant
+in this repo).  A COMMON event (p >= keep at level 0) short-circuits
+to the plain Monte Carlo estimate, so the splitting path never does
+worse than the fleet it started from.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, Optional
+
+import numpy as np
+
+from isotope_tpu.sim.ensemble import wilson_interval
+
+#: the splitting block's schema key inside ``<label>.ensemble.json``
+#: (isotope-ensemble/v2)
+SPLIT_SCHEMA = "isotope-splitting/v1"
+
+#: severity statistics the estimator can rank members by
+SEVERITIES = ("err_peak", "err_share", "p99")
+
+
+@dataclasses.dataclass(frozen=True)
+class SplitSpec:
+    """One splitting estimate's configuration.
+
+    ``threshold`` defines the rare event (``severity >= threshold``);
+    ``keep`` is the survivor fraction per level (the level quantile is
+    ``1 - keep``); ``members`` is the fleet width per level, so the
+    total simulation budget is at most ``levels * members`` member
+    runs; ``horizon`` scales the per-member request count (splitting
+    fleets are screening fleets — a short horizon ranks severity
+    almost as well as the full run at a fraction of the cost);
+    ``chaos_prob`` / ``work_prob`` are the proposal's per-component
+    redraw probabilities.
+    """
+
+    levels: int = 4
+    members: int = 64
+    keep: float = 0.25
+    threshold: float = 0.5
+    severity: str = "err_peak"
+    horizon: float = 0.25
+    slo_s: Optional[float] = None   # the p99 severity's latency unit
+    chaos_prob: float = 0.5
+    work_prob: float = 0.5
+    seed: int = 0
+
+    def __post_init__(self):
+        if self.levels < 1:
+            raise ValueError("splitting levels must be >= 1")
+        if self.members < 2:
+            raise ValueError("splitting members must be >= 2")
+        if not 0.0 < self.keep < 1.0:
+            raise ValueError(
+                "splitting keep (survivor fraction) must lie in (0, 1)"
+            )
+        if self.severity not in SEVERITIES:
+            raise ValueError(
+                f"unknown splitting severity {self.severity!r} "
+                f"(one of {SEVERITIES})"
+            )
+        if not 0.0 < self.horizon <= 1.0:
+            raise ValueError("splitting horizon must lie in (0, 1]")
+        if not 0.0 <= self.chaos_prob <= 1.0:
+            raise ValueError("splitting chaos_prob must lie in [0, 1]")
+        if not 0.0 <= self.work_prob <= 1.0:
+            raise ValueError("splitting work_prob must lie in [0, 1]")
+
+    @property
+    def budget(self) -> int:
+        """The worst-case member-run budget of one estimate."""
+        return self.levels * self.members
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+def parse_split_spec(text: Optional[str]) -> Optional[SplitSpec]:
+    """Parse the CLI/TOML spec, e.g.
+    ``"levels=4,members=64,keep=0.25,threshold=0.5,sev=err_peak"``.
+    ``"off"`` / empty returns None.  Unknown keys are errors — a
+    typo'd knob must not silently run the defaults."""
+    if not text or str(text).strip().lower() in ("off", "0", "false"):
+        return None
+    kw: dict = {}
+    keys = {
+        "levels": ("levels", int),
+        "members": ("members", int),
+        "keep": ("keep", float),
+        "threshold": ("threshold", float),
+        "sev": ("severity", str),
+        "severity": ("severity", str),
+        "horizon": ("horizon", float),
+        "slo": ("slo_s", float),
+        "chaos_prob": ("chaos_prob", float),
+        "work_prob": ("work_prob", float),
+        "seed": ("seed", int),
+    }
+    for part in str(text).split(","):
+        part = part.strip()
+        if not part:
+            continue
+        if "=" not in part:
+            raise ValueError(
+                f"bad splitting spec entry {part!r} (expected "
+                f"key=value; keys: {', '.join(sorted(keys))})"
+            )
+        k, v = part.split("=", 1)
+        k = k.strip().lower()
+        if k not in keys:
+            raise ValueError(
+                f"unknown splitting spec key {k!r} (expected one of "
+                f"{', '.join(sorted(keys))})"
+            )
+        name, conv = keys[k]
+        kw[name] = conv(v.strip())
+    return SplitSpec(**kw)
+
+
+class _Draws:
+    """One level's population: chaos (N, C) + work (N,) seed arrays."""
+
+    def __init__(self, chaos: np.ndarray, work: np.ndarray):
+        self.chaos = np.asarray(chaos, np.int64)
+        self.work = np.asarray(work, np.int64)
+
+    def take(self, idx) -> "_Draws":
+        return _Draws(self.chaos[idx], self.work[idx])
+
+
+def _fresh(rng: np.random.Generator, shape) -> np.ndarray:
+    # 31-bit positive seeds: safe through jax fold_in uint32 and json
+    return rng.integers(1, 2**31 - 1, size=shape, dtype=np.int64)
+
+
+def subset_estimate(
+    evaluate: Callable[[np.ndarray, np.ndarray], np.ndarray],
+    spec: SplitSpec,
+    chaos_components: int = 1,
+) -> dict:
+    """Estimate ``P(severity >= spec.threshold)`` by subset simulation.
+
+    ``evaluate(chaos_seeds, work_seeds) -> severities`` runs one fleet
+    of ``N = spec.members`` members (``chaos_seeds`` is ``(N, C)``
+    with one column per chaos component, ``work_seeds`` ``(N,)``) and
+    returns their severity scores — ONE call per level, so the engine
+    backs it with one jitted fleet dispatch per level.  Deterministic
+    given ``spec.seed``.
+
+    Returns the ``isotope-splitting/v1`` dict: ``p`` (the estimate),
+    ``cv`` / ``ci_lo`` / ``ci_hi`` (delta-method, independence
+    approximation), per-level records, and the member-run budget
+    actually spent.
+    """
+    rng = np.random.default_rng(np.random.SeedSequence([spec.seed]))
+    N = spec.members
+    C = max(int(chaos_components), 1)
+    draws = _Draws(_fresh(rng, (N, C)), _fresh(rng, N))
+    sev = np.asarray(evaluate(draws.chaos, draws.work), np.float64)
+    if sev.shape != (N,):
+        raise ValueError(
+            f"evaluate returned shape {sev.shape}, expected ({N},)"
+        )
+    evals = N
+    levels = []
+    log_p = 0.0
+    cv2 = 0.0
+    p_final = None
+    for level in range(spec.levels):
+        above = float((sev >= spec.threshold).mean())
+        last = level == spec.levels - 1
+        # the intermediate threshold: the survivor quantile, clamped
+        # at the target — once the population reaches the event, the
+        # remaining fraction is the final conditional
+        T = float(np.quantile(sev, 1.0 - spec.keep))
+        if above >= spec.keep or T >= spec.threshold or last:
+            p_final = above
+            levels.append({
+                "level": level, "threshold": spec.threshold,
+                "p_level": above, "final": True,
+            })
+            break
+        surv = np.nonzero(sev >= T)[0]
+        if len(surv) == 0:  # degenerate population (constant severity)
+            p_final = 0.0
+            levels.append({
+                "level": level, "threshold": T,
+                "p_level": 0.0, "final": True,
+            })
+            break
+        p_l = len(surv) / N
+        levels.append({
+            "level": level, "threshold": T, "p_level": p_l,
+            "final": False,
+        })
+        log_p += float(np.log(p_l))
+        cv2 += (1.0 - p_l) / (p_l * N)
+        # clone-and-continue: survivors cycle over the N slots, each
+        # clone takes one Metropolis step with the component-wise
+        # prior proposal (re-folded keys)
+        slot = surv[np.arange(N) % len(surv)]
+        parents = draws.take(slot)
+        sev_par = sev[slot]
+        prop = _Draws(
+            np.where(
+                rng.random((N, C)) < spec.chaos_prob,
+                _fresh(rng, (N, C)), parents.chaos,
+            ),
+            np.where(
+                rng.random(N) < spec.work_prob,
+                _fresh(rng, N), parents.work,
+            ),
+        )
+        sev_prop = np.asarray(
+            evaluate(prop.chaos, prop.work), np.float64
+        )
+        evals += N
+        accept = sev_prop >= T
+        draws = _Draws(
+            np.where(accept[:, None], prop.chaos, parents.chaos),
+            np.where(accept, prop.work, parents.work),
+        )
+        sev = np.where(accept, sev_prop, sev_par)
+    assert p_final is not None
+    if p_final > 0.0:
+        p = float(np.exp(log_p) * p_final)
+        cv2 += (1.0 - p_final) / (p_final * N)
+    else:
+        p = 0.0
+    cv = float(np.sqrt(cv2)) if p > 0 else 0.0
+    # lognormal-shaped CI: multiplicative error keeps the bound
+    # positive where the rare estimate sits orders below 1
+    z = 1.959963984540054  # norm_ppf(0.975)
+    ci = (
+        (p * np.exp(-z * cv), min(1.0, p * np.exp(z * cv)))
+        if p > 0
+        else (0.0, wilson_interval(0, spec.budget)[1])
+    )
+    return {
+        "schema": SPLIT_SCHEMA,
+        "spec": spec.to_dict(),
+        "p": p,
+        "cv": cv,
+        "ci_lo": float(ci[0]),
+        "ci_hi": float(ci[1]),
+        "levels": levels,
+        "evaluations": int(evals),
+        "accept_note": (
+            "variance assumes independent level samples; correlated "
+            "clone chains understate it"
+        ),
+    }
+
+
+# -- severity statistics ------------------------------------------------------
+
+
+def severity_scores(
+    spec: SplitSpec,
+    summaries,
+    timelines=None,
+) -> np.ndarray:
+    """Per-member severity from a fleet's stacked outputs.
+
+    - ``err_peak``: the PEAK per-window client error share from the
+      recorder windows (``timelines``; the statistic that sees a
+      transient outage a run-long average dilutes); falls back to
+      ``err_share`` when no timeline rode the fleet;
+    - ``err_share``: the run-long client error share;
+    - ``p99``: the member's p99 latency in units of ``spec.slo_s``
+      (severity 1.0 == exactly at the SLO — "SLO-violation depth").
+    """
+    if spec.severity == "p99":
+        if spec.slo_s is None or spec.slo_s <= 0:
+            raise ValueError(
+                "p99 splitting severity needs slo= (the latency that "
+                "maps to severity 1.0)"
+            )
+        from isotope_tpu.metrics.histogram import quantile_from_histogram
+
+        hists = np.asarray(summaries.latency_hist, np.float64)
+        p99 = np.asarray([
+            quantile_from_histogram(h, (0.99,))[0] for h in hists
+        ])
+        return p99 / float(spec.slo_s)
+    if spec.severity == "err_peak" and timelines is not None:
+        arr = np.asarray(timelines.arrivals, np.float64)   # (N, W)
+        err = np.asarray(timelines.errors, np.float64)     # (N, W)
+        share = err / np.maximum(arr, 1.0)
+        return share.max(axis=1)
+    counts = np.asarray(summaries.count, np.float64)
+    errs = np.asarray(summaries.error_count, np.float64)
+    return errs / np.maximum(counts, 1.0)

@@ -421,8 +421,13 @@ def test_doc_round_trip(ens3):
     doc = json.loads(json.dumps(
         ens3.to_doc(label="t", slo_s=0.01)
     ))
-    assert doc["schema"] == "isotope-ensemble/v1"
+    # v2 since PR 15 (the schema-versioned splitting block); v1
+    # documents stay readable
+    assert doc["schema"] == "isotope-ensemble/v2"
     assert doc["members"] == 3
+    v1 = dict(doc, schema="isotope-ensemble/v1")
+    assert np.allclose(doc_member_quantiles(v1),
+                       ens3.member_quantiles())
     mq = doc_member_quantiles(doc)
     assert np.allclose(mq, ens3.member_quantiles())
     spec2 = EnsembleSpec.from_dict(doc["spec"])

@@ -113,7 +113,11 @@ def _cases(doc: dict, prefer_best: bool = False) -> dict:
                        "_timeline_overhead", "_mesh_layout_score",
                        "_rollout", "_lb", "_ensemble_members",
                        "_ensemble_traces", "_ensemble_solo_rate",
-                       "_ensemble_speedup")):
+                       "_ensemble_speedup",
+                       "_chaosfleet_members", "_chaosfleet_traces",
+                       "_chaosfleet_worst_severity",
+                       "_chaosfleet_split_p",
+                       "_chaosfleet_split_evals")):
             continue  # evidence / variance keys, not rates
         cases[k] = float(v)
     if prefer_best:

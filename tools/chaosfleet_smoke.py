@@ -1,0 +1,250 @@
+"""chaosfleet-smoke: the chaos-fleet acceptance story end-to-end.
+
+A retry-storm topology (entry -> worker with timeouts + retries, a
+breaker / retry-budget / HPA policy block) under a worker-kill chaos
+schedule, dispatched as a PROTECTED Monte Carlo fleet with PER-MEMBER
+kill timing/magnitude (PR 15), checked four ways:
+
+1. **Protected fleet == solo protected runs**: member k of the
+   seeds-only policy fleet must be BIT-IDENTICAL to the solo
+   ``run_policies`` with ``fold_in(key, k)`` — summary, recorder
+   windows, and policy actuation series alike.
+
+2. **Every member survives a different bad day**: under a
+   ``ChaosJitterSpec`` the members' kill windows differ (asserted on
+   the jittered schedules) and the severity statistic spreads across
+   members.
+
+3. **Splitting resolves a forced-rare outage**: a severity threshold
+   is placed so deep that the brute-force fleet sees ~no hits, then
+   the multilevel-splitting estimator (sim/splitting.py) must return
+   a NONZERO probability using <= 10% of the member budget an
+   oversampled brute-force reference needs for a stable estimate —
+   and on a COMMON event the splitting CI must overlap the
+   brute-force Wilson CI.
+
+4. **Worst-member replay**: the most-severe member's jittered
+   schedule, replayed through a solo Simulator, reproduces that
+   member's run bit-for-bit — the postmortem artifact contract.
+
+``make chaosfleet-smoke`` wires it in next to the other smokes.
+"""
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+import numpy as np
+
+TOPOLOGY = """
+services:
+- name: entry
+  isEntrypoint: true
+  numReplicas: 4
+  script:
+  - call: {service: worker, timeout: 850us, retries: 2}
+- name: worker
+  numReplicas: 4
+  errorRate: 0.5%
+policies:
+  defaults:
+    retry_budget: {budget_percent: 25%}
+  worker:
+    breaker: {max_pending: 6, max_connections: 64,
+              consecutive_errors: 5, base_ejection: 2s}
+    autoscaler: {min_replicas: 2, max_replicas: 8,
+                 target_utilization: 60%, sync_period: 1s,
+                 stabilization_window: 3s}
+"""
+
+
+def main() -> int:
+    import jax
+
+    from isotope_tpu.compiler import compile_graph, compile_policies
+    from isotope_tpu.models.graph import ServiceGraph
+    from isotope_tpu.resilience import faults
+    from isotope_tpu.sim import splitting as split_mod
+    from isotope_tpu.sim.config import ChaosEvent, LoadModel, SimParams
+    from isotope_tpu.sim.engine import Simulator
+    from isotope_tpu.sim.ensemble import EnsembleSpec, wilson_interval
+
+    g = ServiceGraph.from_yaml(TOPOLOGY)
+    compiled = compile_graph(g)
+    pol = compile_policies(g, compiled)
+    chaos = (ChaosEvent("worker", 0.5, 1.5, replicas_down=3),)
+    sim = Simulator(
+        compiled, SimParams(timeline=True), chaos=chaos, policies=pol
+    )
+    load = LoadModel(kind="open", qps=4_000.0)
+    key = jax.random.PRNGKey(0)
+    n, block, win = 8_192, 2_048, 0.25
+    members = 8
+    spec = EnsembleSpec.of(members)
+    reps = compiled.services.replicas_by_name()
+
+    # -- 1. protected fleet == solo protected runs ---------------------
+    fleet = sim.run_policies_ensemble(
+        load, n, key, spec, block_size=block, trim=True, window_s=win
+    )
+    k = 3
+    solo = sim.run_policies(
+        load, n, jax.random.fold_in(key, k), block_size=block,
+        trim=True, window_s=win,
+    )
+    assert np.array_equal(
+        np.asarray(fleet.member(k).latency_hist),
+        np.asarray(solo[0].latency_hist),
+    ), "fleet member summary != solo run_policies"
+    assert np.array_equal(
+        np.asarray(fleet.member_timeline(k).errors),
+        np.asarray(solo[1].errors),
+    ), "fleet member timeline != solo"
+    assert np.array_equal(
+        np.asarray(fleet.member_policies(k).replicas),
+        np.asarray(solo[2].replicas),
+    ), "fleet member policy series != solo"
+    print(
+        f"protected fleet: {members} members, member {k} bit-equal "
+        "to solo run_policies (summary + timeline + policy series)"
+    )
+
+    # -- 2. per-member bad days ----------------------------------------
+    jitter = faults.ChaosJitterSpec(time=0.3, magnitude=0.6, seed=7)
+    jfleet = sim.run_policies_ensemble(
+        load, n, key, spec, block_size=block, trim=True,
+        window_s=win, member_chaos=jitter,
+    )
+    starts = [evs[0].start_s for evs in jfleet.member_chaos]
+    downs = [evs[0].replicas_down for evs in jfleet.member_chaos]
+    assert len(set(round(s, 6) for s in starts)) > 1, \
+        "kill timing did not vary across members"
+    sev = jfleet.severity()
+    print(
+        f"per-member chaos: kill starts "
+        f"{min(starts):.2f}..{max(starts):.2f}s, replicas_down "
+        f"{min(downs)}..{max(downs)}, severity "
+        f"{sev.min():.4f}..{sev.max():.4f} (worst member "
+        f"{jfleet.worst_member()})"
+    )
+
+    # -- 3. splitting vs brute force -----------------------------------
+    # severity here is the RUN-LONG client error share: continuous in
+    # the jittered kill timing/magnitude, so quantile thresholds from
+    # an oversampled reference define events of known rarity
+    n_short = 2_048
+    base = jax.random.fold_in(key, 777)
+    sev_spec = split_mod.SplitSpec(severity="err_share")
+
+    def evaluate(chaos_seeds, work_seeds):
+        mkeys = [
+            jax.random.fold_in(base, int(w)) for w in work_seeds
+        ]
+        mc = [
+            faults.jitter_chaos_events(chaos, jitter, row, reps)
+            for row in np.asarray(chaos_seeds)
+        ]
+        ens = sim.run_policies_ensemble(
+            load, n_short, base, EnsembleSpec.of(len(mkeys)),
+            block_size=block, window_s=win, member_keys=mkeys,
+            member_chaos=mc,
+        )
+        return split_mod.severity_scores(
+            sev_spec, ens.summaries, ens.timelines,
+        )
+
+    # the oversampled brute-force reference: B batches place the
+    # common (p ~ 0.3) and forced-rare (p ~ 1/100) thresholds
+    rng = np.random.default_rng(99)
+    ref = np.concatenate([
+        evaluate(
+            rng.integers(1, 2**31 - 1, size=(24, 1)),
+            rng.integers(1, 2**31 - 1, size=24),
+        )
+        for _ in range(10)
+    ])
+    t_common = float(np.quantile(ref, 0.7))
+    t_rare = float(np.quantile(ref, 1.0 - 2.5 / len(ref)))
+
+    # common event: splitting CI must overlap a fresh brute-force
+    # fleet's Wilson interval
+    brute = np.concatenate([
+        evaluate(
+            rng.integers(1, 2**31 - 1, size=(24, 1)),
+            rng.integers(1, 2**31 - 1, size=24),
+        )
+        for _ in range(2)
+    ])
+    k_hits = int((brute >= t_common).sum())
+    b_lo, b_hi = wilson_interval(k_hits, len(brute))
+    sdoc = split_mod.subset_estimate(
+        evaluate,
+        split_mod.SplitSpec(
+            levels=3, members=24, keep=0.5, threshold=t_common,
+            severity="err_share", seed=1,
+        ),
+        chaos_components=1,
+    )
+    overlap = sdoc["ci_hi"] >= b_lo and b_hi >= sdoc["ci_lo"]
+    print(
+        f"common event (share >= {t_common:.4f}): brute "
+        f"{k_hits}/{len(brute)} -> [{b_lo:.3f}, {b_hi:.3f}], "
+        f"splitting p={sdoc['p']:.3f} [{sdoc['ci_lo']:.3f}, "
+        f"{sdoc['ci_hi']:.3f}] ({sdoc['evaluations']} member runs)"
+    )
+    assert overlap, "splitting CI does not overlap brute-force CI"
+
+    # forced-rare outage: the reference's extreme quantile — a
+    # 48-member brute-force fleet typically sees NOTHING past it;
+    # splitting must climb to a nonzero estimate on <= 10% of the
+    # budget a stable brute-force estimate needs (~10/p members)
+    rdoc = split_mod.subset_estimate(
+        evaluate,
+        split_mod.SplitSpec(
+            levels=4, members=24, keep=0.3, threshold=t_rare,
+            severity="err_share", seed=2, chaos_prob=0.6,
+        ),
+        chaos_components=1,
+    )
+    brute_budget_needed = (
+        10.0 / max(rdoc["p"], 1e-12) if rdoc["p"] > 0 else np.inf
+    )
+    print(
+        f"rare outage (share >= {t_rare:.4f}): splitting "
+        f"p={rdoc['p']:.2e} [{rdoc['ci_lo']:.2e}, "
+        f"{rdoc['ci_hi']:.2e}] in {rdoc['evaluations']} member runs "
+        f"(brute force would need ~{brute_budget_needed:.0f})"
+    )
+    assert rdoc["p"] > 0.0, "splitting failed to resolve the outage"
+    assert rdoc["evaluations"] <= 0.1 * brute_budget_needed, (
+        "splitting spent more than 10% of the brute-force budget"
+    )
+
+    # -- 4. worst-member replay ----------------------------------------
+    worst = jfleet.worst_member()
+    replay_sim = Simulator(
+        compiled, SimParams(timeline=True),
+        chaos=jfleet.member_chaos[worst], policies=pol,
+    )
+    replay = replay_sim.run_policies(
+        load, n, jax.random.fold_in(key, worst), block_size=block,
+        trim=True, window_s=win,
+    )
+    assert np.array_equal(
+        np.asarray(jfleet.member(worst).latency_hist),
+        np.asarray(replay[0].latency_hist),
+    ), "worst-member replay diverged"
+    print(
+        f"worst member {worst} replayed solo from its jittered "
+        "schedule: BIT-EQUAL — the postmortem artifact is executable"
+    )
+    print("chaosfleet-smoke OK")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
