@@ -9,7 +9,7 @@ DURATION ?= 120s
 .PHONY: test lint vet-smoke bench telemetry-smoke resilience-smoke \
 	attribution-smoke sparse-smoke timeline-smoke multihost-smoke \
 	policies-smoke rollout-smoke lb-smoke ensemble-smoke \
-	chaosfleet-smoke examples \
+	chaosfleet-smoke search-smoke examples \
 	canonical tree star multitier auxiliary-services star-auxiliary \
 	latency cpu_mem dot clean
 
@@ -209,6 +209,15 @@ ensemble-smoke:
 # worst member's jittered schedule replaying solo bit-for-bit
 chaosfleet-smoke:
 	$(PY) tools/chaosfleet_smoke.py
+
+# config-search end-to-end check (sim/search.py): a 16-candidate
+# successive-halving bracket over the svc-scale fan-out — the planted
+# near-zero-error candidate wins, the bracket compiles <= once per
+# rung (a repeat bracket adds zero traces), rung 0 bit-equals the
+# plain screening fleet, and the winner's carry-continued segments
+# replay the unbroken full-horizon member exactly
+search-smoke:
+	$(PY) tools/search_smoke.py
 
 examples:
 	$(PY) tools/gen_examples.py

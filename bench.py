@@ -53,7 +53,9 @@ Methodology (r5):
 """
 from __future__ import annotations
 
+import dataclasses
 import json
+import math
 import os
 import statistics
 import subprocess
@@ -67,6 +69,7 @@ CASE_ORDER = [
     "closed64",
     "svc1000",
     "ensembleN",
+    "search64",
     "svc1000_chaosfleet",
     "realistic50",
     "rollout50",
@@ -86,7 +89,7 @@ CASE_TIMEOUT_OVERRIDES = {"svc10k_cfg3_10M": 3000}
 
 
 def _rate(sim, load, num_requests, block_size, *, warm=3, iters=3,
-          trials=5, runner=None):
+          trials=5, runner=None, case=None):
     """Steady-state hop-events/s of run_summary on the current device.
 
     Returns (median, rel_spread, best, first_s, warmup_windows) over
@@ -189,8 +192,33 @@ def _rate(sim, load, num_requests, block_size, *, warm=3, iters=3,
         m = statistics.median(window)
         return (max(window) - min(window)) / m if m > 0 else 0.0
 
-    steady_thr = float(os.environ.get("BENCH_STEADY_SPREAD", "0.15"))
+    # per-case steady-state threshold (r7): $BENCH_STEADY_SPREAD_<CASE>
+    # overrides the global default — the tunneled chip's fast cases
+    # (tree121/closed64/realistic50 at 22-27% r6 spread) need a looser
+    # settle bar than the long-window ones, and a single global knob
+    # either burns the fast cases' budget or lets the slow ones drift
+    default_thr = os.environ.get("BENCH_STEADY_SPREAD", "0.15")
+    steady_thr = float(
+        os.environ.get(f"BENCH_STEADY_SPREAD_{case.upper()}",
+                       default_thr)
+        if case else default_thr
+    )
     warmup_cap = int(os.environ.get("BENCH_WARMUP_CAP", "5"))
+    # window floor (r7): sub-millisecond timed windows measure the
+    # host timer + dispatch jitter, not the engine — scale ``iters``
+    # until one window spans at least $BENCH_WINDOW_FLOOR seconds
+    # (probed with one untimed-for-stats window; rates normalize by
+    # iters so the statistic is unchanged)
+    floor_s = float(os.environ.get("BENCH_WINDOW_FLOOR", "0.2"))
+    if floor_s > 0:
+        t0 = time.perf_counter()
+        s = once(jax.random.fold_in(key, 777))
+        jax.block_until_ready(s.count)
+        probe_dt = time.perf_counter() - t0
+        if probe_dt * iters < floor_s:
+            iters = min(
+                512, max(iters, int(floor_s / max(probe_dt, 1e-6)) + 1)
+            )
     rates = []
     warmup_windows = 0
     trial = 0
@@ -346,7 +374,9 @@ def run_case(name: str) -> dict:
 
     def measure(sim, load, *args, **kw):
         case_ctx["sim"], case_ctx["load"] = sim, load
-        med, spread, best, first_s, warmup = _rate(sim, load, *args, **kw)
+        med, spread, best, first_s, warmup = _rate(
+            sim, load, *args, case=name, **kw
+        )
         case_ctx["warmup_windows"] = warmup
         return med, spread, best, first_s
 
@@ -458,6 +488,126 @@ def run_case(name: str) -> dict:
         out[f"{name}_ensemble_solo_rate"] = solo_best
         out[f"{name}_ensemble_speedup"] = round(
             med / max(solo_best, 1e-9), 3
+        )
+    elif name == "search64":
+        # on-device config search (sim/search.py): a 64-candidate
+        # successive-halving bracket over svc1000 — eta=4, 3 rungs
+        # (64 -> 16 -> 4 -> winner), growth=2 so the screening
+        # horizons double per rung (1/2/4 blocks).  The
+        # case rate is the bracket's POOLED hop-events/s (every
+        # simulated row across all rungs over its wall-clock); the
+        # evidence carries the candidate/rung counts, the engine-
+        # trace delta (one compile per rung shape — <= 3 for the
+        # whole bracket), and the rate of the SEQUENTIAL sweep that
+        # replays the same per-rung per-candidate budgets as solo
+        # run_summary dispatches (64 + 16 + 4 = 84 host round-trips,
+        # the Python screening loop the bracket replaces).  The
+        # `<case>_search_*` keys are EXCLUDED from bench_regress's
+        # rate comparison; the speedup has its own opt-in gate
+        # (BENCH_REGRESS_SEARCH_THRESHOLD).
+        from isotope_tpu.sim.ensemble import EnsembleSpec
+        from isotope_tpu.sim.search import SearchSpec, plan_bracket
+
+        with open("examples/topologies/1000-svc_2000-end.yaml") as f:
+            doc = yaml.safe_load(f)
+        sim = Simulator(compile_graph(ServiceGraph.decode(doc)))
+        cands = int(os.environ.get("BENCH_SEARCH_CANDIDATES", "64"))
+        spec = SearchSpec(
+            candidates=EnsembleSpec.from_jitter(
+                cands, qps_jitter=0.2, cpu_jitter=0.1,
+                error_jitter=0.3,
+            ),
+            eta=4, rungs=3, growth=2,
+        )
+        load_s = LoadModel(kind="open", qps=10_000.0)
+        # 4 blocks total => cumulative rung horizons 1/2/4 at
+        # growth=2; short blocks on CPU — the screening regime where
+        # dispatch overhead dominates — wider on TPU where the
+        # member axis feeds the MXU
+        b_s = 4_096 if on_tpu else 4
+        n_s = b_s * 4
+        traces0 = telemetry.counter_get("engine_traces")
+        last_srch = {}
+
+        def search_runner(s_, l_, n_, k_, b_):
+            srch = s_.run_search(l_, n_, k_, spec, block_size=b_)
+            last_srch["srch"] = srch
+            return srch.pooled()
+
+        med, spread, best, first_s = measure(
+            sim, load_s, n_s, b_s, warm=2, iters=2,
+            runner=search_runner,
+        )
+        out[f"{name}_search_candidates"] = cands
+        out[f"{name}_search_rungs"] = spec.rungs
+        out[f"{name}_search_traces"] = int(
+            telemetry.counter_get("engine_traces") - traces0
+        )
+
+        # the sequential sweep: the SAME successive-halving screen
+        # run the only way it could be before the bracket — a Python
+        # loop of solo run_summary dispatches, each candidate at its
+        # OWN jittered qps, each rung's cumulative horizon
+        # resimulated from scratch (solo runs have no carry
+        # machinery; extending a candidate means rerunning it), the
+        # rung ranked HOST-side from each candidate's summary (the
+        # severity reads are the per-candidate syncs a screening
+        # loop pays) and the top 1/eta advanced.  That is the loop
+        # the bracket replaces, and what the screen costs without it.
+        plan = plan_bracket(spec, n_s, b_s)
+        key_s = jax.random.PRNGKey(0)
+        scales = spec.candidates.qps_scale
+
+        def solo_sweep(k):
+            live = list(range(cands))
+            tot = 0.0
+            for rp in plan:
+                sev = []
+                for m in live:
+                    sc = 1.0 if scales is None else float(scales[m])
+                    load_m = dataclasses.replace(
+                        load_s, qps=load_s.qps * sc
+                    )
+                    s = sim.run_summary(
+                        load_m, rp.num_blocks * b_s,
+                        jax.random.fold_in(k, rp.rung * 1_000 + m),
+                        block_size=b_s,
+                    )
+                    tot += float(s.hop_events)
+                    sev.append((
+                        float(s.error_count)
+                        / max(float(s.count), 1.0),
+                        m,
+                    ))
+                sev.sort()
+                keep = (
+                    plan[rp.rung + 1].width
+                    if rp.rung + 1 < len(plan) else 1
+                )
+                live = [m for _, m in sev[:keep]]
+            return tot
+
+        hops_total = solo_sweep(key_s)  # warm: compiles the solo shapes
+        solo_dt = math.inf
+        for w in range(5):
+            t0 = time.perf_counter()
+            hops_total = solo_sweep(jax.random.fold_in(key_s, 900 + w))
+            solo_dt = min(solo_dt, time.perf_counter() - t0)
+        out[f"{name}_search_sequential_rate"] = hops_total / solo_dt
+
+        # speedup: wall-clock to complete the same screen (find the
+        # winner over the same per-rung candidate budgets), best-of-N
+        # on both sides so a noisy box compares floors with floors
+        br_dt = math.inf
+        for w in range(8):
+            t0 = time.perf_counter()
+            sim.run_search(
+                load_s, n_s, jax.random.fold_in(key_s, 700 + w),
+                spec, block_size=b_s,
+            )
+            br_dt = min(br_dt, time.perf_counter() - t0)
+        out[f"{name}_search_speedup"] = round(
+            solo_dt / max(br_dt, 1e-9), 3
         )
     elif name == "svc1000_chaosfleet":
         # chaos fleets (PR 15): svc1000 under a retry-storm policy
@@ -854,7 +1004,8 @@ def main() -> None:
     # CPU keeps the cheap cases: the headline tree plus the ensemble
     # fleet (its acceptance bar — >= 2x aggregate vs N sequential solo
     # dispatches with ONE compile — is a CPU-checkable claim)
-    names = CASE_ORDER if on_tpu else ["tree121", "ensembleN"]
+    names = CASE_ORDER if on_tpu else ["tree121", "ensembleN",
+                                       "search64"]
 
     extra: dict = {}
     for name in names:

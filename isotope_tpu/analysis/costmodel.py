@@ -673,6 +673,48 @@ def protected_ensemble_findings(
     )]
 
 
+def search_carry_bytes(connections: int) -> float:
+    """Per-member bytes of a search bracket's carry-I/O arguments
+    (sim/search.py): the block offset ``b0`` (i32) plus the
+    ``(t0, conn_t0, req_off)`` scan carry (f32; ``conn_t0`` holds one
+    slot per closed-loop connection)."""
+    return 4.0 * (3 + max(int(connections), 1))
+
+
+def search_findings(
+    estimate: CostEstimate,
+    widest_members: int,
+    connections: int = 0,
+) -> List[Finding]:
+    """The VET-M005 verdict: a search bracket whose WIDEST rung's
+    ``members x (peak + carry)-bytes`` exceeds the device budget.
+    WARN, never blocking: the bracket pre-computes the carry-aware
+    member chunk (``search_auto_chunk``) and splits the rung —
+    narrower rungs inherit smaller footprints, so the widest rung is
+    the only one that needs auditing."""
+    cap = estimate.capacity_bytes
+    members = int(widest_members)
+    if members <= 1 or cap is None or cap <= 0:
+        return []
+    peak = estimate.peak_bytes_at_block
+    carry = search_carry_bytes(connections)
+    budget = CAPACITY_FILL * cap
+    need = members * (peak + carry)
+    if need <= budget:
+        return []
+    chunk = ensemble_chunk(
+        members, peak, cap, carry_bytes_per_member=carry
+    )
+    return [Finding(
+        "VET-M005", SEV_WARN,
+        f"search bracket's widest rung of {members} candidates needs "
+        f"{need:.3g} B (> the {budget:.3g} B budget, "
+        f"{CAPACITY_FILL:.0%} of {cap:.3g} B capacity); the rung will "
+        f"run in member chunks of {chunk} — shrink the block or the "
+        "population to run each rung in one dispatch",
+    )]
+
+
 def memory_findings(
     estimate: CostEstimate,
     rung_names: Sequence[str] = ("scan", "half-block", "cpu-eager"),

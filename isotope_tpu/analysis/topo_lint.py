@@ -526,6 +526,85 @@ def lint_split(spec) -> List[Finding]:
     return findings
 
 
+def lint_search(spec, num_requests=None,
+                block: int = 65_536) -> List[Finding]:
+    """Search-bracket misconfiguration rules (VET-T026) over a
+    :class:`~isotope_tpu.sim.search.SearchSpec` (or its raw
+    ``[search]`` table dict).
+
+    Errors on an undecodable spec, a population too small for the
+    bracket (rung widths ``ceil(N / eta^r)`` must strictly shrink —
+    population < eta degenerates at the first halving), and — when
+    ``num_requests`` is known — a horizon schedule that fails to
+    increase between rungs (the continuation segments would be
+    empty).  Warns when the population is not a power of ``eta``
+    (non-integer survivor counts: ceil rounds rungs up, so padded
+    slots re-run candidates the severity rank already rejected) and
+    when the rank channel needs a recorder no search fleet carries
+    (``err_peak`` falls back to ``err_share``).  ``run_search``
+    raises the ERROR-grade defects loudly at run entry
+    (sim/search.py ``SearchSpec.check`` / ``plan_bracket``)."""
+    findings: List[Finding] = []
+    if spec is None:
+        return findings
+    from isotope_tpu.sim.search import SearchSpec
+
+    if isinstance(spec, dict):
+        try:
+            spec = SearchSpec.from_dict(spec)
+        except (ValueError, TypeError, KeyError) as e:
+            findings.append(Finding(
+                "VET-T026", SEV_ERROR,
+                f"undecodable search spec: {e}",
+                path="search",
+            ))
+            return findings
+    widths = spec.rung_widths()
+    if any(b >= a for a, b in zip(widths, widths[1:])):
+        findings.append(Finding(
+            "VET-T026", SEV_ERROR,
+            f"population of {spec.members} cannot support "
+            f"{spec.rungs} rungs at eta={spec.eta}: rung widths "
+            f"{widths} stop shrinking — the bracket degenerates at "
+            "the first halving (grow the population or drop rungs)",
+            path="search",
+        ))
+    else:
+        n = spec.members
+        if any(n % spec.eta ** r for r in range(spec.rungs)):
+            findings.append(Finding(
+                "VET-T026", SEV_WARN,
+                f"population {n} is not a power-of-eta multiple "
+                f"(eta={spec.eta}, widths {widths}): ceil rounds "
+                "survivor counts up and pow2 buckets pad the rungs — "
+                "some dispatch slots re-run already-rejected "
+                "candidates (harmless, but a power of eta wastes "
+                "none)",
+                path="search",
+            ))
+    if spec.rank == "err_peak":
+        findings.append(Finding(
+            "VET-T026", SEV_WARN,
+            "rank='err_peak' needs the recorder-window timelines no "
+            "search fleet carries — the bracket ranks by the run-long "
+            "'err_share' fallback (use rank='err_share' to say what "
+            "runs, or rank='p99' with slo= for tail risk)",
+            path="search.rank",
+        ))
+    if num_requests is not None and not any(
+        f.severity == SEV_ERROR for f in findings
+    ):
+        from isotope_tpu.sim.search import plan_bracket
+
+        try:
+            plan_bracket(spec, int(num_requests), int(block))
+        except ValueError as e:
+            findings.append(Finding(
+                "VET-T026", SEV_ERROR, str(e), path="search",
+            ))
+    return findings
+
+
 def lint_compiled(compiled, params=None) -> List[Finding]:
     """Shape rules needing the unrolled hop tree (VET-T007/T008).
 
@@ -788,6 +867,20 @@ def lint_config(config) -> Tuple[List[Finding], Dict[str, object]]:
         except ValueError as e:
             findings.append(Finding(
                 "VET-T023", SEV_ERROR, str(e), path="sim.ensemble",
+            ))
+
+    # VET-T026: the sweep's search bracket (degenerate population /
+    # horizon schedule / rank channel) — config-level for the same
+    # fail-before-compile reason
+    if getattr(config, "search_candidates", 0):
+        try:
+            findings.extend(lint_search(
+                config.search_spec(),
+                num_requests=config.num_requests,
+            ))
+        except ValueError as e:
+            findings.append(Finding(
+                "VET-T026", SEV_ERROR, str(e), path="search",
             ))
     return findings, graphs
 

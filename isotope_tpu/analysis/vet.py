@@ -99,6 +99,7 @@ def vet_simulator(
     ensemble=None,
     protected: bool = False,
     split_spec=None,
+    search_spec=None,
 ) -> Report:
     """Full vet of one built Simulator under one load.
 
@@ -117,7 +118,9 @@ def vet_simulator(
     (VET-T025: the stacked policy/rollout/timeline carry counts
     toward each member's footprint).  ``split_spec`` (a SplitSpec or
     its raw string) lints the importance-splitting config
-    (VET-T024).
+    (VET-T024).  ``search_spec`` (a SearchSpec or its raw ``[search]``
+    dict) lints the successive-halving bracket (VET-T026) and runs
+    the widest-rung capacity verdict (VET-M005, carry-aware).
     """
     report = Report(suppress=suppress)
     with telemetry.phase("vet.total"):
@@ -190,6 +193,29 @@ def vet_simulator(
             }
         if split_spec is not None:
             report.extend(topo_lint.lint_split(split_spec))
+        if search_spec is not None:
+            report.extend(topo_lint.lint_search(search_spec))
+            from isotope_tpu.sim.search import SearchSpec
+
+            if isinstance(search_spec, SearchSpec):
+                widths = search_spec.rung_widths()
+                conns = getattr(load, "connections", 0) or 0
+                report.extend(costmodel.search_findings(
+                    est, widths[0], connections=conns,
+                ))
+                report.meta["search"] = {
+                    "candidates": search_spec.members,
+                    "rungs": search_spec.rungs,
+                    "eta": search_spec.eta,
+                    "widths": list(widths),
+                    "chunk": costmodel.ensemble_chunk(
+                        widths[0], est.peak_bytes_at_block,
+                        est.capacity_bytes,
+                        carry_bytes_per_member=(
+                            costmodel.search_carry_bytes(conns)
+                        ),
+                    ),
+                }
         report.meta["cost"] = {
             "block_requests": est.block_requests,
             "flops_at_block": est.flops_at_block,

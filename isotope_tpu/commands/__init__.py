@@ -14,6 +14,7 @@ def register_all(sub) -> None:
     # clean error at run time from _require_jax, not a hidden subcommand.
     from isotope_tpu.commands import (
         fidelity_cmd,
+        search_cmd,
         simulate_cmd,
         suite_cmd,
         telemetry_cmd,
@@ -26,4 +27,5 @@ def register_all(sub) -> None:
     fidelity_cmd.register(sub)
     telemetry_cmd.register(sub)
     timeline_cmd.register(sub)
+    search_cmd.register(sub)
     vet_cmd.register(sub)

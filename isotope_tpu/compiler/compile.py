@@ -242,6 +242,38 @@ def compile_ensemble(spec) -> EnsembleTables:
     )
 
 
+def rung_bucket(width: int) -> int:
+    """Pad a rung's member width up to the next power of two.
+
+    Successive-halving brackets (sim/search.py) dispatch one fleet
+    program per rung *shape*; padding widths to a small bucket family
+    means a whole bracket compiles once per distinct (bucket, horizon)
+    pair and later brackets of any nearby population size reuse the
+    same executables — the VET-J004 retrace audit sees powers of two,
+    never raw survivor counts.
+    """
+    return 1 << max(int(width) - 1, 0).bit_length()
+
+
+def ensemble_take(stacked, idx):
+    """Gather survivor rows from member-stacked fleet inputs/outputs.
+
+    ``stacked`` is any pytree whose array leaves carry a leading
+    member axis (the stacked argument tuple of the vmapped fleet
+    program, its stacked RunSummary output, or a carry tuple); ``idx``
+    is a device array of member indices.  This is the rung-advancement
+    primitive of sim/search.py: a plain ``jnp.take`` per leaf, so
+    survivors move between rungs without a host round-trip and the
+    gathered rows stay bit-identical to the source rows.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    return jax.tree.map(
+        lambda x: jnp.take(x, idx, axis=0), stacked
+    )
+
+
 class ChaosFx(NamedTuple):
     """Per-member stacked chaos phase tables (chaos fleets).
 

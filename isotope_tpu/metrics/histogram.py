@@ -64,3 +64,26 @@ def quantile_from_histogram(hist: np.ndarray, qs) -> np.ndarray:
     cum = np.cumsum(hist)
     idx = np.searchsorted(cum, np.asarray(qs) * total, side="left")
     return bucket_centers()[np.minimum(idx, NUM_BUCKETS - 1)]
+
+
+def quantile_from_histogram_device(hist: jax.Array, q: float) -> jax.Array:
+    """On-device twin of :func:`quantile_from_histogram` for ONE
+    quantile over a stack of histograms ``(..., NUM_BUCKETS)``.
+
+    ``searchsorted(cum, q*total, side="left")`` is the count of cumsum
+    entries strictly below the target, so the index is a comparison
+    reduction — no per-element binary-search gathers (the same reason
+    :func:`bucket_index` avoids searchsorted).  The cumsum runs in f32
+    on device vs the host's f64, so exact bucket-edge ties may resolve
+    one bucket apart from the host answer — every device consumer
+    (sim/search.py rank channels) compares members through THIS twin,
+    so rankings stay internally consistent.  Empty histograms yield 0
+    like the host function.
+    """
+    hist = jnp.asarray(hist, jnp.float32)
+    total = hist.sum(axis=-1, keepdims=True)
+    cum = jnp.cumsum(hist, axis=-1)
+    idx = jnp.sum((cum < q * total).astype(jnp.int32), axis=-1)
+    idx = jnp.minimum(idx, NUM_BUCKETS - 1)
+    val = jnp.asarray(bucket_centers(), jnp.float32)[idx]
+    return jnp.where(total[..., 0] > 0, val, jnp.float32(0.0))

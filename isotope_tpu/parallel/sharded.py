@@ -878,6 +878,79 @@ class ShardedSimulator:
             member_chaos=member_events,
         )
 
+    # -- search brackets (sim/search.py) --------------------------------
+
+    def _get_search_fn(self, block: int, num_blocks: int, kind: str,
+                       conns: int, sat: bool, width: int, tables):
+        """Jitted shard_map of the carry-I/O member program (the
+        search-bracket twin of :meth:`_get_ensemble_fn`): 14 member-
+        sharded inputs (10 standard + b0 + the 3 carries), summary +
+        carry outputs sharded the same way.  No donation on the mesh
+        path — rounds already bound live memory and shard_map aliasing
+        is backend-dependent."""
+        axes = tuple(self.mesh.axis_names)
+        cache_key = (block, num_blocks, kind, conns, sat, width,
+                     tables.jittered, tables.mode)
+        full_key = (
+            ("sharded-search", self.sim.signature,
+             (axes,
+              tuple(int(self.mesh.shape[a]) for a in axes),
+              tuple(d.id for d in self.mesh.devices.flat)))
+            + cache_key
+        )
+        member = self.sim._ensemble_member_fn(
+            block, num_blocks, kind, conns, False, sat,
+            tables.jittered, carry_io=True,
+        )
+        if tables.mode == "map":
+            def local(*xs):
+                return jax.lax.map(lambda t: member(*t), xs)
+        else:
+            local = jax.vmap(member)
+        mapped = _shard_map(
+            local,
+            mesh=self.mesh,
+            in_specs=tuple(P(axes) for _ in range(14)),
+            out_specs=(
+                self._ensemble_out_specs(axes),
+                (P(axes), P(axes), P(axes)),
+            ),
+        )
+        return executable_cache.get_or_build(
+            full_key,
+            lambda: telemetry.time_first_call(
+                jax.jit(mapped), "compile.jit_first_call",
+            ),
+        )
+
+    def run_search(self, load, num_requests: int, key, spec, *,
+                   block_size: int = 65_536, chunk=None):
+        """The successive-halving bracket sharded over the mesh
+        (sim/search.py :func:`run_search_sharded`): rung fleets
+        distribute the member axis over the flattened device list;
+        ranking and survivor gathers are the solo path's jnp ops, so
+        the lineage is bit-identical to the solo bracket and to
+        :meth:`run_search_emulated`."""
+        from isotope_tpu.sim import search as search_mod
+
+        faults.check("sharded.compute")
+        return search_mod.run_search_sharded(
+            self, load, num_requests, key, spec,
+            block_size=block_size, chunk=chunk,
+        )
+
+    def run_search_emulated(self, load, num_requests: int, key, spec,
+                            *, block_size: int = 65_536, chunk=None):
+        """The sharded bracket's single-device twin (EmulatedMesh-
+        friendly): the same rung geometry walked serially through the
+        solo carry-I/O program."""
+        from isotope_tpu.sim import search as search_mod
+
+        return search_mod.run_search_emulated(
+            self, load, num_requests, key, spec,
+            block_size=block_size, chunk=chunk,
+        )
+
     # -- protected ensembles: chaos fleets (sim/ensemble.py) ------------
 
     @staticmethod

@@ -201,6 +201,19 @@ class ExperimentConfig:
     # lands behind `<label>.ensemble.json`'s schema-versioned
     # "splitting" key (--ensemble-split / TOML [sim] ensemble_split)
     ensemble_split: Optional[str] = None
+    # config search (sim/search.py): candidates > 0 arms a
+    # successive-halving bracket per case (TOML [search] block),
+    # writing a `<label>.search.json` isotope-search/v1 artifact with
+    # the per-rung survivor lineage and the winning candidate
+    search_candidates: int = 0
+    search_eta: int = 4
+    search_rungs: int = 3
+    search_growth: Optional[int] = None
+    search_rank: str = "err_share"
+    search_slo_s: Optional[float] = None
+    # the population's jitter spec ("qps=0.2,cpu=0.1,error=0.3[,seed=K]")
+    search_jitter: Optional[str] = None
+    search_seed: int = 0
 
     def sim_params(self) -> SimParams:
         return SimParams(
@@ -250,6 +263,32 @@ class ExperimentConfig:
 
         with config_path("sim.ensemble_split"):
             return parse_split_spec(self.ensemble_split)
+
+    def search_spec(self):
+        """The sweep's :class:`~isotope_tpu.sim.search.SearchSpec`
+        (None when the search axis is off)."""
+        if self.search_candidates <= 0:
+            return None
+        from isotope_tpu.sim.ensemble import (
+            EnsembleSpec,
+            parse_jitter_spec,
+        )
+        from isotope_tpu.sim.search import SearchSpec
+
+        with config_path("search"):
+            jitter = parse_jitter_spec(self.search_jitter)
+            pop = EnsembleSpec.from_jitter(
+                self.search_candidates, **jitter
+            )
+            return SearchSpec(
+                candidates=pop,
+                eta=self.search_eta,
+                rungs=self.search_rungs,
+                growth=self.search_growth,
+                rank=self.search_rank,
+                slo_s=self.search_slo_s,
+                seed=self.search_seed,
+            )
 
     def load_models(self):
         for conn in self.connections:
@@ -473,6 +512,7 @@ def load_toml(path) -> ExperimentConfig:
         policies=bool(sim.get("policies", False)),
         rollouts=bool(sim.get("rollouts", False)),
         **_ensemble_kwargs(sim),
+        **_search_kwargs(doc.get("search", {})),
     )
 
 
@@ -511,4 +551,60 @@ def _ensemble_kwargs(sim: dict) -> dict:
         with config_path("sim.ensemble_split"):
             parse_split_spec(str(sim["ensemble_split"]))
         out["ensemble_split"] = str(sim["ensemble_split"])
+    return out
+
+
+def _search_kwargs(search: dict) -> dict:
+    """The ``[search]`` block: ``candidates = N`` arms a
+    successive-halving bracket per case; ``eta``/``rungs``/``growth``
+    shape the bracket, ``rank`` picks the severity channel
+    (``err_share`` | ``err_peak`` | ``p99``; ``slo = "250ms"``
+    anchors p99), ``jitter`` draws the population and ``seed``
+    derives the rank tie-breaks.  Specs validate eagerly — a typo'd
+    block must fail at config load, not mid-sweep."""
+    if not search:
+        return {}
+    known = {"candidates", "eta", "rungs", "growth", "rank", "slo",
+             "jitter", "seed"}
+    unknown = sorted(set(search) - known)
+    if unknown:
+        with config_path("search"):
+            raise ValueError(
+                f"unknown [search] keys {unknown} (expected "
+                f"{sorted(known)})"
+            )
+    out: dict = {
+        "search_candidates": int(search.get("candidates", 0)),
+        "search_eta": int(search.get("eta", 4)),
+        "search_rungs": int(search.get("rungs", 3)),
+        "search_rank": str(search.get("rank", "err_share")),
+        "search_seed": int(search.get("seed", 0)),
+    }
+    if "growth" in search:
+        out["search_growth"] = int(search["growth"])
+    if "slo" in search:
+        with config_path("search.slo"):
+            out["search_slo_s"] = dur.parse_duration_seconds(
+                search["slo"]
+            )
+    if "jitter" in search:
+        from isotope_tpu.sim.ensemble import parse_jitter_spec
+
+        with config_path("search.jitter"):
+            parse_jitter_spec(str(search["jitter"]))
+        out["search_jitter"] = str(search["jitter"])
+    if out["search_candidates"] > 0:
+        from isotope_tpu.sim.ensemble import EnsembleSpec
+        from isotope_tpu.sim.search import SearchSpec
+
+        with config_path("search"):
+            SearchSpec(
+                candidates=EnsembleSpec.of(out["search_candidates"]),
+                eta=out["search_eta"],
+                rungs=out["search_rungs"],
+                growth=out.get("search_growth"),
+                rank=out["search_rank"],
+                slo_s=out.get("search_slo_s"),
+                seed=out["search_seed"],
+            ).check()
     return out

@@ -111,6 +111,11 @@ class RunResult:
     # to the solo path
     ensemble: Optional[dict] = None
     ensemble_summary: Optional[object] = None
+    # on-device config search (sim/search.py): the search.json doc
+    # (isotope-search/v1: winner config + per-rung lineage of the
+    # successive-halving bracket) ; None when the [search] block was
+    # off or the bracket dispatch fell back
+    search: Optional[dict] = None
 
 
 def _failed_window(reason: str) -> WindowSummary:
@@ -449,7 +454,7 @@ class _EnsembleGroups:
 
 def _vet_gate(mode: str, sim, topo, config, load, block, rungs,
               policy, ensemble=None, protected: bool = False,
-              split_spec=None) -> int:
+              split_spec=None, search_spec=None) -> int:
     """The ``--vet`` pre-flight: lint + audit + cost model for one case.
 
     Returns the ladder rung index the case should START on (the memory
@@ -477,6 +482,7 @@ def _vet_gate(mode: str, sim, topo, config, load, block, rungs,
         ensemble=ensemble,
         protected=protected,
         split_spec=split_spec,
+        search_spec=search_spec,
     )
     for f in report.sorted():
         print(f"vet: {f.render()}", file=sys.stderr)
@@ -1021,6 +1027,9 @@ def run_experiment(
     # scenario ensembles ([sim] ensemble / --ensemble): spec errors
     # surface here, before anything simulates
     ens_spec = config.ensemble_spec()
+    # config-search brackets ([search]): likewise fail-fast on a bad
+    # spec before any case compiles
+    search_spec_cfg = config.search_spec()
 
     # Labels are the identity of a run everywhere downstream — the
     # artifact filenames, the checkpoint restore key, the CSV rows.  A
@@ -1153,6 +1162,7 @@ def run_experiment(
                                     ensemble=ens_spec,
                                     protected=protected,
                                     split_spec=config.ensemble_split,
+                                    search_spec=search_spec_cfg,
                                 )
                             tl_main = pol_main = roll_main = None
                             pol_blame = pol_attr = None
@@ -1570,6 +1580,57 @@ def run_experiment(
                                 # key, not a folded seed — the
                                 # replay recipe is the solo run
                                 ens_doc["worst_member_seed"] = None
+                    search_doc = None
+                    if search_spec_cfg is not None \
+                            and not protected \
+                            and start_rung == 0:
+                        # successive-halving config search
+                        # (sim/search.py): the bracket screens N
+                        # traced perturbations of THIS case and
+                        # rides its own key lane, so the reported
+                        # measurement above is untouched.  Best
+                        # effort like the ensemble axis: a bracket
+                        # failure never fails the case.  Memory-
+                        # degraded cases skip it outright (the
+                        # widest rung is the ensemble problem VET-M
+                        # pre-selected a rung for).
+                        try:
+                            with telemetry.phase("search.run"):
+                                srch = (
+                                    sharded.run_search
+                                    if use_sharded
+                                    else sim.run_search
+                                )(
+                                    load, n,
+                                    jax.random.fold_in(
+                                        run_key, 911
+                                    ),
+                                    search_spec_cfg,
+                                    block_size=block,
+                                )
+                            search_doc = srch.to_doc(label)
+                            # the marker keeps bench_regress from
+                            # comparing a search-carrying row
+                            # against a plain twin
+                            flat["_search"] = (
+                                search_spec_cfg.members
+                            )
+                            telemetry.counter_inc("search_cases")
+                            telemetry.set_meta(
+                                "search",
+                                str(search_spec_cfg.members),
+                            )
+                        except Exception as e:
+                            telemetry.counter_inc(
+                                "search_fallbacks"
+                            )
+                            print(
+                                f"warning: config-search bracket "
+                                f"for {label} failed "
+                                f"({type(e).__name__}: {e}); the "
+                                "case keeps its solo measurement",
+                                file=sys.stderr,
+                            )
                     flat.update(
                         {
                             "cpu_cores_" + name: round(v, 4)
@@ -1616,6 +1677,7 @@ def run_experiment(
                         lb=lb_doc,
                         ensemble=ens_doc,
                         ensemble_summary=ens_summary,
+                        search=search_doc,
                     )
                     results.append(result)
                     if out is not None:
@@ -1654,6 +1716,11 @@ def run_experiment(
                                 out / f"{label}.ensemble.json", "w"
                             ) as f:
                                 json.dump(ens_doc, f, indent=2)
+                        if search_doc is not None:
+                            with open(
+                                out / f"{label}.search.json", "w"
+                            ) as f:
+                                json.dump(search_doc, f, indent=2)
                         if attr_summary is not None:
                             from isotope_tpu.metrics.export import (
                                 write_flamegraph,

@@ -13,6 +13,13 @@ from isotope_tpu.sim.ensemble import (
     EnsembleSummary,
     wilson_interval,
 )
+from isotope_tpu.sim.search import (
+    SearchSpec,
+    SearchSummary,
+    run_search,
+    run_search_emulated,
+    run_search_sharded,
+)
 from isotope_tpu.sim.splitting import SplitSpec, subset_estimate
 
 __all__ = [
@@ -20,10 +27,15 @@ __all__ = [
     "EnsembleSummary",
     "LoadModel",
     "NetworkModel",
+    "SearchSpec",
+    "SearchSummary",
     "SimParams",
     "SimResults",
     "Simulator",
     "SplitSpec",
+    "run_search",
+    "run_search_emulated",
+    "run_search_sharded",
     "simulate",
     "subset_estimate",
     "wilson_interval",

@@ -554,6 +554,23 @@ def _sparse_level_sweep(
 _call_outcome = levelscan.call_outcome
 
 
+_FOLD_MEMBER_KEYS = None
+
+
+def _fold_member_keys():
+    """Cached jitted member-key derivation: fold_in vmapped over the
+    fleet's seeds.  Eagerly the vmap re-traces on every fleet build;
+    screening brackets build fleets in a hot loop."""
+    global _FOLD_MEMBER_KEYS
+    if _FOLD_MEMBER_KEYS is None:
+        _FOLD_MEMBER_KEYS = jax.jit(
+            lambda key, seeds: jax.vmap(
+                lambda s: jax.random.fold_in(key, s)
+            )(seeds)
+        )
+    return _FOLD_MEMBER_KEYS
+
+
 class Simulator:
     """Holds a compiled graph's device constants and jitted entry points."""
 
@@ -1550,6 +1567,7 @@ class Simulator:
         self._fns: Dict[Tuple[int, str, bool], "jax.stages.Wrapped"] = {}
         self._summary_fns: Dict[tuple, "jax.stages.Wrapped"] = {}
         self._ensemble_fns: Dict[tuple, "jax.stages.Wrapped"] = {}
+        self._search_fns: Dict[tuple, "jax.stages.Wrapped"] = {}
         self._rate_cache: Dict[tuple, float] = {}
         telemetry.counter_inc("simulators_built")
         telemetry.phase_add("engine.build", time.perf_counter() - _t_build)
@@ -1843,7 +1861,14 @@ class Simulator:
         """
         P = int(self._phase_starts.shape[0])
         if P == 1 or sat or not self.has_chaos:
-            return jnp.asarray(self._ident_windows)
+            # one cached device copy: fleets stack this row per
+            # member, and a fresh device_put per member would defeat
+            # the identical-row broadcast in _ensemble_args
+            dev = getattr(self, "_ident_windows_dev", None)
+            if dev is None:
+                dev = jnp.asarray(self._ident_windows)
+                self._ident_windows_dev = dev
+            return dev
         key = (float(f"{float(offered):.4g}"),)
         if key not in self._window_cache:
             cuts = np.asarray(self._phase_starts, np.float64)
@@ -2229,7 +2254,8 @@ class Simulator:
     def _ensemble_member_fn(self, block: int, num_blocks: int,
                             kind: str, connections: int, trim: bool,
                             sat: bool, jittered: bool,
-                            member_chaos: bool = False):
+                            member_chaos: bool = False,
+                            carry_io: bool = False):
         """The ONE-member block-scan program the fleet vmaps.
 
         Body-identical to the plain ``_get_summary`` scan (same
@@ -2237,23 +2263,44 @@ class Simulator:
         reproduces its solo ``run_summary`` twin bit-for-bit; the
         jitter scales thread into ``_simulate_core`` only when
         ``jittered`` (the seeds-only fleet trace stays the solo trace,
-        just batched)."""
+        just batched).
+
+        ``carry_io`` is the search-bracket contract (sim/search.py):
+        the member takes four extra traced arguments after the ten
+        standard ones — a block offset ``b0`` plus the
+        ``(t0, conn_t0, req_off)`` scan carry — and returns
+        ``(summary, carry_out)``.  The per-block RNG folds
+        ``1_000_000 + b0 + b`` so a member resumed at ``b0`` draws the
+        EXACT streams the unbroken run drew for those blocks; with
+        ``b0 == 0`` and zero carries the program is value-identical to
+        the plain member (pinned by tests/test_search.py)."""
         from isotope_tpu.sim import summary as summary_mod
 
+        if carry_io and member_chaos:
+            raise ValueError(
+                "carry_io fleets (search brackets) do not support "
+                "per-member chaos schedules yet (ROADMAP residual)"
+            )
         c = max(connections, 1)
         per = block // c
 
         def member_scan(key, offered_qps, pace_gap, nominal_gap,
                         win_lo, win_hi, visits_pc, phase_windows,
-                        cpu_scale, err_scale, *chaos_rows):
+                        cpu_scale, err_scale, *rest):
             telemetry.record_trace(
                 ("ensemble", self.signature[3], block, num_blocks,
                  kind, connections, trim, sat, jittered,
-                 member_chaos),
+                 member_chaos) + (("carry",) if carry_io else ()),
                 tracing=isinstance(key, jax.core.Tracer),
                 requests=block * num_blocks,
                 hops=self.compiled.num_hops,
             )
+            if carry_io:
+                b0, t0_in, conn_t0_in, req_off_in = rest[:4]
+                chaos_rows = rest[4:]
+            else:
+                b0 = 0
+                chaos_rows = rest
             cfx = (
                 self._member_chaos_fx(chaos_rows)
                 if member_chaos else None
@@ -2261,7 +2308,7 @@ class Simulator:
 
             def body(carry, b):
                 t0, conn_t0, req_off = carry
-                kb = jax.random.fold_in(key, 1_000_000 + b)
+                kb = jax.random.fold_in(key, 1_000_000 + b0 + b)
                 res, t_end, conn_end = self._simulate_core(
                     block, kind, connections, kb, offered_qps,
                     pace_gap, offered_qps, nominal_gap, t0, conn_t0,
@@ -2279,15 +2326,25 @@ class Simulator:
                 )
                 return (t_end, conn_end, req_off + per), s
 
-            carry0 = (
-                jnp.float32(0.0),
-                jnp.zeros((c,), jnp.float32),
-                jnp.float32(0.0),
-            )
-            _, parts = jax.lax.scan(
+            if carry_io:
+                carry0 = (
+                    jnp.asarray(t0_in, jnp.float32),
+                    jnp.asarray(conn_t0_in, jnp.float32),
+                    jnp.asarray(req_off_in, jnp.float32),
+                )
+            else:
+                carry0 = (
+                    jnp.float32(0.0),
+                    jnp.zeros((c,), jnp.float32),
+                    jnp.float32(0.0),
+                )
+            carry_out, parts = jax.lax.scan(
                 body, carry0, jnp.arange(num_blocks)
             )
-            return summary_mod.reduce_stacked(parts)
+            out = summary_mod.reduce_stacked(parts)
+            if carry_io:
+                return out, carry_out
+            return out
 
         return member_scan
 
@@ -2350,6 +2407,49 @@ class Simulator:
                 )
             )
         return self._ensemble_fns[cache_key]
+
+    def _get_search(self, block: int, num_blocks: int, kind: str,
+                    connections: int, sat: bool, chunk_members: int,
+                    jittered: bool, mode: str = "vmap"):
+        """One jitted CARRY-I/O fleet program per rung shape: the
+        :meth:`_get_ensemble` fleet with the four carry arguments
+        threaded through (``b0, t0, conn_t0, req_off`` in, carry out)
+        so a search bracket continues its survivors where the previous
+        rung stopped instead of re-simulating from t=0.
+
+        The carry buffers are donated (``donate_argnums``) on
+        accelerators — each rung consumes the previous rung's gathered
+        carries in place, so bracket memory stays O(survivors), not
+        O(rungs x survivors).  CPU skips donation (XLA:CPU cannot
+        alias them and warns per dispatch).  Cache family is
+        ``("search", ...)``: rung shapes deliberately share executables
+        across brackets of the same bucket width (sim/search.py pads
+        rung widths to powers of two for exactly this reuse)."""
+        cache_key = (block, num_blocks, kind, connections, sat,
+                     chunk_members, jittered, mode)
+        if cache_key not in self._search_fns:
+            member = self._ensemble_member_fn(
+                block, num_blocks, kind, connections, False, sat,
+                jittered, carry_io=True,
+            )
+            if mode == "map":
+                def fleet(*xs):
+                    return jax.lax.map(lambda t: member(*t), xs)
+            else:
+                fleet = jax.vmap(member)
+            donate = (
+                () if jax.default_backend() == "cpu" else (11, 12, 13)
+            )
+            self._search_fns[cache_key] = (
+                executable_cache.get_or_build(
+                    ("search", self.signature) + cache_key,
+                    lambda: telemetry.time_first_call(
+                        jax.jit(fleet, donate_argnums=donate),
+                        "compile.jit_first_call",
+                    ),
+                )
+            )
+        return self._search_fns[cache_key]
 
     def _ensemble_args(self, load: LoadModel, num_requests: int,
                        key: jax.Array, spec, tables,
@@ -2423,10 +2523,11 @@ class Simulator:
             else:
                 # ONE vectorized derivation instead of N tiny
                 # dispatches (threefry is bit-identical under vmap —
-                # the member==solo pin covers this path)
-                keys_arr = jax.vmap(
-                    lambda s: jax.random.fold_in(key, s)
-                )(jnp.asarray(spec.seeds, jnp.uint32))
+                # the member==solo pin covers this path); jitted so
+                # repeat fleets skip the eager vmap retrace
+                keys_arr = _fold_member_keys()(
+                    key, jnp.asarray(spec.seeds, jnp.uint32)
+                )
         else:
             member_keys = list(member_keys)
             if len(member_keys) != n_mem:
@@ -2508,6 +2609,19 @@ class Simulator:
             win_rows.append(win_m)
             if trim:
                 win_lo[m], win_hi[m] = lo, hi
+
+        def _stack(rows):
+            # rate-independent tables (no retry feedback / no drains)
+            # hand every member the SAME row object: broadcast it
+            # instead of paying members x device_put + concatenate
+            first = rows[0]
+            if all(r is first for r in rows[1:]):
+                first = jnp.asarray(first)
+                return jnp.broadcast_to(
+                    first[None], (len(rows),) + first.shape
+                )
+            return jnp.stack(rows)
+
         return dict(
             sat=sat,
             kind=load.kind,
@@ -2520,8 +2634,8 @@ class Simulator:
             nominal=nominal,
             win_lo=win_lo,
             win_hi=win_hi,
-            visits=jnp.stack(vis_rows),
-            windows=jnp.stack(win_rows),
+            visits=_stack(vis_rows),
+            windows=_stack(win_rows),
             cpu_scale=tables.cpu_scale,
             err_scale=tables.err_scale,
         )
@@ -2605,6 +2719,9 @@ class Simulator:
         member_keys=None,
         member_qps=None,
         member_chaos=None,
+        carry_in=None,
+        return_carry: bool = False,
+        block_offset: int = 0,
     ):
         """Simulate a Monte Carlo fleet: N scenario variants in ONE
         jitted program per device (sim/ensemble.py).
@@ -2643,6 +2760,18 @@ class Simulator:
         probabilities with Wilson CIs).  The per-service collector
         series stay out of the fleet program (O(N * S * buckets)
         leaves); run a solo collector pass for those.
+
+        The carry export (search brackets, sim/search.py):
+        ``block_offset`` resumes every member's per-block RNG at that
+        block index, ``carry_in`` seeds the ``(t0, conn_t0, req_off)``
+        scan carries (member-stacked; ``None`` = fresh t=0 start), and
+        ``return_carry`` returns ``(summary, carry_out)`` so the next
+        segment can continue where this one stopped.  A run split into
+        carry-continued segments reproduces the unbroken run's RNG
+        streams and carries exactly; the summed float reductions
+        (``latency_sum``/``latency_m2``) may differ by reduction order
+        like :func:`~isotope_tpu.sim.summary.summary_accumulate`.
+        These knobs require ``trim=False`` and no ``member_chaos``.
         """
         from isotope_tpu.compiler.compile import compile_ensemble
         from isotope_tpu.sim import ensemble as ens_mod
@@ -2675,6 +2804,14 @@ class Simulator:
             member_qps=member_qps, planners=planners,
         )
         n_mem = spec.members
+        carry_run = (
+            carry_in is not None or return_carry or block_offset != 0
+        )
+        if carry_run and (trim or chaos_fx is not None):
+            raise ValueError(
+                "the ensemble carry export (carry_in/return_carry/"
+                "block_offset) requires trim=False and no member_chaos"
+            )
         chunk_sz = chunk if chunk is not None else spec.chunk
         if chunk_sz is None:
             chunk_sz = self.ensemble_chunk_size(n_mem, args["block"])
@@ -2686,33 +2823,81 @@ class Simulator:
         telemetry.gauge_set("engine_block_requests", args["block"])
         telemetry.gauge_set("engine_num_blocks", args["num_blocks"])
         telemetry.set_meta("ensemble_mode", tables.mode)
-        fn = self._get_ensemble(
-            args["block"], args["num_blocks"], args["kind"],
-            args["conns"], trim, args["sat"], chunk_sz,
-            tables.jittered, tables.mode,
-            member_chaos=chaos_fx is not None,
-        )
+        stacked = self._ensemble_stacked_args(args)
+        if carry_run:
+            fn = self._get_search(
+                args["block"], args["num_blocks"], args["kind"],
+                args["conns"], args["sat"], chunk_sz,
+                tables.jittered, tables.mode,
+            )
+            if carry_in is None:
+                carry_in = self.zero_ensemble_carry(
+                    n_mem, args["conns"]
+                )
+            b0 = jnp.full((n_mem,), int(block_offset), jnp.int32)
+            stacked = stacked + (b0,) + tuple(carry_in)
+        else:
+            fn = self._get_ensemble(
+                args["block"], args["num_blocks"], args["kind"],
+                args["conns"], trim, args["sat"], chunk_sz,
+                tables.jittered, tables.mode,
+                member_chaos=chaos_fx is not None,
+            )
+            stacked = stacked + self._chaos_fx_args(
+                chaos_fx, with_pol=False
+            )
         padded = self._ensemble_pad_args(
-            self._ensemble_stacked_args(args)
-            + self._chaos_fx_args(chaos_fx, with_pol=False),
-            n_mem, n_chunks * chunk_sz,
+            stacked, n_mem, n_chunks * chunk_sz,
         )
         parts = []
+        carry_parts = []
         with self._detail_ctx():
             for ci in range(n_chunks):
                 sl = slice(ci * chunk_sz, (ci + 1) * chunk_sz)
-                parts.append(fn(*(x[sl] for x in padded)))
+                out = fn(*(x[sl] for x in padded))
+                if carry_run:
+                    out, carry_out = out
+                    carry_parts.append(carry_out)
+                parts.append(out)
                 if n_chunks > 1:
                     # serialize chunks: live memory stays bounded by
                     # one chunk's event tensors (the point of chunking)
                     jax.block_until_ready(parts[-1].count)
         summaries = self._ensemble_concat(parts, n_mem)
-        return ens_mod.EnsembleSummary(
+        ens = ens_mod.EnsembleSummary(
             spec=spec,
             summaries=summaries,
             offered_qps=args["offered"],
             chunk=chunk_sz,
             member_chaos=member_events,
+        )
+        if return_carry:
+            return ens, self._ensemble_concat(carry_parts, n_mem)
+        return ens
+
+    @staticmethod
+    def zero_ensemble_carry(n_mem: int, connections: int):
+        """The fresh-start ``(t0, conn_t0, req_off)`` member-stacked
+        carry — what a carry-I/O fleet resumes from at t=0 (the same
+        zeros the plain member scan starts with)."""
+        c = max(connections, 1)
+        return (
+            jnp.zeros((n_mem,), jnp.float32),
+            jnp.zeros((n_mem, c), jnp.float32),
+            jnp.zeros((n_mem,), jnp.float32),
+        )
+
+    def run_search(self, load: LoadModel, num_requests: int,
+                   key: jax.Array, spec, *,
+                   block_size: int = 65_536,
+                   chunk: Optional[int] = None):
+        """Screen a config population by successive halving in a few
+        jitted dispatches (sim/search.py :func:`run_search`)."""
+        from isotope_tpu.sim import search as search_mod
+
+        return search_mod.run_search(
+            self, load, num_requests, key, spec,
+            block_size=block_size, chunk=chunk,
         )
 
     def plan_timeline_windows(

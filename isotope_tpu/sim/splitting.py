@@ -331,3 +331,43 @@ def severity_scores(
     counts = np.asarray(summaries.count, np.float64)
     errs = np.asarray(summaries.error_count, np.float64)
     return errs / np.maximum(counts, 1.0)
+
+
+def severity_scores_device(
+    severity: str,
+    summaries,
+    slo_s=None,
+):
+    """On-device twin of :func:`severity_scores` over a member-stacked
+    fleet summary — the rank channel of the search brackets
+    (sim/search.py), where the scores feed a ``lexsort`` + gather
+    WITHOUT leaving the device.
+
+    Same channel semantics: ``p99`` is SLO-violation depth via the
+    device histogram-quantile twin; ``err_share`` is the run-long
+    client error share; ``err_peak`` falls back to ``err_share``
+    exactly like the host function does when no recorder timeline
+    rode the fleet (search fleets carry none — VET-T026 warns at the
+    spec layer).  Every bracket path (solo, sharded, emulated) ranks
+    through THIS function, so severities — and therefore survivor
+    lineages — are bit-identical across them.
+    """
+    import jax.numpy as jnp
+
+    if severity == "p99":
+        if slo_s is None or slo_s <= 0:
+            raise ValueError(
+                "p99 search severity needs slo= (the latency that "
+                "maps to severity 1.0)"
+            )
+        from isotope_tpu.metrics.histogram import (
+            quantile_from_histogram_device,
+        )
+
+        p99 = quantile_from_histogram_device(
+            summaries.latency_hist, 0.99
+        )
+        return p99 / jnp.float32(slo_s)
+    counts = jnp.asarray(summaries.count, jnp.float32)
+    errs = jnp.asarray(summaries.error_count, jnp.float32)
+    return errs / jnp.maximum(counts, 1.0)

@@ -569,3 +569,63 @@ def test_ensemble_evidence_keys_not_compared_as_rates(tmp_path,
     monkeypatch.delenv("BENCH_REGRESS_ENSEMBLE_THRESHOLD",
                        raising=False)
     assert run_gate(tmp_path, monkeypatch, new, base) == 0
+
+
+# -- config-search speedup gate (BENCH_REGRESS_SEARCH_THRESHOLD) -------
+
+
+def _search_extra(rate, speedup, traces=3, **kw):
+    d = {"search64": rate, "search64_best": rate,
+         "search64_search_candidates": 64,
+         "search64_search_rungs": 3,
+         "search64_search_traces": traces,
+         "search64_search_sequential_rate": rate / max(speedup, 1e-9),
+         "search64_search_speedup": speedup}
+    d.update(kw)
+    return d
+
+
+def test_search_gate_off_by_default(tmp_path, monkeypatch):
+    base = capture(2.0e9, _search_extra(1.3e7, 3.5))
+    new = capture(2.0e9, _search_extra(1.3e7, 1.2))
+    monkeypatch.delenv("BENCH_REGRESS_SEARCH_THRESHOLD",
+                       raising=False)
+    assert run_gate(tmp_path, monkeypatch, new, base) == 0
+
+
+def test_search_gate_fails_below_threshold(tmp_path, monkeypatch,
+                                           capsys):
+    base = capture(2.0e9, _search_extra(1.3e7, 3.5))
+    new = capture(2.0e9, _search_extra(1.3e7, 2.4))
+    monkeypatch.setenv("BENCH_REGRESS_SEARCH_THRESHOLD", "3.0")
+    assert run_gate(tmp_path, monkeypatch, new, base) == 1
+    assert "search64.search_speedup" in capsys.readouterr().out
+
+
+def test_search_gate_passes_at_threshold(tmp_path, monkeypatch):
+    base = capture(2.0e9, _search_extra(1.3e7, 3.5))
+    new = capture(2.0e9, _search_extra(1.3e7, 3.1))
+    monkeypatch.setenv("BENCH_REGRESS_SEARCH_THRESHOLD", "3.0")
+    assert run_gate(tmp_path, monkeypatch, new, base) == 0
+
+
+def test_search_gate_trace_bound_rides_along(tmp_path, monkeypatch,
+                                             capsys):
+    # a bracket that compiled more executables than rungs lost the
+    # one-compile-per-rung-shape property, whatever the speedup says
+    base = capture(2.0e9, _search_extra(1.3e7, 3.5))
+    new = capture(2.0e9, _search_extra(1.3e7, 3.5, traces=5))
+    monkeypatch.setenv("BENCH_REGRESS_SEARCH_THRESHOLD", "3.0")
+    assert run_gate(tmp_path, monkeypatch, new, base) == 1
+    assert "search64.search_traces" in capsys.readouterr().out
+
+
+def test_search_evidence_keys_not_compared_as_rates(tmp_path,
+                                                    monkeypatch):
+    # a sequential-rate / speedup drop must never read as a case-rate
+    # regression: the *_search_* keys are evidence, like *_spread
+    base = capture(2.0e9, _search_extra(1.3e7, 4.0))
+    new = capture(2.0e9, _search_extra(1.3e7, 1.1))
+    monkeypatch.delenv("BENCH_REGRESS_SEARCH_THRESHOLD",
+                       raising=False)
+    assert run_gate(tmp_path, monkeypatch, new, base) == 0

@@ -1,0 +1,487 @@
+"""On-device config search (sim/search.py): successive-halving
+brackets as a few jitted dispatches.
+
+The pins the feature's contract rests on:
+
+- rung 0 of a bracket is BIT-IDENTICAL to the plain ``run_ensemble``
+  fleet at the screening horizon (same fold_in layout, same stacked
+  tables);
+- a survivor's carry-continued trajectory equals the unbroken solo
+  member at the combined horizon on every exact field (counts, hist,
+  min/max, end_max); the float-summed ``latency_sum``/``latency_m2``
+  may differ by reduction order only;
+- the zero-carry export path leaves the plain fleet byte-identical
+  (search off = nothing changed);
+- ranking is deterministic under ties: the fold_in-derived tie-break
+  draws order all-tied candidates the same way on every run key;
+- the sharded bracket == its emulated twin == the solo bracket,
+  winner and full lineage;
+- member-chunked rung dispatches == the unchunked bracket;
+- the isotope-search/v1 artifact round-trips; the ``[search]`` TOML
+  block decodes to the same spec; VET-T026/VET-M005 lint the
+  degenerate cases the run entry raises on.
+"""
+import dataclasses
+import json
+
+import jax
+import jax.tree_util as jtu
+import numpy as np
+import pytest
+
+from isotope_tpu.compiler import compile_graph
+from isotope_tpu.models.graph import ServiceGraph
+from isotope_tpu.sim import LoadModel
+from isotope_tpu.sim.engine import Simulator
+from isotope_tpu.sim.ensemble import EnsembleSpec
+from isotope_tpu.sim.search import (
+    DOC_SCHEMA,
+    SearchSpec,
+    check_doc,
+    load_doc,
+    plan_bracket,
+    tiebreak_draws,
+)
+
+YAML = """
+defaults:
+  responseSize: 1 KiB
+services:
+- name: entry
+  isEntrypoint: true
+  errorRate: 1%
+  script:
+  - - call: x
+    - call: y
+  - call: z
+- name: x
+  numReplicas: 2
+- name: y
+  script:
+  - call: z
+- name: z
+"""
+
+# the tie graph: no errorRate anywhere, so err_share severity is 0.0
+# for EVERY candidate and ranking falls through to the tie-break draws
+YAML_NOERR = """
+defaults:
+  responseSize: 1 KiB
+services:
+- name: entry
+  isEntrypoint: true
+  script:
+  - call: z
+- name: z
+"""
+
+OPEN = LoadModel(kind="open", qps=2000.0)
+KEY = jax.random.PRNGKey(7)
+N, BLOCK = 512, 128  # 4 blocks: rungs screen at 1 then continue to 4
+
+
+@pytest.fixture(scope="module")
+def compiled():
+    return compile_graph(ServiceGraph.from_yaml(YAML))
+
+
+@pytest.fixture(scope="module")
+def sim(compiled):
+    return Simulator(compiled)
+
+
+@pytest.fixture(scope="module")
+def pop16():
+    """The module's canonical candidate population: every perturbation
+    axis jittered, so per-candidate offered rates and physics differ."""
+    return EnsembleSpec.from_jitter(
+        16, qps_jitter=0.2, cpu_jitter=0.1, error_jitter=0.3
+    )
+
+
+@pytest.fixture(scope="module")
+def spec16(pop16):
+    return SearchSpec(candidates=pop16, eta=4, rungs=2)
+
+
+@pytest.fixture(scope="module")
+def srch16(sim, spec16):
+    """The canonical bracket: 16 -> 4 -> winner over 1 then 4 blocks."""
+    return sim.run_search(OPEN, N, KEY, spec16, block_size=BLOCK)
+
+
+def _leaves_equal(a, b):
+    la, lb = jtu.tree_leaves(a), jtu.tree_leaves(b)
+    assert len(la) == len(lb)
+    return all(
+        np.array_equal(np.asarray(x), np.asarray(y), equal_nan=True)
+        for x, y in zip(la, lb)
+    )
+
+# fields a segmented (carry-continued) run reproduces EXACTLY; the
+# float-summed leaves (latency_sum/latency_m2) may differ by reduction
+# order, like summary_accumulate
+EXACT_FIELDS = ("count", "error_count", "hop_events", "latency_min",
+                "latency_max", "latency_hist", "end_max")
+
+
+# -- plan law ----------------------------------------------------------
+
+
+def test_plan_bracket_widths_horizons(spec16):
+    plan = plan_bracket(spec16, N, BLOCK)
+    assert [rp.width for rp in plan] == [16, 4]
+    assert [rp.bucket for rp in plan] == [16, 4]
+    assert [rp.start_block for rp in plan] == [0, 1]
+    assert [rp.num_blocks for rp in plan] == [1, 3]
+    assert [rp.cum_requests for rp in plan] == [BLOCK, 4 * BLOCK]
+
+
+def test_plan_bracket_rejects_flat_horizon(spec16):
+    # 1 total block cannot grow between 2 rungs
+    with pytest.raises(ValueError, match="VET-T026"):
+        plan_bracket(spec16, BLOCK, BLOCK)
+
+
+def test_spec_validation():
+    pop = EnsembleSpec.of(8)
+    with pytest.raises(ValueError, match="eta"):
+        SearchSpec(candidates=pop, eta=1)
+    with pytest.raises(ValueError, match="rungs"):
+        SearchSpec(candidates=pop, rungs=0)
+    with pytest.raises(ValueError, match="growth"):
+        SearchSpec(candidates=pop, growth=1)
+    with pytest.raises(ValueError, match="rank"):
+        SearchSpec(candidates=pop, rank="latency_hist")
+    with pytest.raises(ValueError, match="slo_s"):
+        SearchSpec(candidates=pop, rank="p99")
+    # population too small for the rung count: widths stop shrinking
+    with pytest.raises(ValueError, match="VET-T026"):
+        SearchSpec(candidates=EnsembleSpec.of(4), eta=4,
+                   rungs=3).check()
+
+
+# -- rung 0 == the plain fleet at the screening horizon ----------------
+
+
+def test_rung0_bit_equals_run_ensemble(sim, pop16, srch16):
+    ens = sim.run_ensemble(OPEN, BLOCK, KEY, pop16, block_size=BLOCK)
+    r0 = srch16.rungs[0]
+    assert list(r0.candidates) == list(range(16))
+    assert _leaves_equal(ens.summaries, r0.summaries)
+
+
+def test_search_off_byte_identity(sim, pop16):
+    """The carry export with zero carry and zero offset IS the plain
+    fleet — arming the machinery without using it changes nothing."""
+    plain = sim.run_ensemble(OPEN, BLOCK, KEY, pop16, block_size=BLOCK)
+    carried, carry_out = sim.run_ensemble(
+        OPEN, BLOCK, KEY, pop16, block_size=BLOCK, return_carry=True,
+    )
+    assert _leaves_equal(plain.summaries, carried.summaries)
+    t0, conn_t0, req_off = carry_out
+    assert np.asarray(t0).shape == (16,)
+    assert np.asarray(req_off).shape == (16,)
+
+
+# -- survivor continuation == the unbroken solo member -----------------
+
+
+def test_winner_continuation_equals_unbroken_member(sim, pop16,
+                                                    srch16):
+    full = sim.run_ensemble(OPEN, N, KEY, pop16, block_size=BLOCK)
+    combined = srch16.winner_summary()
+    unbroken = full.member(srch16.winner)
+    for f in EXACT_FIELDS:
+        assert np.array_equal(
+            np.asarray(getattr(combined, f)),
+            np.asarray(getattr(unbroken, f)),
+        ), f
+    np.testing.assert_allclose(
+        np.asarray(combined.latency_sum),
+        np.asarray(unbroken.latency_sum), rtol=1e-5,
+    )
+
+
+def test_every_survivor_continuation_matches(sim, pop16, srch16):
+    """Not just the winner: each rung-1 row is candidate c's blocks
+    [1, 4) continuation — accumulated with its rung-0 segment it
+    matches c's unbroken full-horizon member."""
+    full = sim.run_ensemble(OPEN, N, KEY, pop16, block_size=BLOCK)
+    r0, r1 = srch16.rungs
+    for row, c in enumerate(r1.candidates):
+        seg0 = jtu.tree_map(
+            lambda x: np.asarray(x)[int(c)], r0.summaries
+        )
+        seg1 = jtu.tree_map(
+            lambda x: np.asarray(x)[row], r1.summaries
+        )
+        unbroken = full.member(int(c))
+        for f in ("count", "error_count", "hop_events"):
+            assert (
+                np.asarray(getattr(seg0, f))
+                + np.asarray(getattr(seg1, f))
+                == np.asarray(getattr(unbroken, f))
+            ), (c, f)
+        assert np.array_equal(
+            np.asarray(seg0.latency_hist)
+            + np.asarray(seg1.latency_hist),
+            np.asarray(unbroken.latency_hist),
+        ), c
+        assert np.asarray(seg1.end_max) == np.asarray(
+            unbroken.end_max
+        ), c
+
+
+# -- deterministic ranking under ties ----------------------------------
+
+
+def test_rank_ties_resolve_by_fold_in_draws():
+    sim_t = Simulator(compile_graph(ServiceGraph.from_yaml(YAML_NOERR)))
+    spec = SearchSpec(
+        candidates=EnsembleSpec.of(8), eta=2, rungs=2, seed=3,
+    )
+    a = sim_t.run_search(OPEN, 256, KEY, spec, block_size=128)
+    assert np.all(a.rungs[0].severity == 0.0)  # everything tied
+    # the tie order is the spec's fold_in draws, not timing or memory
+    tb = np.asarray(tiebreak_draws(spec))
+    expected = np.argsort(tb, kind="stable")
+    assert list(a.rungs[0].survivors) == list(expected[:4])
+    assert a.winner == int(expected[0])
+    # ...and independent of the run key: a different key re-draws the
+    # simulation, but all-tied severities rank identically
+    b = sim_t.run_search(
+        OPEN, 256, jax.random.fold_in(KEY, 99), spec, block_size=128
+    )
+    assert b.winner == a.winner
+    assert list(b.rungs[1].candidates) == list(a.rungs[1].candidates)
+
+
+# -- chunked == unchunked ----------------------------------------------
+
+
+def test_chunked_bracket_matches_unchunked(sim, spec16, srch16):
+    chunked = sim.run_search(
+        OPEN, N, KEY, spec16, block_size=BLOCK, chunk=4
+    )
+    assert chunked.rungs[0].chunk == 4
+    assert chunked.winner == srch16.winner
+    for ra, rb in zip(chunked.rungs, srch16.rungs):
+        assert list(ra.candidates) == list(rb.candidates)
+        assert list(ra.survivors) == list(rb.survivors)
+        assert _leaves_equal(ra.summaries, rb.summaries)
+
+
+def test_search_auto_chunk_unknown_capacity_is_whole_rung(sim):
+    from isotope_tpu.analysis import costmodel
+    from isotope_tpu.sim.search import search_auto_chunk
+
+    if costmodel.device_capacity_bytes() is None:
+        assert search_auto_chunk(sim, 16, BLOCK, 0) == 16
+
+
+# -- sharded == emulated == solo ---------------------------------------
+
+
+def test_sharded_bracket_bit_equals_emulated_twin(compiled, spec16,
+                                                  srch16):
+    from isotope_tpu.parallel import (
+        EmulatedMesh,
+        MeshSpec,
+        ShardedSimulator,
+        build_mesh,
+    )
+
+    sh = ShardedSimulator(compiled, build_mesh(MeshSpec(data=4, svc=2)))
+    dev = sh.run_search(OPEN, N, KEY, spec16, block_size=BLOCK)
+    esh = ShardedSimulator(
+        compiled, EmulatedMesh(MeshSpec(data=4, svc=2))
+    )
+    emu = esh.run_search_emulated(OPEN, N, KEY, spec16,
+                                  block_size=BLOCK)
+    for twin in (emu, dev):
+        assert twin.winner == srch16.winner
+        for ra, rb in zip(twin.rungs, srch16.rungs):
+            assert list(ra.candidates) == list(rb.candidates)
+            assert list(ra.survivors) == list(rb.survivors)
+            assert np.array_equal(ra.severity, rb.severity)
+            assert _leaves_equal(ra.summaries, rb.summaries)
+    with pytest.raises(ValueError, match="emulated"):
+        esh.run_search(OPEN, N, KEY, spec16, block_size=BLOCK)
+
+
+# -- trace discipline --------------------------------------------------
+
+
+def test_bracket_traces_bounded_by_rungs(sim, spec16, srch16):
+    from isotope_tpu import telemetry
+
+    assert srch16.traces <= spec16.rungs
+    # a repeat bracket re-dispatches the SAME executables: 0 traces
+    t0 = telemetry.counter_get("engine_traces")
+    sim.run_search(
+        OPEN, N, jax.random.fold_in(KEY, 5), spec16, block_size=BLOCK
+    )
+    assert telemetry.counter_get("engine_traces") == t0
+
+
+# -- artifact ----------------------------------------------------------
+
+
+def test_artifact_round_trip(tmp_path, spec16, srch16):
+    doc = srch16.to_doc("svc.search")
+    doc = json.loads(json.dumps(doc))  # through the wire
+    assert check_doc(doc) is doc
+    assert doc["schema"] == DOC_SCHEMA
+    assert doc["label"] == "svc.search"
+    assert doc["candidates"] == 16
+    assert doc["winner"]["candidate"] == srch16.winner
+    assert [r["width"] for r in doc["lineage"]] == [16, 4]
+    spec_rt = SearchSpec.from_dict(doc["spec"])
+    assert spec_rt.eta == spec16.eta
+    assert spec_rt.rungs == spec16.rungs
+    assert spec_rt.members == spec16.members
+    np.testing.assert_allclose(
+        spec_rt.candidates.qps_scale, spec16.candidates.qps_scale
+    )
+    p = tmp_path / "x.search.json"
+    p.write_text(json.dumps(doc))
+    assert load_doc(str(p))["winner"]["candidate"] == srch16.winner
+    with pytest.raises(ValueError, match="isotope-search"):
+        check_doc({"schema": "isotope-ensemble/v1"})
+
+
+def test_winner_config_is_the_warm_start(pop16, srch16):
+    w = srch16.winner_config()
+    k = srch16.winner
+    assert w["seed"] == pop16.seeds[k]
+    assert w["qps_scale"] == pytest.approx(float(pop16.qps_scale[k]))
+    assert w["offered_qps"] == pytest.approx(
+        float(srch16.offered_qps[k])
+    )
+    assert w["rank"] == "err_share"
+
+
+# -- [search] TOML block -----------------------------------------------
+
+
+def test_toml_search_block_decodes(tmp_path):
+    topo = tmp_path / "t.yaml"
+    topo.write_text(YAML)
+    cfg = tmp_path / "exp.toml"
+    cfg.write_text(f"""
+topology_paths = ["{topo}"]
+environments = ["NONE"]
+
+[client]
+qps = [500]
+num_concurrent_connections = [8]
+duration = "60s"
+load_kind = "open"
+
+[sim]
+num_requests = 512
+seed = 7
+
+[search]
+candidates = 16
+eta = 4
+rungs = 2
+rank = "p99"
+slo = "250ms"
+jitter = "qps=0.2,cpu=0.1,error=0.3"
+seed = 3
+""")
+    from isotope_tpu.runner import load_toml
+
+    spec = load_toml(cfg).search_spec()
+    assert spec is not None
+    assert (spec.members, spec.eta, spec.rungs) == (16, 4, 2)
+    assert spec.rank == "p99"
+    assert spec.slo_s == pytest.approx(0.25)
+    assert spec.seed == 3
+    assert spec.candidates.qps_scale is not None
+
+
+def test_toml_search_block_rejects_typos(tmp_path):
+    topo = tmp_path / "t.yaml"
+    topo.write_text(YAML)
+    cfg = tmp_path / "exp.toml"
+    cfg.write_text(f"""
+topology_paths = ["{topo}"]
+environments = ["NONE"]
+
+[client]
+qps = [500]
+num_concurrent_connections = [8]
+duration = "60s"
+load_kind = "open"
+
+[search]
+candidats = 16
+""")
+    from isotope_tpu.runner import load_toml
+
+    with pytest.raises(ValueError, match="candidats"):
+        load_toml(cfg)
+
+
+# -- vet rules ---------------------------------------------------------
+
+
+def test_lint_search_rules():
+    from isotope_tpu.analysis.topo_lint import lint_search
+
+    assert lint_search(None) == []
+    ok = SearchSpec(candidates=EnsembleSpec.of(16), eta=4, rungs=2)
+    assert lint_search(ok, num_requests=N, block=BLOCK) == []
+    # undecodable raw [search] table
+    bad = lint_search({"eta": "wide"})
+    assert bad and bad[0].rule == "VET-T026"
+    assert bad[0].severity == "error"
+    # population too small: widths stop shrinking
+    small = lint_search(
+        {"candidates": {"seeds": [0, 1, 2, 3]}, "eta": 4, "rungs": 3}
+    )
+    assert any(
+        f.rule == "VET-T026" and f.severity == "error" for f in small
+    )
+    # flat horizon schedule (1 total block over 2 rungs)
+    flat = lint_search(ok, num_requests=BLOCK, block=BLOCK)
+    assert any(
+        f.rule == "VET-T026" and f.severity == "error" for f in flat
+    )
+    # warn-grade: non-power-of-eta population, recorderless err_peak
+    ragged = lint_search(
+        SearchSpec(candidates=EnsembleSpec.of(10), eta=4, rungs=2)
+    )
+    assert any(f.severity == "warn" for f in ragged)
+    peak = lint_search(
+        SearchSpec(candidates=EnsembleSpec.of(16), eta=4, rungs=2,
+                   rank="err_peak")
+    )
+    assert any("err_share" in f.message for f in peak)
+
+
+def test_vet_m005_widest_rung_capacity(sim, monkeypatch):
+    from isotope_tpu.analysis import costmodel
+
+    est = costmodel.estimate_run(sim, BLOCK)
+    # no capacity signal (CPU): the vet gate invents no OOMs
+    if est.capacity_bytes is None:
+        assert costmodel.search_findings(est, 64, 0) == []
+    # force a tiny budget: the widest rung must report its auto-chunk
+    tiny = dataclasses.replace(
+        est, capacity_bytes=2.0 * est.peak_bytes_at_block
+    )
+    out = costmodel.search_findings(tiny, 64, 8)
+    assert out and out[0].rule == "VET-M005"
+    assert out[0].severity == "warn"
+    assert "member chunks" in out[0].message
+    # a rung that fits reports nothing
+    assert costmodel.search_findings(
+        dataclasses.replace(
+            est, capacity_bytes=1e6 * est.peak_bytes_at_block
+        ),
+        64, 8,
+    ) == []

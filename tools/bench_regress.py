@@ -117,7 +117,10 @@ def _cases(doc: dict, prefer_best: bool = False) -> dict:
                        "_chaosfleet_members", "_chaosfleet_traces",
                        "_chaosfleet_worst_severity",
                        "_chaosfleet_split_p",
-                       "_chaosfleet_split_evals")):
+                       "_chaosfleet_split_evals",
+                       "_search_candidates", "_search_rungs",
+                       "_search_traces", "_search_sequential_rate",
+                       "_search_speedup")):
             continue  # evidence / variance keys, not rates
         cases[k] = float(v)
     if prefer_best:
@@ -354,6 +357,50 @@ def ensemble_failures(prev_doc: dict, new_doc: dict) -> list:
     return failures
 
 
+def search_failures(new_doc: dict) -> list:
+    """Opt-in gate (``BENCH_REGRESS_SEARCH_THRESHOLD=<ratio>``): a
+    config-search bracket case whose measured speedup over the
+    sequential sweep (``<case>_search_speedup``) fell under the
+    threshold fails the round.
+
+    Like the timeline-overhead gate this is an absolute bound on the
+    NEW capture, not a ratio against the previous one — the bracket's
+    perf claim (the ISSUE's >= 3x bar) either holds or it doesn't;
+    comparing drifting speedups would let the bound creep.  The trace
+    bound rides along: a bracket that compiled more executables than
+    rungs (``_search_traces`` > ``_search_rungs``) lost the
+    one-compile-per-rung-shape property the speedup rests on.
+    """
+    raw = os.environ.get("BENCH_REGRESS_SEARCH_THRESHOLD")
+    if raw is None or raw == "":
+        return []
+    thr = float(raw)
+    failures = []
+    new_extra = new_doc.get("extra", {})
+    for k, v in sorted(new_extra.items()):
+        if not k.endswith("_search_speedup") or not isinstance(
+            v, (int, float)
+        ):
+            continue
+        case = k[: -len("_search_speedup")]
+        bad = float(v) < thr
+        verdict = "REGRESSION" if bad else "OK"
+        print(f"bench_regress: {case}.search_speedup: {float(v):.3f} "
+              f"(threshold {thr:.3f}) {verdict}")
+        if bad:
+            failures.append(f"{case}.search_speedup")
+        traces = new_extra.get(f"{case}_search_traces")
+        rungs = new_extra.get(f"{case}_search_rungs")
+        if isinstance(traces, (int, float)) and isinstance(
+            rungs, (int, float)
+        ) and traces > rungs:
+            print(f"bench_regress: {case}.search_traces: "
+                  f"{int(traces)} > {int(rungs)} rung shapes "
+                  "REGRESSION")
+            failures.append(f"{case}.search_traces")
+    return failures
+
+
 def layout_failures(prev_doc: dict, new_doc: dict) -> list:
     """Opt-in gate (``BENCH_REGRESS_LAYOUT_GATE=1``): the automatic
     mesh-layout search (parallel/layout.py — bench embeds the chosen
@@ -521,6 +568,7 @@ def main() -> int:
     failures.extend(spread_failures(prev_doc, new_doc))
     failures.extend(timeline_failures(new_doc))
     failures.extend(ensemble_failures(prev_doc, new_doc))
+    failures.extend(search_failures(new_doc))
     failures.extend(layout_failures(prev_doc, new_doc))
     if failures:
         print(f"bench_regress: FAIL vs {prev_path}: "

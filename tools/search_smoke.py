@@ -1,0 +1,163 @@
+"""search-smoke: the config-search acceptance story end-to-end.
+
+One svc-scale successive-halving bracket (the vendored 1000-service
+fan-out with a 2% entry error rate injected so ``error_scale``
+bites) over 16 candidates on CPU, checked four ways (sim/search.py):
+
+1. **The planted best wins**: candidate 5 carries a near-zero
+   ``error_scale`` while every rival's is >= 0.8, so the err_share
+   ranking must advance it through every rung and crown it — and
+   ``winner_config()`` must hand back exactly that candidate's
+   scales (the ``optimize`` warm start).
+
+2. **A bracket costs at most one compile per rung**: the telemetry
+   trace counter across the whole bracket must record <= rungs
+   engine traces (one per rung width), and a second bracket of the
+   same shape must add ZERO — every rung rides the executable cache.
+
+3. **Rung 0 is the plain fleet, bit for bit**: each screening row
+   must equal the matching member of ``run_ensemble`` at the same
+   horizon on every exact field — ranking gathers candidates, it
+   never perturbs their physics.
+
+4. **The winner's carry-continued trajectory replays solo**: the
+   per-rung segments merged by ``winner_summary()`` must match the
+   winner's row of an UNBROKEN full-horizon fleet exactly on counts,
+   extrema, and the latency histogram (float-summed leaves agree to
+   reduction order).
+
+``make search-smoke`` wires it into CI-style checks next to the
+other smokes.
+"""
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+import numpy as np
+
+EXACT_FIELDS = (
+    "count", "error_count", "hop_events",
+    "latency_min", "latency_max", "latency_hist", "end_max",
+)
+
+
+def main() -> int:
+    import jax
+    import yaml
+
+    from isotope_tpu import telemetry
+    from isotope_tpu.compiler import compile_graph
+    from isotope_tpu.models.graph import ServiceGraph
+    from isotope_tpu.sim import LoadModel
+    from isotope_tpu.sim.engine import Simulator
+    from isotope_tpu.sim.ensemble import EnsembleSpec
+    from isotope_tpu.sim.search import SearchSpec
+
+    telemetry.reset()
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    with open(os.path.join(
+        root, "examples/topologies/1000-svc_2000-end.yaml"
+    )) as f:
+        doc = yaml.safe_load(f)
+    # the vendored fan-out ships error-free; give the entrypoint a
+    # base error rate so the candidates' error_scale has a signal
+    doc["services"][0]["errorRate"] = "2%"
+    sim = Simulator(compile_graph(ServiceGraph.decode(doc)))
+    load = LoadModel(kind="open", qps=10_000.0)
+    key = jax.random.PRNGKey(42)
+    cands, best, n, block = 16, 5, 256, 64
+
+    # a planted winner: near-zero error scaling for candidate 5,
+    # every rival >= 0.8 (distinct, so no rank ties)
+    err = 0.8 + 0.08 * np.arange(cands, dtype=np.float64)
+    err[best] = 1e-3
+    pop = EnsembleSpec(seeds=tuple(range(cands)), error_scale=err)
+    spec = SearchSpec(candidates=pop, eta=4, rungs=2)
+
+    # -- 1+2. the bracket: planted winner, <= rungs compiles ----------
+    traces0 = telemetry.counter_get("engine_traces")
+    srch = sim.run_search(load, n, key, spec, block_size=block)
+    traces = int(telemetry.counter_get("engine_traces") - traces0)
+    for r in srch.rungs:
+        print(
+            f"search-smoke: rung {r.rung}: width {r.width} @ "
+            f"{r.cum_requests} reqs -> survivors "
+            f"{[int(x) for x in r.survivors]}"
+        )
+        assert best in set(int(x) for x in r.survivors), (
+            f"planted best {best} eliminated at rung {r.rung}"
+        )
+    print(
+        f"search-smoke: winner {srch.winner} (severity "
+        f"{srch.winner_severity:.5f}) in {traces} engine trace(s) "
+        f"for {spec.rungs} rungs"
+    )
+    assert srch.winner == best, (
+        f"planted best {best} must win, got {srch.winner}"
+    )
+    assert srch.traces <= spec.rungs and traces <= spec.rungs, (
+        f"a bracket compiles at most once per rung "
+        f"(recorded {traces}, reported {srch.traces})"
+    )
+    cfg = srch.winner_config()
+    assert cfg["candidate"] == best
+    assert abs(cfg["error_scale"] - float(err[best])) < 1e-12, (
+        "winner_config must replay the planted candidate's scales"
+    )
+
+    traces1 = telemetry.counter_get("engine_traces")
+    sim.run_search(
+        load, n, jax.random.fold_in(key, 1), spec, block_size=block
+    )
+    re_traces = int(telemetry.counter_get("engine_traces") - traces1)
+    assert re_traces == 0, (
+        f"the second bracket must reuse every rung's compile "
+        f"(got {re_traces} new traces)"
+    )
+    print("search-smoke: second bracket: 0 new traces "
+          "(the cache serves every rung shape)")
+
+    # -- 3. rung 0 == the plain screening fleet, bit for bit ----------
+    rung0 = srch.rungs[0]
+    ens = sim.run_ensemble(
+        load, rung0.cum_requests, key, pop, block_size=block
+    )
+    for row, cand in enumerate(int(x) for x in rung0.candidates):
+        for f in EXACT_FIELDS:
+            a = np.asarray(getattr(rung0.summaries, f)[row])
+            b = np.asarray(getattr(ens.summaries, f)[cand])
+            assert np.array_equal(a, b), (
+                f"rung 0 row {row} (candidate {cand}) diverged from "
+                f"the plain fleet on {f}"
+            )
+    print("search-smoke: rung 0 bit-equals the plain "
+          f"{cands}-member fleet on {len(EXACT_FIELDS)} exact fields")
+
+    # -- 4. the winner's carried segments replay the unbroken run -----
+    full = sim.run_ensemble(load, n, key, pop, block_size=block)
+    won = srch.winner_summary()
+    for f in EXACT_FIELDS:
+        a = np.asarray(getattr(won, f))
+        b = np.asarray(getattr(full.summaries, f)[best])
+        assert np.array_equal(a, b), (
+            f"winner's carry-continued {f} diverged from the "
+            "unbroken member"
+        )
+    a = float(np.asarray(won.latency_sum))
+    b = float(np.asarray(full.summaries.latency_sum)[best])
+    assert abs(a - b) <= 1e-5 * max(abs(b), 1.0), (
+        "winner's latency_sum drifted beyond reduction-order noise"
+    )
+    print("search-smoke: winner's carry-continued trajectory "
+          "replays the unbroken member bit-for-bit")
+    print("search-smoke: PASS")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
