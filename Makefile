@@ -9,7 +9,7 @@ DURATION ?= 120s
 .PHONY: test lint vet-smoke bench telemetry-smoke resilience-smoke \
 	attribution-smoke sparse-smoke timeline-smoke multihost-smoke \
 	policies-smoke rollout-smoke lb-smoke ensemble-smoke \
-	chaosfleet-smoke search-smoke examples \
+	chaosfleet-smoke search-smoke explain-smoke examples \
 	canonical tree star multitier auxiliary-services star-auxiliary \
 	latency cpu_mem dot clean
 
@@ -218,6 +218,15 @@ chaosfleet-smoke:
 # replay the unbroken full-horizon member exactly
 search-smoke:
 	$(PY) tools/search_smoke.py
+
+# fleet-observability end-to-end check (PR 17): a fleet with a
+# planted slow-hop member (3/4 worker replicas killed at 0.3s) runs
+# blame + recorder through ONE dispatch; the fleet-blame artifact +
+# `isotope-tpu explain` must name the hop, the onset window, and the
+# band departure from the artifact alone, and the worst member's
+# blame must replay solo
+explain-smoke:
+	$(PY) tools/explain_smoke.py
 
 examples:
 	$(PY) tools/gen_examples.py

@@ -329,6 +329,59 @@ def _case_timeline_overhead(sim, load, n, block, iters=2) -> float:
     return (t_on - t_off) / max(t_off, 1e-9)
 
 
+def _case_fleet_blame_overhead(sim, spec, load, n, block,
+                               iters=2) -> float:
+    """Steady-state overhead of the FLEET attribution pass (PR 17):
+    timed windows of ``run_ensemble(attribution=True)`` vs the plain
+    fleet on the same sim/load/population shape.
+
+    Symmetric double-warm probe (the ``_case_timeline_overhead``
+    discipline): BOTH sides run on freshly rebuilt Simulators — each
+    side pays its own compile in the warm calls, each side times the
+    same member count — so the delta isolates the stacked blame
+    carry + readback cost, not a cold-vs-warm artifact.  Lands in the
+    capture as ``ensembleN_blame_overhead``; ``tools/bench_regress.py``
+    gates it opt-in (``BENCH_REGRESS_FLEETBLAME_THRESHOLD``) and
+    excludes it from the plain rate comparison.
+    """
+    import dataclasses
+
+    import jax
+
+    from isotope_tpu.sim.engine import Simulator
+
+    osim = Simulator(sim.compiled, sim.params)
+    asim = Simulator(
+        sim.compiled,
+        dataclasses.replace(sim.params, attribution=True),
+    )
+    key = jax.random.PRNGKey(17)
+
+    def timed(fn, windows=3):
+        for i in range(2):
+            s = fn(jax.random.fold_in(key, 900 + i))
+        jax.block_until_ready(s.summaries.count)
+        best = float("inf")
+        for w in range(windows):
+            t0 = time.perf_counter()
+            for i in range(iters):
+                s = fn(jax.random.fold_in(key, w * iters + i))
+            jax.block_until_ready(s.summaries.count)
+            best = min(best, time.perf_counter() - t0)
+        return best
+
+    t_off = timed(
+        lambda k: osim.run_ensemble(load, n, k, spec,
+                                    block_size=block)
+    )
+    t_on = timed(
+        lambda k: asim.run_ensemble(load, n, k, spec,
+                                    block_size=block,
+                                    attribution=True)
+    )
+    return (t_on - t_off) / max(t_off, 1e-9)
+
+
 def run_case(name: str) -> dict:
     """Build and measure ONE case; returns {"median", "spread", ...}.
 
@@ -489,6 +542,22 @@ def run_case(name: str) -> dict:
         out[f"{name}_ensemble_speedup"] = round(
             med / max(solo_best, 1e-9), 3
         )
+
+        # fleet blame-pass overhead probe (PR 17): attribution ON vs
+        # OFF over the same fleet shape, bounded to a small member
+        # count so the probe's extra compiles stay cheap relative to
+        # the case.  BENCH_FLEETBLAME=0 disables.
+        if os.environ.get("BENCH_FLEETBLAME", "1") not in ("0", "off"):
+            try:
+                probe_spec = EnsembleSpec.of(min(members, 32))
+                out[f"{name}_blame_overhead"] = round(
+                    _case_fleet_blame_overhead(
+                        sim, probe_spec, load_e, n_e, b_e
+                    ),
+                    4,
+                )
+            except Exception:  # pragma: no cover - capture survival
+                pass
     elif name == "search64":
         # on-device config search (sim/search.py): a 64-candidate
         # successive-halving bracket over svc1000 — eta=4, 3 rungs
@@ -1059,6 +1128,7 @@ def main() -> None:
     extra_out = {
         k: (round(v) if isinstance(v, float)
             and not k.endswith(("_spread", "_timeline_overhead",
+                                "_blame_overhead",
                                 "_mesh_layout_score"))
             else v)
         for k, v in extra.items()

@@ -567,6 +567,32 @@ def protected_carry_bytes(sim, num_windows: int,
     return total
 
 
+def observability_carry_bytes(sim, attr: bool = False,
+                              timeline_windows: Optional[int] = None
+                              ) -> float:
+    """Per-member bytes of an OBSERVED fleet's stacked observability
+    carry (engine ``_ensemble_member_fn`` with attribution / timeline
+    armed, ``_protected_member_fn`` with attribution armed): the
+    blame reduction's exemplar state plus its reduced
+    ``AttributionSummary`` leaves (5 scalars, 11 per-hop vectors, two
+    ``(S, 64)`` blame histograms), and the flight recorder's windowed
+    accumulator — the VET-M006 accounting.  All f32."""
+    from isotope_tpu.metrics.attribution import NUM_BLAME_BUCKETS
+
+    total = 0.0
+    if attr:
+        s = max(sim.compiled.num_services, 1)
+        h = max(sim.compiled.num_hops, 1)
+        k = max(int(getattr(sim.params, "attribution_top_k", 0)), 0)
+        # reduced summary leaves + the top-K exemplar carry
+        # (ExemplarBatch: 3 (K,) + 4 (K, H))
+        total += 4.0 * (5 + 11 * h + 2 * s * NUM_BLAME_BUCKETS)
+        total += 4.0 * (k * (3 + 4 * h))
+    if timeline_windows is not None:
+        total += timeline_bytes(sim, num_windows=timeline_windows)
+    return total
+
+
 def ensemble_chunk(
     members: int,
     peak_bytes_per_member: float,
@@ -670,6 +696,45 @@ def protected_ensemble_findings(
         f"carry (> the {budget:.3g} B budget); the fleet will run in "
         f"member chunks of {chunk} — shrink the block, the window "
         "count, or the fleet to run it in one dispatch",
+    )]
+
+
+def observed_ensemble_findings(
+    estimate: CostEstimate,
+    members: int,
+    obs_carry_bytes: float,
+    base_carry_bytes: float = 0.0,
+) -> List[Finding]:
+    """The VET-M006 verdict: an OBSERVED fleet (attribution and/or
+    timeline threaded through the member axis) whose members' event
+    tensors PLUS stacked observability carries — blame histograms,
+    exemplar state, windowed recorder accumulators
+    (:func:`observability_carry_bytes`) — exceed the device budget.
+    WARN, never blocking: the engine pre-computes the carry-aware
+    member chunk (``Simulator.ensemble_chunk_size`` /
+    ``protected_ensemble_chunk``) and splits the fleet."""
+    cap = estimate.capacity_bytes
+    members = int(members)
+    obs = max(float(obs_carry_bytes), 0.0)
+    if members <= 1 or cap is None or cap <= 0 or obs <= 0:
+        return []
+    peak = estimate.peak_bytes_at_block
+    carry = obs + max(float(base_carry_bytes), 0.0)
+    budget = CAPACITY_FILL * cap
+    need = members * (peak + carry)
+    if need <= budget:
+        return []
+    chunk = ensemble_chunk(
+        members, peak, cap, carry_bytes_per_member=carry
+    )
+    return [Finding(
+        "VET-M006", SEV_WARN,
+        f"observed fleet of {members} members needs {need:.3g} B "
+        f"including {obs:.3g} B/member of stacked blame/timeline "
+        f"carry (> the {budget:.3g} B budget); the fleet will run in "
+        f"member chunks of {chunk} — shrink the block, the window "
+        "count, or the fleet, or drop attribution/timeline, to run "
+        "it in one dispatch",
     )]
 
 

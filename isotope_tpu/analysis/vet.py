@@ -182,13 +182,43 @@ def vet_simulator(
                 report.extend(costmodel.ensemble_findings(
                     est, ensemble.members,
                 ))
+            # VET-M006: an OBSERVED fleet (attribution / timeline
+            # armed on the sim params) stacks per-member blame
+            # histograms and window series on top of the event
+            # tensors; the protected carry above already counts the
+            # recorder, so only the attribution part adds there
+            obs_carry = 0.0
+            if sim.params.attribution or (
+                sim.params.timeline and not protected
+            ):
+                obs_windows = None
+                if sim.params.timeline and not protected:
+                    from isotope_tpu.metrics.timeline import (
+                        plan_windows,
+                    )
+
+                    obs_windows, _, _ = plan_windows(
+                        getattr(load, "duration_s", 0.0) or 1.0,
+                        sim.params.timeline_window_s,
+                        sim.params.timeline_max_windows,
+                        sim.compiled.num_services,
+                        log=lambda m: None,
+                    )
+                obs_carry = costmodel.observability_carry_bytes(
+                    sim, attr=bool(sim.params.attribution),
+                    timeline_windows=obs_windows,
+                )
+                report.extend(costmodel.observed_ensemble_findings(
+                    est, ensemble.members, obs_carry,
+                    base_carry_bytes=carry,
+                ))
             report.meta["ensemble"] = {
                 "members": ensemble.members,
                 "protected": bool(protected),
                 "chunk": costmodel.ensemble_chunk(
                     ensemble.members, est.peak_bytes_at_block,
                     est.capacity_bytes,
-                    carry_bytes_per_member=carry,
+                    carry_bytes_per_member=carry + obs_carry,
                 ),
             }
         if split_spec is not None:

@@ -13,6 +13,7 @@ def register_all(sub) -> None:
     # handlers (so --help stays instant); a jax-less environment gets a
     # clean error at run time from _require_jax, not a hidden subcommand.
     from isotope_tpu.commands import (
+        explain_cmd,
         fidelity_cmd,
         search_cmd,
         simulate_cmd,
@@ -28,4 +29,5 @@ def register_all(sub) -> None:
     telemetry_cmd.register(sub)
     timeline_cmd.register(sub)
     search_cmd.register(sub)
+    explain_cmd.register(sub)
     vet_cmd.register(sub)

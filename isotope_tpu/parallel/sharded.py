@@ -630,40 +630,80 @@ class ShardedSimulator:
 
     def _plan_ensemble(self, load, num_requests: int, key, spec,
                        block_size: int, trim: bool, member_keys,
-                       member_qps=None, member_chaos=None):
+                       member_qps=None, member_chaos=None,
+                       attribution: bool = False, tail: bool = False,
+                       tail_cut=None, timeline: bool = False,
+                       window_s=None):
         """Resolve (spec, tables, stacked args, members-per-shard) for
         one fleet dispatch.  Each member is a FULL run of
         ``num_requests`` — the mesh parallelizes the member axis, not
         the request stream, so a member's physics (and bits) are the
-        single-device member program's."""
+        single-device member program's.  Attribution / timeline arm
+        the fleet observability pass (PR 17): the stacked tail-cut
+        argument rides between the 10 standard member args and the
+        chaos rows, exactly the engine's calling convention."""
         from isotope_tpu.compiler.compile import compile_ensemble
         from isotope_tpu.sim import ensemble as ens_mod
 
+        sim = self.sim
+        if attribution and not sim.params.attribution:
+            raise ValueError(
+                "attributed fleets need SimParams(attribution=True)"
+            )
+        if timeline and not sim.params.timeline:
+            raise ValueError(
+                "timeline fleets need SimParams(timeline=True)"
+            )
+        if attribution and tail and tail_cut is None:
+            # ONE pilot (on the fleet key) serves every member — and
+            # both the mesh path and the emulated twin, so their cut
+            # (and bits) agree
+            tail_cut = sim.estimate_tail_cut(
+                load, num_requests, key, block_size=block_size
+            )
         if spec is None:
-            if self.sim.params.ensemble <= 0:
+            if sim.params.ensemble <= 0:
                 raise ValueError(
                     "run_ensemble needs an EnsembleSpec (or "
                     "SimParams.ensemble > 0 for the seeds-only "
                     "default fleet)"
                 )
-            spec = ens_mod.EnsembleSpec.of(self.sim.params.ensemble)
+            spec = ens_mod.EnsembleSpec.of(sim.params.ensemble)
         spec.check(allow_duplicate_seeds=member_keys is not None)
-        self.sim._check_lb_load(load)
+        sim._check_lb_load(load)
         tables = compile_ensemble(spec)
-        if member_chaos is not None and self.sim._saturated(load):
+        if member_chaos is not None and sim._saturated(load):
             raise ValueError(
                 "per-member chaos does not support saturated -qps "
                 "max loads (host-constant finite-population tables)"
             )
         member_events, planners, chaos_fx = (
-            self.sim._resolve_member_chaos(member_chaos, spec.seeds)
+            sim._resolve_member_chaos(member_chaos, spec.seeds)
         )
-        chaos_args = self.sim._chaos_fx_args(chaos_fx, with_pol=False)
-        args = self.sim._ensemble_args(
+        chaos_args = sim._chaos_fx_args(chaos_fx, with_pol=False)
+        args = sim._ensemble_args(
             load, num_requests, key, spec, tables,
             member_keys=member_keys, block_size=block_size, trim=trim,
             member_qps=member_qps, planners=planners,
         )
+        attr_mode = (
+            ("tail" if tail else "mean") if attribution else None
+        )
+        tl_plan = (
+            sim.plan_timeline_windows(
+                args["num_blocks"] * args["block"],
+                float(args["offered"][0]), window_s,
+            )
+            if timeline else None
+        )
+        cut_arg = ()
+        if attribution:
+            cut_arg = (jnp.full(
+                (spec.members,),
+                tail_cut if (tail and tail_cut is not None)
+                else np.inf,
+                jnp.float32,
+            ),)
         per_shard = -(-spec.members // self.n_shards)
         # member chunking, mesh edition: per_shard members ride EACH
         # device, so the solo path's capacity pre-check applies to the
@@ -672,14 +712,17 @@ class ShardedSimulator:
         # promises, not an OOM)
         width = spec.chunk
         if width is None:
-            width = self.sim.ensemble_chunk_size(
-                per_shard, args["block"]
+            width = sim.ensemble_chunk_size(
+                per_shard, args["block"], attr=attribution,
+                timeline_windows=(
+                    tl_plan[0] if tl_plan is not None else None
+                ),
             )
         width = max(1, min(int(width), per_shard))
         rounds = -(-per_shard // width)
         width = -(-per_shard // rounds)  # balanced rounds
-        return (spec, tables, args, width, rounds, chaos_args,
-                member_events)
+        return (spec, tables, args, width, rounds, cut_arg,
+                chaos_args, member_events, attr_mode, tl_plan)
 
     def _ensemble_padded(self, args, n_mem: int, width: int,
                          rounds: int, chaos_args=()):
@@ -720,6 +763,11 @@ class ShardedSimulator:
         member_keys=None,
         member_qps=None,
         member_chaos=None,
+        attribution: bool = False,
+        tail: bool = False,
+        tail_cut=None,
+        timeline: bool = False,
+        window_s=None,
     ):
         """The Monte Carlo fleet sharded over the mesh: the member
         axis distributes over the FLATTENED device list (every mesh
@@ -736,14 +784,26 @@ class ShardedSimulator:
         per-shard vmapped program serially on one device
         (tests/test_ensemble.py) — the OOM-degradation rung and the
         laptop twin of a pod-scale fleet.
+
+        ``attribution``/``timeline`` arm the fleet observability pass
+        (PR 17): each member accumulates its own critical-path blame
+        and window series INSIDE the sharded member body, stacked
+        along the member axis like the summaries — member k's blame
+        is bit-identical to its solo ``run_attributed`` (and to the
+        emulated twin's).  ``tail=True`` blames only requests above
+        ``tail_cut`` seconds (one pilot run on the fleet key estimates
+        it when unset).
         """
         self._require_mesh("run_ensemble")
-        (spec, tables, args, width, rounds, chaos_args,
-         member_events) = self._plan_ensemble(
+        (spec, tables, args, width, rounds, cut_arg, chaos_args,
+         member_events, attr_mode, tl_plan) = self._plan_ensemble(
             load, num_requests, key, spec, block_size, trim,
             member_keys, member_qps, member_chaos,
+            attribution=attribution, tail=tail, tail_cut=tail_cut,
+            timeline=timeline, window_s=window_s,
         )
         n_mem = spec.members
+        observed = attribution or timeline
         telemetry.counter_inc("sharded_ensemble_runs")
         telemetry.gauge_set("ensemble_members", n_mem)
         telemetry.gauge_set("ensemble_members_per_shard", width)
@@ -751,10 +811,11 @@ class ShardedSimulator:
         fn = self._get_ensemble_fn(
             args, width, tables, trim,
             member_chaos=len(chaos_args) > 0,
-            n_extra=len(chaos_args),
+            n_extra=len(cut_arg) + len(chaos_args),
+            attr=attr_mode, tl_plan=tl_plan,
         )
         padded = self._ensemble_padded(
-            args, n_mem, width, rounds, chaos_args
+            args, n_mem, width, rounds, cut_arg + chaos_args
         )
         faults.check("sharded.compute")
         if self.dcn_axes:
@@ -767,8 +828,16 @@ class ShardedSimulator:
             if rounds > 1:
                 # serialize rounds: live memory stays bounded by one
                 # round's event tensors (the point of the split)
-                jax.block_until_ready(parts[-1].count)
-        summaries = self.sim._ensemble_concat(parts, n_mem)
+                head = parts[-1][0] if observed else parts[-1]
+                jax.block_until_ready(head.count)
+        out = self.sim._ensemble_concat(parts, n_mem)
+        if observed:
+            summaries = out[0]
+            rest = list(out[1:])
+            tl_stack = rest.pop(0) if timeline else None
+            attr_stack = rest.pop(0) if attribution else None
+        else:
+            summaries, tl_stack, attr_stack = out, None, None
         from isotope_tpu.sim import ensemble as ens_mod
 
         return ens_mod.EnsembleSummary(
@@ -777,19 +846,39 @@ class ShardedSimulator:
             offered_qps=args["offered"],
             chunk=width,
             member_chaos=member_events,
+            timelines=tl_stack,
+            attributions=attr_stack,
         )
+
+    def _attr_out_specs(self, member):
+        """AttributionSummary out-specs with every leaf member-sharded
+        — the exemplar heap rides only when the params reserve slots
+        (matching the member program's ``exemplars=None`` otherwise)."""
+        from isotope_tpu.metrics.attribution import (
+            AttributionSummary, ExemplarBatch,
+        )
+
+        ex = (
+            ExemplarBatch(*([member] * len(ExemplarBatch._fields)))
+            if self.sim.params.attribution_top_k > 0 else None
+        )
+        n = len(AttributionSummary._fields) - 1
+        return AttributionSummary(*([member] * n), exemplars=ex)
 
     def _get_ensemble_fn(self, args, width: int, tables,
                          trim: bool, member_chaos: bool = False,
-                         n_extra: int = 0):
+                         n_extra: int = 0, attr=None, tl_plan=None):
         """Jitted shard_map of the vmapped member program; the member
-        axis (per-shard round width), jitter arming, and per-member
-        chaos arming key the cache."""
+        axis (per-shard round width), jitter arming, per-member chaos
+        arming, and the observability plan (attr mode + timeline grid)
+        key the cache."""
+        from isotope_tpu.metrics.timeline import TimelineSummary
+
         axes = tuple(self.mesh.axis_names)
         cache_key = (args["block"], args["num_blocks"], args["kind"],
                      args["conns"], trim,
                      args["sat"], width, tables.jittered,
-                     tables.mode, member_chaos)
+                     tables.mode, member_chaos, attr, tl_plan)
         full_key = (
             ("sharded-ensemble", self.sim.signature,
              (axes,
@@ -800,18 +889,29 @@ class ShardedSimulator:
         member = self.sim._ensemble_member_fn(
             args["block"], args["num_blocks"], args["kind"],
             args["conns"], trim, args["sat"], tables.jittered,
-            member_chaos=member_chaos,
+            member_chaos=member_chaos, attr=attr, tl_plan=tl_plan,
         )
         if tables.mode == "map":
             def local(*xs):
                 return jax.lax.map(lambda t: member(*t), xs)
         else:
             local = jax.vmap(member)
+        out_specs = self._ensemble_out_specs(axes)
+        if attr is not None or tl_plan is not None:
+            # observed member output: (summary[, timeline][, attr]) —
+            # attribution LAST, the engine member ordering
+            out_specs = (out_specs,)
+            if tl_plan is not None:
+                out_specs += (self._filled_specs(
+                    TimelineSummary, P(axes)
+                ),)
+            if attr is not None:
+                out_specs += (self._attr_out_specs(P(axes)),)
         mapped = _shard_map(
             local,
             mesh=self.mesh,
             in_specs=tuple(P(axes) for _ in range(10 + n_extra)),
-            out_specs=self._ensemble_out_specs(axes),
+            out_specs=out_specs,
         )
         return executable_cache.get_or_build(
             full_key,
@@ -832,6 +932,11 @@ class ShardedSimulator:
         member_keys=None,
         member_qps=None,
         member_chaos=None,
+        attribution: bool = False,
+        tail: bool = False,
+        tail_cut=None,
+        timeline: bool = False,
+        window_s=None,
     ):
         """The fleet's single-device twin: each shard's member slice
         runs through the SAME vmapped member program (the engine's
@@ -840,22 +945,28 @@ class ShardedSimulator:
         program, so this is bit-equal to :meth:`run_ensemble` — works
         over an :class:`~isotope_tpu.parallel.mesh.EmulatedMesh` (any
         host count on one CPU) and serves as the fleet's OOM
-        degradation rung."""
-        (spec, tables, args, width, rounds, chaos_args,
-         member_events) = self._plan_ensemble(
+        degradation rung.  ``attribution``/``timeline`` arm the same
+        fleet observability pass as the mesh path (same member trace,
+        same bits)."""
+        (spec, tables, args, width, rounds, cut_arg, chaos_args,
+         member_events, attr_mode, tl_plan) = self._plan_ensemble(
             load, num_requests, key, spec, block_size, trim,
             member_keys, member_qps, member_chaos,
+            attribution=attribution, tail=tail, tail_cut=tail_cut,
+            timeline=timeline, window_s=window_s,
         )
         n_mem = spec.members
+        observed = attribution or timeline
         telemetry.counter_inc("sharded_ensemble_emulated_runs")
         fn = self.sim._get_ensemble(
             args["block"], args["num_blocks"], args["kind"],
             args["conns"], trim, args["sat"], width,
             tables.jittered, tables.mode,
             member_chaos=len(chaos_args) > 0,
+            attr=attr_mode, tl_plan=tl_plan,
         )
         padded = self._ensemble_padded(
-            args, n_mem, width, rounds, chaos_args
+            args, n_mem, width, rounds, cut_arg + chaos_args
         )
         parts = []
         with telemetry.phase("sharded.emulated"):
@@ -865,9 +976,17 @@ class ShardedSimulator:
                 sl = slice(c * width, (c + 1) * width)
                 out = fn(*(x[sl] for x in padded))
                 # serialize: live memory stays bounded by ONE shard
-                jax.block_until_ready(out.count)
+                head = out[0] if observed else out
+                jax.block_until_ready(head.count)
                 parts.append(out)
-        summaries = self.sim._ensemble_concat(parts, n_mem)
+        out = self.sim._ensemble_concat(parts, n_mem)
+        if observed:
+            summaries = out[0]
+            rest = list(out[1:])
+            tl_stack = rest.pop(0) if timeline else None
+            attr_stack = rest.pop(0) if attribution else None
+        else:
+            summaries, tl_stack, attr_stack = out, None, None
         from isotope_tpu.sim import ensemble as ens_mod
 
         return ens_mod.EnsembleSummary(
@@ -876,6 +995,8 @@ class ShardedSimulator:
             offered_qps=args["offered"],
             chunk=width,
             member_chaos=member_events,
+            timelines=tl_stack,
+            attributions=attr_stack,
         )
 
     # -- search brackets (sim/search.py) --------------------------------
@@ -963,9 +1084,11 @@ class ShardedSimulator:
             for f in cls._fields
         })
 
-    def _protected_ens_out_specs(self, axes, roll: bool):
+    def _protected_ens_out_specs(self, axes, roll: bool,
+                                 attr: bool = False):
         """The protected fleet's output pytree: every leaf carries a
-        leading member axis sharded over the flattened mesh."""
+        leading member axis sharded over the flattened mesh
+        (attribution rides LAST, the engine member ordering)."""
         from isotope_tpu.metrics.timeline import TimelineSummary
 
         member = P(axes)
@@ -985,21 +1108,38 @@ class ShardedSimulator:
             out = out + (
                 self._filled_specs(PolicySummary, member),
             )
+        if attr:
+            out = out + (self._attr_out_specs(member),)
         return out
 
     def _plan_protected_ensemble(self, load, num_requests, key, spec,
                                  block_size, trim, window_s,
                                  member_keys, member_qps,
-                                 member_chaos, roll: bool):
+                                 member_chaos, roll: bool,
+                                 attribution: bool = False,
+                                 tail: bool = False, tail_cut=None):
         """Resolve one protected fleet dispatch: spec/tables/args plus
         the timeline plan and the stacked chaos rows — shared by the
         mesh path and the emulated twin so their member programs are
-        the identical trace."""
+        the identical trace.  ``attribution`` arms the per-member
+        blame pass: the stacked tail-cut argument rides between the
+        10 standard member args and the chaos rows (the engine's
+        calling convention)."""
         from isotope_tpu.compiler.compile import compile_ensemble
         from isotope_tpu.metrics import timeline as timeline_mod
         from isotope_tpu.sim import ensemble as ens_mod
 
         sim = self.sim
+        if attribution and not sim.params.attribution:
+            raise ValueError(
+                "attributed fleets need SimParams(attribution=True)"
+            )
+        if attribution and tail and tail_cut is None:
+            # ONE pilot (on the fleet key) serves every member — and
+            # both the mesh path and the emulated twin
+            tail_cut = sim.estimate_tail_cut(
+                load, num_requests, key, block_size=block_size
+            )
         if spec is None:
             if sim.params.ensemble <= 0:
                 raise ValueError(
@@ -1036,22 +1176,36 @@ class ShardedSimulator:
                 pl._policy_downed_windows(tspec, base_split=roll)
                 for pl in planners
             ]),)
+        attr_mode = (
+            ("tail" if tail else "mean") if attribution else None
+        )
+        cut_arg = ()
+        if attribution:
+            cut_arg = (jnp.full(
+                (spec.members,),
+                tail_cut if (tail and tail_cut is not None)
+                else np.inf,
+                jnp.float32,
+            ),)
         per_shard = -(-spec.members // self.n_shards)
         width = spec.chunk
         if width is None:
             width = sim.protected_ensemble_chunk(
-                per_shard, args["block"], tl_plan, roll
+                per_shard, args["block"], tl_plan, roll,
+                attr=attribution,
             )
         width = max(1, min(int(width), per_shard))
         rounds = -(-per_shard // width)
         width = -(-per_shard // rounds)  # balanced rounds
-        return (spec, tables, args, tl_plan, chaos_args,
-                member_events, width, rounds)
+        return (spec, tables, args, tl_plan, cut_arg, chaos_args,
+                member_events, width, rounds, attr_mode)
 
     def _protected_ens_summary(self, spec, args, out, width,
-                               member_events, roll: bool):
+                               member_events, roll: bool,
+                               attribution: bool = False):
         """Assemble the EnsembleSummary from the concatenated
-        protected fleet output tuple (the engine's unpack order)."""
+        protected fleet output tuple (the engine's unpack order —
+        attribution LAST)."""
         from isotope_tpu.sim import ensemble as ens_mod
 
         summary, tl = out[0], out[1]
@@ -1060,6 +1214,7 @@ class ShardedSimulator:
         pol_stack = (
             rest.pop(0) if self.sim._policies is not None else None
         )
+        attr_stack = rest.pop(0) if attribution else None
         return ens_mod.EnsembleSummary(
             spec=spec,
             summaries=summary,
@@ -1069,13 +1224,15 @@ class ShardedSimulator:
             timelines=tl,
             policies=pol_stack,
             rollouts=roll_stack,
+            attributions=attr_stack,
         )
 
     def run_policies_ensemble(
         self, load, num_requests, key, spec=None, *,
         block_size: int = 65_536, trim: bool = False,
         window_s=None, member_keys=None, member_qps=None,
-        member_chaos=None,
+        member_chaos=None, attribution: bool = False,
+        tail: bool = False, tail_cut=None,
     ):
         """The protected policy fleet sharded over the mesh: the
         member axis distributes over the FLATTENED device list and
@@ -1086,7 +1243,10 @@ class ShardedSimulator:
         whole fleet is bit-equal to
         :meth:`run_policies_ensemble_emulated` (pinned).  Unlike the
         request-sharded :meth:`run_policies` there is NO svc=1 mesh
-        restriction: members are whole worlds."""
+        restriction: members are whole worlds.  ``attribution`` arms
+        the per-member critical-path blame pass (PR 17) — stacked
+        like the summaries, bit-identical to each member's solo
+        ``run_policies(attribution=True)``."""
         self._require_mesh("run_policies_ensemble")
         if self.sim._policies is None:
             raise ValueError(
@@ -1102,18 +1262,21 @@ class ShardedSimulator:
         return self._run_protected_ensemble_device(
             load, num_requests, key, spec, block_size, trim,
             window_s, member_keys, member_qps, member_chaos,
-            roll=False,
+            roll=False, attribution=attribution, tail=tail,
+            tail_cut=tail_cut,
         )
 
     def run_rollouts_ensemble(
         self, load, num_requests, key, spec=None, *,
         block_size: int = 65_536, trim: bool = False,
         window_s=None, member_keys=None, member_qps=None,
-        member_chaos=None,
+        member_chaos=None, attribution: bool = False,
+        tail: bool = False, tail_cut=None,
     ):
         """The progressive-delivery fleet sharded over the mesh (see
         :meth:`run_policies_ensemble` — member-axis sharding, zero
-        collectives, bit-equal emulated twin)."""
+        collectives, bit-equal emulated twin, optional per-member
+        blame via ``attribution``)."""
         self._require_mesh("run_rollouts_ensemble")
         if self.sim._rollouts is None:
             raise ValueError(
@@ -1130,18 +1293,26 @@ class ShardedSimulator:
         return self._run_protected_ensemble_device(
             load, num_requests, key, spec, block_size, trim,
             window_s, member_keys, member_qps, member_chaos,
-            roll=True,
+            roll=True, attribution=attribution, tail=tail,
+            tail_cut=tail_cut,
         )
 
     def _run_protected_ensemble_device(self, load, num_requests, key,
                                        spec, block_size, trim,
                                        window_s, member_keys,
                                        member_qps, member_chaos,
-                                       roll: bool):
-        (spec, tables, args, tl_plan, chaos_args, member_events,
-         width, rounds) = self._plan_protected_ensemble(
-            load, num_requests, key, spec, block_size, trim,
-            window_s, member_keys, member_qps, member_chaos, roll,
+                                       roll: bool,
+                                       attribution: bool = False,
+                                       tail: bool = False,
+                                       tail_cut=None):
+        (spec, tables, args, tl_plan, cut_arg, chaos_args,
+         member_events, width, rounds, attr_mode) = (
+            self._plan_protected_ensemble(
+                load, num_requests, key, spec, block_size, trim,
+                window_s, member_keys, member_qps, member_chaos,
+                roll, attribution=attribution, tail=tail,
+                tail_cut=tail_cut,
+            )
         )
         n_mem = spec.members
         telemetry.counter_inc(
@@ -1156,7 +1327,7 @@ class ShardedSimulator:
         cache_key = ("prot-ens", args["block"], args["num_blocks"],
                      args["kind"], args["conns"], trim, tl_plan,
                      roll, width, tables.jittered, tables.mode,
-                     member_chaos_on)
+                     member_chaos_on, attr_mode)
         full_key = (
             ("sharded-ensemble", self.sim.signature,
              (axes,
@@ -1167,19 +1338,21 @@ class ShardedSimulator:
         member = self.sim._protected_member_fn(
             args["block"], args["num_blocks"], args["kind"],
             args["conns"], trim, tl_plan, roll, tables.jittered,
-            member_chaos_on,
+            member_chaos_on, attr=attr_mode,
         )
         if tables.mode == "map":
             def local(*xs):
                 return jax.lax.map(lambda t: member(*t), xs)
         else:
             local = jax.vmap(member)
-        n_args = 10 + len(chaos_args)
+        n_args = 10 + len(cut_arg) + len(chaos_args)
         mapped = _shard_map(
             local,
             mesh=self.mesh,
             in_specs=tuple(P(axes) for _ in range(n_args)),
-            out_specs=self._protected_ens_out_specs(axes, roll),
+            out_specs=self._protected_ens_out_specs(
+                axes, roll, attr=attribution
+            ),
         )
         fn = executable_cache.get_or_build(
             full_key,
@@ -1188,7 +1361,8 @@ class ShardedSimulator:
             ),
         )
         padded = self.sim._ensemble_pad_args(
-            self.sim._ensemble_stacked_args(args) + chaos_args,
+            self.sim._ensemble_stacked_args(args) + cut_arg
+            + chaos_args,
             n_mem, rounds * width * self.n_shards,
         )
         faults.check("sharded.compute")
@@ -1203,14 +1377,16 @@ class ShardedSimulator:
                 jax.block_until_ready(parts[-1][0].count)
         out = self.sim._ensemble_concat(parts, n_mem)
         return self._protected_ens_summary(
-            spec, args, out, width, member_events, roll
+            spec, args, out, width, member_events, roll,
+            attribution=attribution,
         )
 
     def run_policies_ensemble_emulated(
         self, load, num_requests, key, spec=None, *,
         block_size: int = 65_536, trim: bool = False,
         window_s=None, member_keys=None, member_qps=None,
-        member_chaos=None,
+        member_chaos=None, attribution: bool = False,
+        tail: bool = False, tail_cut=None,
     ):
         """The protected fleet's single-device twin: each shard's
         member slice runs through the engine's own protected fleet
@@ -1227,14 +1403,16 @@ class ShardedSimulator:
         return self._run_protected_ensemble_emulated(
             load, num_requests, key, spec, block_size, trim,
             window_s, member_keys, member_qps, member_chaos,
-            roll=False,
+            roll=False, attribution=attribution, tail=tail,
+            tail_cut=tail_cut,
         )
 
     def run_rollouts_ensemble_emulated(
         self, load, num_requests, key, spec=None, *,
         block_size: int = 65_536, trim: bool = False,
         window_s=None, member_keys=None, member_qps=None,
-        member_chaos=None,
+        member_chaos=None, attribution: bool = False,
+        tail: bool = False, tail_cut=None,
     ):
         """The rollout fleet's single-device twin (see
         :meth:`run_policies_ensemble_emulated`)."""
@@ -1246,18 +1424,26 @@ class ShardedSimulator:
         return self._run_protected_ensemble_emulated(
             load, num_requests, key, spec, block_size, trim,
             window_s, member_keys, member_qps, member_chaos,
-            roll=True,
+            roll=True, attribution=attribution, tail=tail,
+            tail_cut=tail_cut,
         )
 
     def _run_protected_ensemble_emulated(self, load, num_requests,
                                          key, spec, block_size, trim,
                                          window_s, member_keys,
                                          member_qps, member_chaos,
-                                         roll: bool):
-        (spec, tables, args, tl_plan, chaos_args, member_events,
-         width, rounds) = self._plan_protected_ensemble(
-            load, num_requests, key, spec, block_size, trim,
-            window_s, member_keys, member_qps, member_chaos, roll,
+                                         roll: bool,
+                                         attribution: bool = False,
+                                         tail: bool = False,
+                                         tail_cut=None):
+        (spec, tables, args, tl_plan, cut_arg, chaos_args,
+         member_events, width, rounds, attr_mode) = (
+            self._plan_protected_ensemble(
+                load, num_requests, key, spec, block_size, trim,
+                window_s, member_keys, member_qps, member_chaos,
+                roll, attribution=attribution, tail=tail,
+                tail_cut=tail_cut,
+            )
         )
         n_mem = spec.members
         telemetry.counter_inc(
@@ -1268,9 +1454,11 @@ class ShardedSimulator:
             args["block"], args["num_blocks"], args["kind"],
             args["conns"], trim, tl_plan, roll, width,
             tables.jittered, tables.mode, len(chaos_args) > 0,
+            attr=attr_mode,
         )
         padded = self.sim._ensemble_pad_args(
-            self.sim._ensemble_stacked_args(args) + chaos_args,
+            self.sim._ensemble_stacked_args(args) + cut_arg
+            + chaos_args,
             n_mem, rounds * width * self.n_shards,
         )
         parts = []
@@ -1284,7 +1472,8 @@ class ShardedSimulator:
                 parts.append(out)
         out = self.sim._ensemble_concat(parts, n_mem)
         return self._protected_ens_summary(
-            spec, args, out, width, member_events, roll
+            spec, args, out, width, member_events, roll,
+            attribution=attribution,
         )
 
     # -- attributed runs (metrics/attribution.py) -----------------------

@@ -111,6 +111,11 @@ class RunResult:
     # to the solo path
     ensemble: Optional[dict] = None
     ensemble_summary: Optional[object] = None
+    # fleet divergence explainer (metrics/fleetblame.py): the
+    # fleet-blame.json doc (isotope-fleet-blame/v1: per-hop blame
+    # bands, per-member top-K blamed hops, divergence onsets); None
+    # when the fleet carried no attribution
+    fleet_blame: Optional[dict] = None
     # on-device config search (sim/search.py): the search.json doc
     # (isotope-search/v1: winner config + per-rung lineage of the
     # successive-halving bracket) ; None when the [search] block was
@@ -369,9 +374,13 @@ class _EnsembleGroups:
         return group if any(c["label"] == label for c in group) else me
 
     def run(self, label, topo_path, env_name, load, sim, sharded,
-            use_sharded, n, block):
+            use_sharded, n, block, attribution=None, timeline=None):
         """This cell's EnsembleSummary (dispatching its whole
-        same-shape group on first touch)."""
+        same-shape group on first touch).  ``attribution`` (``"on"`` /
+        ``"tail"``) and ``timeline`` (a window width) thread the fleet
+        observability pass (PR 17) through the SAME dispatch — blame
+        and window series accumulate per member inside the fleet
+        program instead of a separate solo pass."""
         import numpy as np
 
         from isotope_tpu.sim.ensemble import (
@@ -423,23 +432,38 @@ class _EnsembleGroups:
             qps_arg = np.asarray(member_qps)
         runner = sharded if (use_sharded and sharded is not None) \
             else sim
+        obs_kw = {}
+        if attribution is not None:
+            obs_kw.update(
+                attribution=True, tail=attribution == "tail",
+            )
+        if timeline is not None:
+            obs_kw.update(timeline=True, window_s=float(timeline))
         ens = runner.run_ensemble(
             load, n, jax.random.fold_in(self.key, group[0]["idx"]),
             group_spec, block_size=block, trim=True,
-            member_keys=member_keys, member_qps=qps_arg,
+            member_keys=member_keys, member_qps=qps_arg, **obs_kw,
         )
         # served cells leave the grouping pool: a later cell's group
         # must never re-dispatch members whose results already landed
         self.completed.update(c["label"] for c in group)
         for i, c in enumerate(group):
             sl = slice(i * n_seeds, (i + 1) * n_seeds)
+
+            def cell(stacked, sl=sl):
+                if stacked is None:
+                    return None
+                return jax.tree.map(
+                    lambda x: np.asarray(x)[sl], stacked
+                )
+
             self.results[c["label"]] = EnsembleSummary(
                 spec=spec,
-                summaries=jax.tree.map(
-                    lambda x: np.asarray(x)[sl], ens.summaries
-                ),
+                summaries=cell(ens.summaries),
                 offered_qps=np.asarray(ens.offered_qps)[sl],
                 chunk=ens.chunk,
+                timelines=cell(ens.timelines),
+                attributions=cell(ens.attributions),
             )
         if len(group) > 1:
             telemetry.counter_inc("ensemble_group_dispatches")
@@ -813,7 +837,8 @@ def _protected_window_block(sim, load, block, config, timeline,
 
 def _protected_ensemble_run(sim, sharded, use_sharded, load, n,
                             run_key, block, config, timeline,
-                            tables_roll, ens_spec, chaos_jitter):
+                            tables_roll, ens_spec, chaos_jitter,
+                            attribution=None):
     """The protected Monte Carlo fleet for one case (PR 15): N
     members of ``run_policies`` / ``run_rollouts`` behind one jitted
     program per device — the PROTECTED physics measured
@@ -823,7 +848,10 @@ def _protected_ensemble_run(sim, sharded, use_sharded, load, n,
     protected run the pre-fleet runner would have executed (members
     1..N-1 fold their seeds and survive their own jittered bad days).
     ``chaos_jitter`` applies to policy fleets only — the rollout
-    kill-split tables are trace constants."""
+    kill-split tables are trace constants.  ``attribution`` (``"on"``
+    / ``"tail"``, PR 17) threads the per-member blame pass through
+    the SAME fleet dispatch — no separate solo pass, and the worst
+    member's blame lands in the postmortem."""
     roll = tables_roll is not None
     win, block = _protected_window_block(
         sim, load, block, config, timeline
@@ -853,11 +881,14 @@ def _protected_ensemble_run(sim, sharded, use_sharded, load, n,
         runner,
         "run_rollouts_ensemble" if roll else "run_policies_ensemble",
     )
+    obs_kw = {}
+    if attribution is not None:
+        obs_kw = dict(attribution=True, tail=attribution == "tail")
     with telemetry.phase("ensemble.run"):
         ens = method(
             load, n, run_key, ens_spec, block_size=block, trim=True,
             window_s=win, member_keys=member_keys,
-            member_chaos=member_chaos,
+            member_chaos=member_chaos, **obs_kw,
         )
         jax.block_until_ready(ens.summaries.count)
     telemetry.counter_inc("protected_fleet_cases")
@@ -997,7 +1028,16 @@ def run_experiment(
     ``config.timeline``) runs a flight-recorder pass per case: the
     windowed series ride ``RunResult.timeline`` and, with an output
     directory, a ``<label>.timeline.json`` artifact the ``report``
-    command renders as per-run sparklines."""
+    command renders as per-run sparklines.
+
+    Fleet-served cases (the ensemble axis armed) thread BOTH passes
+    through the fleet dispatch itself (PR 17): blame and window
+    series accumulate per member inside the fleet program, the worst
+    member's become the case's blame/timeline docs (stamped with
+    member + seed), and the cross-member divergence explanation lands
+    in ``<label>.fleet-blame.json``
+    (``isotope-fleet-blame/v1`` — the ``explain`` subcommand's
+    input)."""
     from isotope_tpu.analysis.vet import vet_mode
 
     vet = vet_mode(vet)
@@ -1195,6 +1235,8 @@ def run_experiment(
                                             env.name, load, sim,
                                             sharded, use_sharded, n,
                                             block,
+                                            attribution=attribution,
+                                            timeline=timeline,
                                         )
                                     telemetry.counter_inc(
                                         "ensemble_cases"
@@ -1229,13 +1271,14 @@ def run_experiment(
                                 # bit-equal to the solo protected run,
                                 # and the worst member's artifacts
                                 # become the postmortem.  Attributed
-                                # cases keep the solo path (fleet
-                                # blame is a ROADMAP residual), as do
-                                # memory-degraded ones.
+                                # cases thread the blame pass through
+                                # the SAME fleet dispatch (PR 17 —
+                                # the solo-path detour is deleted);
+                                # memory-degraded cases keep the solo
+                                # path.
                                 degraded_to = None
                                 if ens_spec is not None \
-                                        and start_rung == 0 \
-                                        and attribution is None:
+                                        and start_rung == 0:
                                     try:
                                         ens_summary = \
                                             _protected_ensemble_run(
@@ -1247,6 +1290,9 @@ def run_experiment(
                                                 ens_spec,
                                                 config
                                                 .chaos_jitter_spec(),
+                                                attribution=(
+                                                    attribution
+                                                ),
                                             )
                                         prot_fleet = True
                                         summary = \
@@ -1275,6 +1321,28 @@ def run_experiment(
                                                 ens_summary
                                                 .member_rollouts(
                                                     prot_worst
+                                                )
+                                            )
+                                        if ens_summary.attributions \
+                                                is not None:
+                                            # the worst member's
+                                            # blame IS the postmortem
+                                            # blame doc (stamped with
+                                            # member/seed below)
+                                            from isotope_tpu.metrics \
+                                                import attribution \
+                                                as attr_mod
+
+                                            pol_attr = (
+                                                ens_summary
+                                                .member_attribution(
+                                                    prot_worst
+                                                )
+                                            )
+                                            pol_blame = (
+                                                attr_mod.to_doc(
+                                                    topo.compiled,
+                                                    pol_attr,
                                                 )
                                             )
                                         telemetry.counter_inc(
@@ -1378,15 +1446,45 @@ def run_experiment(
                         # same streams/trajectory as the measurement
                         blame_doc, attr_summary = pol_blame, pol_attr
                     elif attribution is not None:
-                        # identical executor/key/blocking to the main
-                        # run, so the attributed pass replays the same
-                        # request streams the reported metrics came
-                        # from
-                        blame_doc, attr_summary = _attribution_pass(
-                            sim, sharded, use_sharded, topo, load, n,
-                            run_key, block,
-                            tail=attribution == "tail",
-                        )
+                        if ens_summary is not None and \
+                                ens_summary.attributions is not None:
+                            # the fleet already carried the blame
+                            # pass per member (PR 17): the worst
+                            # member's blame is the case's blame doc,
+                            # stamped so the bad day replays solo
+                            from isotope_tpu.metrics import (
+                                attribution as attr_mod,
+                            )
+
+                            worst = ens_summary.worst_member()
+                            attr_summary = (
+                                ens_summary.member_attribution(worst)
+                            )
+                            blame_doc = attr_mod.to_doc(
+                                topo.compiled, attr_summary,
+                            )
+                            blame_doc.update({
+                                "member": int(worst),
+                                "member_seed": int(
+                                    ens_summary.spec.seeds[worst]
+                                ),
+                                "fleet_members": (
+                                    ens_summary.members
+                                ),
+                                "worst_member": True,
+                            })
+                        else:
+                            # identical executor/key/blocking to the
+                            # main run, so the attributed pass replays
+                            # the same request streams the reported
+                            # metrics came from
+                            blame_doc, attr_summary = (
+                                _attribution_pass(
+                                    sim, sharded, use_sharded, topo,
+                                    load, n, run_key, block,
+                                    tail=attribution == "tail",
+                                )
+                            )
                     tl_doc = tl_summary = None
                     pol_doc = pol_summary_out = None
                     roll_doc = roll_summary_out = None
@@ -1463,14 +1561,43 @@ def run_experiment(
                                     for ev in ens_summary
                                     .member_chaos[prot_worst]
                                 ]
-                            for d in (tl_doc, pol_doc, roll_doc):
+                            for d in (tl_doc, pol_doc, roll_doc,
+                                      blame_doc):
                                 if d is not None:
                                     d.update(stamp)
                     elif timeline is not None:
-                        tl_doc, tl_summary = _timeline_pass(
-                            sim, sharded, use_sharded, topo, load, n,
-                            run_key, block, window_s=timeline,
-                        )
+                        if ens_summary is not None and \
+                                ens_summary.timelines is not None:
+                            # the fleet already carried the recorder
+                            # per member: the worst member's window
+                            # series is the case's timeline doc
+                            from isotope_tpu.metrics import (
+                                timeline as timeline_mod,
+                            )
+
+                            worst = ens_summary.worst_member()
+                            tl_summary = (
+                                ens_summary.member_timeline(worst)
+                            )
+                            tl_doc = timeline_mod.to_doc(
+                                topo.compiled, tl_summary,
+                            )
+                            tl_doc.update({
+                                "member": int(worst),
+                                "member_seed": int(
+                                    ens_summary.spec.seeds[worst]
+                                ),
+                                "fleet_members": (
+                                    ens_summary.members
+                                ),
+                                "worst_member": True,
+                            })
+                        else:
+                            tl_doc, tl_summary = _timeline_pass(
+                                sim, sharded, use_sharded, topo,
+                                load, n, run_key, block,
+                                window_s=timeline,
+                            )
                     if (
                         topo.lb_tables is not None
                         and topo.lb_tables.active
@@ -1547,6 +1674,7 @@ def run_experiment(
                         flat["_lb"] = True
                         telemetry.set_meta("lb", "on")
                     ens_doc = None
+                    fb_doc = None
                     if ens_summary is not None:
                         # the row POOLS N seed members — a tighter
                         # estimate than a solo run of the same cell,
@@ -1580,6 +1708,54 @@ def run_experiment(
                                 # key, not a folded seed — the
                                 # replay recipe is the solo run
                                 ens_doc["worst_member_seed"] = None
+                        if ens_summary.attributions is not None:
+                            # fleet divergence explainer (PR 17):
+                            # band the per-hop blame shares across
+                            # members, rank who diverged and why,
+                            # localize the window of onset — one
+                            # device reduce, one readback.  Best
+                            # effort: an explainer failure never
+                            # fails a case whose metrics landed.
+                            import numpy as _np
+
+                            from isotope_tpu.metrics import (
+                                fleetblame,
+                            )
+
+                            try:
+                                win_arr = None
+                                if ens_summary.timelines is not None:
+                                    win_arr = float(
+                                        _np.asarray(
+                                            ens_summary.timelines
+                                            .window_s
+                                        ).reshape(-1)[0]
+                                    )
+                                fb_doc = fleetblame.to_doc(
+                                    topo.compiled,
+                                    ens_summary.attributions,
+                                    ens_summary.timelines,
+                                    label=label,
+                                    severity=(
+                                        ens_summary.severity()
+                                    ),
+                                    seeds=ens_summary.spec.seeds,
+                                    window_s=win_arr,
+                                )
+                                flat["_fleet_blame"] = True
+                                telemetry.counter_inc(
+                                    "fleet_blame_docs"
+                                )
+                            except Exception as e:
+                                telemetry.counter_inc(
+                                    "fleet_blame_failures"
+                                )
+                                print(
+                                    f"warning: fleet-blame "
+                                    f"explainer for {label} failed "
+                                    f"({type(e).__name__}: {e})",
+                                    file=sys.stderr,
+                                )
                     search_doc = None
                     if search_spec_cfg is not None \
                             and not protected \
@@ -1677,6 +1853,7 @@ def run_experiment(
                         lb=lb_doc,
                         ensemble=ens_doc,
                         ensemble_summary=ens_summary,
+                        fleet_blame=fb_doc,
                         search=search_doc,
                     )
                     results.append(result)
@@ -1716,6 +1893,12 @@ def run_experiment(
                                 out / f"{label}.ensemble.json", "w"
                             ) as f:
                                 json.dump(ens_doc, f, indent=2)
+                        if fb_doc is not None:
+                            with open(
+                                out / f"{label}.fleet-blame.json",
+                                "w",
+                            ) as f:
+                                json.dump(fb_doc, f, indent=2)
                         if search_doc is not None:
                             with open(
                                 out / f"{label}.search.json", "w"

@@ -2255,7 +2255,9 @@ class Simulator:
                             kind: str, connections: int, trim: bool,
                             sat: bool, jittered: bool,
                             member_chaos: bool = False,
-                            carry_io: bool = False):
+                            carry_io: bool = False,
+                            attr: Optional[str] = None,
+                            tl_plan: Optional[Tuple[int, float]] = None):
         """The ONE-member block-scan program the fleet vmaps.
 
         Body-identical to the plain ``_get_summary`` scan (same
@@ -2273,7 +2275,19 @@ class Simulator:
         ``1_000_000 + b0 + b`` so a member resumed at ``b0`` draws the
         EXACT streams the unbroken run drew for those blocks; with
         ``b0 == 0`` and zero carries the program is value-identical to
-        the plain member (pinned by tests/test_search.py)."""
+        the plain member (pinned by tests/test_search.py).
+
+        ``attr`` / ``tl_plan`` arm the fleet observability pass: the
+        member reduces an ``AttributionSummary`` (blame exemplar state
+        in the scan carry, per-block blame vectors/hists in the
+        stacked ys — the solo ``_get_summary`` attr body) and/or a
+        ``TimelineSummary`` (carry-resident, the PR 7 recorder body),
+        returning ``(summary[, tl][, attr])``.  With ``attr`` the
+        member takes ONE extra traced argument before the chaos rows:
+        its ``tail_cut`` (``+inf`` = mean attribution).  Member k's
+        blame/windows are bit-identical to its solo ``run_attributed``
+        / ``run_timeline`` twin; with both off this member program is
+        the historical one, untouched."""
         from isotope_tpu.sim import summary as summary_mod
 
         if carry_io and member_chaos:
@@ -2281,8 +2295,29 @@ class Simulator:
                 "carry_io fleets (search brackets) do not support "
                 "per-member chaos schedules yet (ROADMAP residual)"
             )
+        if carry_io and (attr is not None or tl_plan is not None):
+            raise ValueError(
+                "carry_io fleets (search brackets) do not carry the "
+                "attribution/timeline reductions (screen first, then "
+                "explain the winner with an observed fleet)"
+            )
         c = max(connections, 1)
         per = block // c
+        observed = attr is not None or tl_plan is not None
+        packed = self.params.packed_carries
+        if attr is not None:
+            from isotope_tpu.metrics import attribution
+
+            # trace constants (tables/top_k) build OUTSIDE the member
+            # body — inside they would be cached as tracers and leak
+            atables = self._attribution_tables()
+            top_k = self.params.attribution_top_k
+        if tl_plan is not None:
+            from isotope_tpu.metrics import timeline as timeline_mod
+
+            tspec = timeline_mod.build_spec(
+                self.compiled, tl_plan[0], tl_plan[1]
+            )
 
         def member_scan(key, offered_qps, pace_gap, nominal_gap,
                         win_lo, win_hi, visits_pc, phase_windows,
@@ -2290,7 +2325,9 @@ class Simulator:
             telemetry.record_trace(
                 ("ensemble", self.signature[3], block, num_blocks,
                  kind, connections, trim, sat, jittered,
-                 member_chaos) + (("carry",) if carry_io else ()),
+                 member_chaos) + (("carry",) if carry_io else ())
+                + ((attr,) if attr is not None else ())
+                + ((tl_plan,) if tl_plan is not None else ()),
                 tracing=isinstance(key, jax.core.Tracer),
                 requests=block * num_blocks,
                 hops=self.compiled.num_hops,
@@ -2300,16 +2337,18 @@ class Simulator:
                 chaos_rows = rest[4:]
             else:
                 b0 = 0
-                chaos_rows = rest
+                if attr is not None:
+                    tail_cut = rest[0]
+                    chaos_rows = rest[1:]
+                else:
+                    chaos_rows = rest
             cfx = (
                 self._member_chaos_fx(chaos_rows)
                 if member_chaos else None
             )
 
-            def body(carry, b):
-                t0, conn_t0, req_off = carry
-                kb = jax.random.fold_in(key, 1_000_000 + b0 + b)
-                res, t_end, conn_end = self._simulate_core(
+            def core(kb, t0, conn_t0, req_off):
+                return self._simulate_core(
                     block, kind, connections, kb, offered_qps,
                     pace_gap, offered_qps, nominal_gap, t0, conn_t0,
                     req_off,
@@ -2320,6 +2359,86 @@ class Simulator:
                     err_scale=err_scale if jittered else None,
                     chaos_fx=cfx,
                 )
+
+            if observed:
+                # fleet observability body: timeline accumulator and
+                # blame exemplar state ride the carry as optional
+                # leaves (absent = None, the _get_protected idiom)
+                def body(carry, b):
+                    (t0, conn_t0, req_off), tl_acc, ex = carry
+                    kb = jax.random.fold_in(key, 1_000_000 + b)
+                    res, t_end, conn_end = core(
+                        kb, t0, conn_t0, req_off
+                    )
+                    s = summary_mod.summarize(
+                        res, None,
+                        window=(win_lo, win_hi) if trim else None,
+                    )
+                    if tl_plan is not None:
+                        tl_acc = timeline_mod.accumulate(
+                            tl_acc,
+                            timeline_mod.timeline_block(
+                                res, tspec, packed=packed
+                            ),
+                        )
+                    ys = s
+                    if attr is not None:
+                        a, ex = attribution.attribute_block(
+                            res, atables,
+                            tail_cut=(
+                                tail_cut if attr == "tail" else None
+                            ),
+                            top_k=top_k, ex_state=ex,
+                            packed=packed,
+                        )
+                        ys = (s, a)
+                    return (
+                        (t_end, conn_end, req_off + per), tl_acc, ex
+                    ), ys
+
+                ex0 = None
+                if attr is not None:
+                    k0 = min(top_k, block) if top_k > 0 else 0
+                    ex0 = (
+                        attribution.empty_exemplars(
+                            k0, self.compiled.num_hops
+                        )
+                        if k0 > 0
+                        else None
+                    )
+                carry0 = (
+                    (
+                        jnp.float32(0.0),
+                        jnp.zeros((c,), jnp.float32),
+                        jnp.float32(0.0),
+                    ),
+                    (
+                        timeline_mod.zeros_summary(tspec, packed=packed)
+                        if tl_plan is not None else None
+                    ),
+                    ex0,
+                )
+                (_, tl_final, ex_final), ys = jax.lax.scan(
+                    body, carry0, jnp.arange(num_blocks)
+                )
+                if attr is not None:
+                    parts, aparts = ys
+                    a_out = attribution.reduce_stacked(
+                        aparts, ex_final
+                    )
+                else:
+                    parts = ys
+                out = (summary_mod.reduce_stacked(parts),)
+                if tl_plan is not None:
+                    out = out + (tl_final,)
+                if attr is not None:
+                    out = out + (a_out,)
+                return out
+
+            def body(carry, b):
+                t0, conn_t0, req_off = carry
+                kb = jax.random.fold_in(key, 1_000_000 + b0 + b)
+                res, t_end, conn_end = core(kb, t0, conn_t0, req_off)
                 s = summary_mod.summarize(
                     res, None,
                     window=(win_lo, win_hi) if trim else None,
@@ -2375,7 +2494,9 @@ class Simulator:
     def _get_ensemble(self, block: int, num_blocks: int, kind: str,
                       connections: int, trim: bool, sat: bool,
                       chunk_members: int, jittered: bool,
-                      mode: str = "vmap", member_chaos: bool = False):
+                      mode: str = "vmap", member_chaos: bool = False,
+                      attr: Optional[str] = None,
+                      tl_plan: Optional[Tuple[int, float]] = None):
         """One jitted fleet program over a ``chunk_members``-wide
         member axis: ``vmap(member_scan)`` (true batch dim — the
         accelerator idiom) or ``lax.map`` over members (serial inside
@@ -2386,11 +2507,13 @@ class Simulator:
         fleet auto-chunked to the same width, reuses ONE compile
         (in-process and through the persistent XLA cache)."""
         cache_key = (block, num_blocks, kind, connections, trim, sat,
-                     chunk_members, jittered, mode, member_chaos)
+                     chunk_members, jittered, mode, member_chaos,
+                     attr, tl_plan)
         if cache_key not in self._ensemble_fns:
             member = self._ensemble_member_fn(
                 block, num_blocks, kind, connections, trim, sat,
-                jittered, member_chaos=member_chaos,
+                jittered, member_chaos=member_chaos, attr=attr,
+                tl_plan=tl_plan,
             )
             if mode == "map":
                 def fleet(*xs):
@@ -2691,18 +2814,29 @@ class Simulator:
             *parts,
         )
 
-    def ensemble_chunk_size(self, members: int, block: int) -> int:
+    def ensemble_chunk_size(self, members: int, block: int,
+                            attr: bool = False,
+                            timeline_windows: Optional[int] = None
+                            ) -> int:
         """The auto member-chunk: how many fleet members fit one
         device dispatch, from the vet cost model's plan-only peak-
         bytes estimate vs device capacity — pre-computed the way the
         VET-M* memory verdict pre-selects degradation-ladder rungs
-        (unknown capacity, e.g. CPU, runs the whole fleet at once)."""
+        (unknown capacity, e.g. CPU, runs the whole fleet at once).
+
+        ``attr`` / ``timeline_windows`` add the stacked fleet
+        observability footprint (members x blame hists + window
+        series — the VET-M006 accounting) to the carry-aware split."""
         from isotope_tpu.analysis import costmodel
 
         cap = costmodel.device_capacity_bytes()
         est = costmodel.estimate_run(self, block)
+        obs = costmodel.observability_carry_bytes(
+            self, attr=attr, timeline_windows=timeline_windows,
+        )
         return costmodel.ensemble_chunk(
-            members, est.peak_bytes_at_block, cap
+            members, est.peak_bytes_at_block, cap,
+            carry_bytes_per_member=obs,
         )
 
     def run_ensemble(
@@ -2722,6 +2856,11 @@ class Simulator:
         carry_in=None,
         return_carry: bool = False,
         block_offset: int = 0,
+        attribution: bool = False,
+        tail: bool = False,
+        tail_cut: Optional[float] = None,
+        timeline: bool = False,
+        window_s: Optional[float] = None,
     ):
         """Simulate a Monte Carlo fleet: N scenario variants in ONE
         jitted program per device (sim/ensemble.py).
@@ -2772,6 +2911,23 @@ class Simulator:
         (``latency_sum``/``latency_m2``) may differ by reduction order
         like :func:`~isotope_tpu.sim.summary.summary_accumulate`.
         These knobs require ``trim=False`` and no ``member_chaos``.
+
+        Fleet observability (metrics/fleetblame.py): ``attribution``
+        (needs ``SimParams.attribution``) reduces each member's
+        critical-path blame inside the same member body — the
+        returned summary's ``attributions`` stacks per-member
+        :class:`~isotope_tpu.metrics.attribution.AttributionSummary`
+        leaves along the member axis, with member k bit-identical to
+        its solo :meth:`run_attributed`.  ``tail=True`` arms the
+        conditional-tail accumulators at ``tail_cut`` — estimated
+        once from a pilot on the FLEET key when not given (one pilot
+        serves every member; pass an explicit cut for exact
+        solo-tail equivalence).  ``timeline`` (needs
+        ``SimParams.timeline``) likewise stacks per-member
+        :class:`~isotope_tpu.metrics.timeline.TimelineSummary` series
+        under ``timelines`` — ``window_s`` overrides the window
+        width.  With both off, every traced program and result is the
+        historical one, byte-identical (pinned).
         """
         from isotope_tpu.compiler.compile import compile_ensemble
         from isotope_tpu.sim import ensemble as ens_mod
@@ -2787,6 +2943,21 @@ class Simulator:
         spec.check(allow_duplicate_seeds=member_keys is not None)
         faults.check("engine.run")
         self._check_lb_load(load)
+        if attribution and not self.params.attribution:
+            raise ValueError(
+                "attributed fleets need SimParams(attribution=True)"
+            )
+        if timeline and not self.params.timeline:
+            raise ValueError(
+                "timeline fleets need SimParams(timeline=True)"
+            )
+        if attribution and tail and tail_cut is None:
+            # ONE pilot (on the fleet key) serves every member — a
+            # per-member cut would cost N pilot dispatches; pass an
+            # explicit tail_cut for exact solo-tail equivalence
+            tail_cut = self.estimate_tail_cut(
+                load, num_requests, key, block_size=block_size
+            )
         tables = compile_ensemble(spec)
         if member_chaos is not None and self._saturated(load):
             raise ValueError(
@@ -2812,9 +2983,30 @@ class Simulator:
                 "the ensemble carry export (carry_in/return_carry/"
                 "block_offset) requires trim=False and no member_chaos"
             )
+        observed = attribution or timeline
+        if carry_run and observed:
+            raise ValueError(
+                "the ensemble carry export does not compose with the "
+                "attribution/timeline reductions (screen first, then "
+                "explain with an observed fleet)"
+            )
+        attr_mode = (
+            ("tail" if tail else "mean") if attribution else None
+        )
+        tl_plan = None
+        if timeline:
+            tl_plan = self.plan_timeline_windows(
+                args["num_blocks"] * args["block"],
+                float(args["offered"][0]), window_s,
+            )
         chunk_sz = chunk if chunk is not None else spec.chunk
         if chunk_sz is None:
-            chunk_sz = self.ensemble_chunk_size(n_mem, args["block"])
+            chunk_sz = self.ensemble_chunk_size(
+                n_mem, args["block"], attr=attribution,
+                timeline_windows=(
+                    tl_plan[0] if tl_plan is not None else None
+                ),
+            )
         chunk_sz = max(1, min(int(chunk_sz), n_mem))
         n_chunks = -(-n_mem // chunk_sz)
         telemetry.counter_inc("ensemble_runs")
@@ -2842,7 +3034,18 @@ class Simulator:
                 args["conns"], trim, args["sat"], chunk_sz,
                 tables.jittered, tables.mode,
                 member_chaos=chaos_fx is not None,
+                attr=attr_mode, tl_plan=tl_plan,
             )
+            if attr_mode is not None:
+                # per-member tail cuts ride as a traced argument
+                # BEFORE the chaos rows (the member_scan unpack order)
+                stacked = stacked + (jnp.full(
+                    (n_mem,),
+                    tail_cut
+                    if (tail and tail_cut is not None)
+                    else np.inf,
+                    jnp.float32,
+                ),)
             stacked = stacked + self._chaos_fx_args(
                 chaos_fx, with_pol=False
             )
@@ -2862,14 +3065,24 @@ class Simulator:
                 if n_chunks > 1:
                     # serialize chunks: live memory stays bounded by
                     # one chunk's event tensors (the point of chunking)
-                    jax.block_until_ready(parts[-1].count)
-        summaries = self._ensemble_concat(parts, n_mem)
+                    head = parts[-1][0] if observed else parts[-1]
+                    jax.block_until_ready(head.count)
+        out = self._ensemble_concat(parts, n_mem)
+        if observed:
+            summaries = out[0]
+            rest = list(out[1:])
+            tl_stack = rest.pop(0) if timeline else None
+            attr_stack = rest.pop(0) if attribution else None
+        else:
+            summaries, tl_stack, attr_stack = out, None, None
         ens = ens_mod.EnsembleSummary(
             spec=spec,
             summaries=summaries,
             offered_qps=args["offered"],
             chunk=chunk_sz,
             member_chaos=member_events,
+            timelines=tl_stack,
+            attributions=attr_stack,
         )
         if return_carry:
             return ens, self._ensemble_concat(carry_parts, n_mem)
@@ -3455,7 +3668,8 @@ class Simulator:
     def _protected_member_fn(self, block: int, num_blocks: int,
                              kind: str, connections: int, trim: bool,
                              tl_plan: Tuple[int, float], roll: bool,
-                             jittered: bool, member_chaos: bool):
+                             jittered: bool, member_chaos: bool,
+                             attr: Optional[str] = None):
         """The ONE-member PROTECTED block-scan program the fleet maps:
         the :meth:`_get_protected` body (policy / rollout state riding
         the scan carry next to the flight recorder) with the fleet
@@ -3463,8 +3677,16 @@ class Simulator:
         seeds-only member reproduces its solo ``run_policies`` /
         ``run_rollouts`` twin bit-for-bit, and the whole fleet batches
         under one vmap / ``lax.map``.  No collector (per-service
-        series stay out of fleet programs) and no attribution (the
-        blame pass stays a solo follow-up — ROADMAP residual).
+        series stay out of fleet programs).
+
+        ``attr`` threads the critical-path blame reduction through
+        the same member body (exemplar state in the carry, per-block
+        blame in the stacked ys — the :meth:`_get_protected` attr
+        branch): the member takes ONE extra traced ``tail_cut``
+        argument before its chaos rows and appends an
+        ``AttributionSummary`` LAST to its output tuple, so member
+        k's fleet blame is bit-identical to its solo attributed
+        ``run_policies`` / ``run_rollouts`` twin.
 
         ``member_chaos`` appends the member's stacked chaos rows
         (eff replicas, outage flags, policy chaos-down deltas, and the
@@ -3497,17 +3719,28 @@ class Simulator:
             stuck = faults.stuck_breaker()
             lag = faults.autoscaler_lag()
             retry_mask = jnp.asarray(self.compiled.hop_attempt > 0)
+        if attr is not None:
+            from isotope_tpu.metrics import attribution
+
+            atables = self._attribution_tables()
+            top_k = self.params.attribution_top_k
 
         def member_scan(key, offered_qps, pace_gap, nominal_gap,
                         win_lo, win_hi, visits_pc, phase_windows,
-                        cpu_scale, err_scale, *chaos_rows):
+                        cpu_scale, err_scale, *rest):
             telemetry.record_trace(
                 (tag, self.signature[3], block, num_blocks, kind,
                  connections, trim, tl_plan, with_pol, jittered,
-                 member_chaos),
+                 member_chaos)
+                + ((attr,) if attr is not None else ()),
                 tracing=isinstance(key, jax.core.Tracer),
                 requests=block, hops=self.compiled.num_hops,
             )
+            if attr is not None:
+                tail_cut = rest[0]
+                chaos_rows = rest[1:]
+            else:
+                chaos_rows = rest
             if member_chaos:
                 cfx = self._member_chaos_fx(chaos_rows)
                 downed_w = chaos_rows[3] if with_pol else None
@@ -3517,7 +3750,8 @@ class Simulator:
 
             def body(carry, b):
                 ((t0, conn_t0, req_off), tl_acc, robs_acc,
-                 rstate, roll_acc, pobs_acc, pstate, pol_acc) = carry
+                 rstate, roll_acc, pobs_acc, pstate, pol_acc,
+                 ex) = carry
                 rfx = rollout_mod.effects(rstate) if roll else None
                 pfx = (
                     policies_mod.effects(pstate)
@@ -3577,12 +3811,32 @@ class Simulator:
                     pol_acc = policies_mod.accumulate_summary(
                         pol_acc, pdelta
                     )
+                ys = s
+                if attr is not None:
+                    a, ex = attribution.attribute_block(
+                        res, atables,
+                        tail_cut=(
+                            tail_cut if attr == "tail" else None
+                        ),
+                        top_k=top_k, ex_state=ex,
+                        packed=packed,
+                    )
+                    ys = (s, a)
                 return (
                     (t_end, conn_end, req_off + per),
                     tl_acc, robs_acc, rstate, roll_acc,
-                    pobs_acc, pstate, pol_acc,
-                ), s
+                    pobs_acc, pstate, pol_acc, ex,
+                ), ys
 
+            ex0 = None
+            if attr is not None:
+                k0 = min(top_k, block) if top_k > 0 else 0
+                H = self.compiled.num_hops
+                ex0 = (
+                    attribution.empty_exemplars(k0, H)
+                    if k0 > 0
+                    else None
+                )
             carry0 = (
                 (
                     jnp.float32(0.0),
@@ -3605,21 +3859,30 @@ class Simulator:
                     policies_mod.zeros_summary(tspec, S)
                     if with_pol else None
                 ),
+                ex0,
             )
             (
                 (_, tl_final, robs_final, _, roll_final, _, _,
-                 pol_final),
+                 pol_final, ex_final),
                 ys,
             ) = jax.lax.scan(body, carry0, jnp.arange(num_blocks))
             if roll:
                 roll_final = rollout_mod.attach_observations(
                     roll_final, robs_final
                 )
-            out = (summary_mod.reduce_stacked(ys), tl_final)
+            if attr is not None:
+                parts, aparts = ys
+                summary = summary_mod.reduce_stacked(parts)
+                a_out = attribution.reduce_stacked(aparts, ex_final)
+            else:
+                summary = summary_mod.reduce_stacked(ys)
+            out = (summary, tl_final)
             if roll:
                 out = out + (roll_final,)
             if with_pol:
                 out = out + (pol_final,)
+            if attr is not None:
+                out = out + (a_out,)
             return out
 
         return member_scan
@@ -3629,7 +3892,8 @@ class Simulator:
                                 trim: bool, tl_plan: Tuple[int, float],
                                 roll: bool, chunk_members: int,
                                 jittered: bool, mode: str,
-                                member_chaos: bool):
+                                member_chaos: bool,
+                                attr: Optional[str] = None):
         """One jitted PROTECTED fleet program over a
         ``chunk_members``-wide member axis (the :meth:`_get_ensemble`
         batching applied to the protected member scan).  The control
@@ -3638,11 +3902,11 @@ class Simulator:
         why the stacked carry batches for free under vmap."""
         cache_key = ("prot-ens", block, num_blocks, kind, connections,
                      trim, tl_plan, roll, chunk_members, jittered,
-                     mode, member_chaos)
+                     mode, member_chaos, attr)
         if cache_key not in self._ensemble_fns:
             member = self._protected_member_fn(
                 block, num_blocks, kind, connections, trim, tl_plan,
-                roll, jittered, member_chaos,
+                roll, jittered, member_chaos, attr=attr,
             )
             if mode == "map":
                 def fleet(*xs):
@@ -3662,11 +3926,13 @@ class Simulator:
 
     def protected_ensemble_chunk(self, members: int, block: int,
                                  tl_plan: Tuple[int, float],
-                                 roll: bool) -> int:
+                                 roll: bool,
+                                 attr: bool = False) -> int:
         """The protected fleet's auto member-chunk: the plain fleet's
         capacity split (:meth:`ensemble_chunk_size`) extended with the
         stacked per-member control carry — timeline accumulator plus
-        policy / rollout state and series — the VET-T025 accounting."""
+        policy / rollout state and series — the VET-T025 accounting,
+        and (``attr``) the stacked blame footprint (VET-M006)."""
         from isotope_tpu.analysis import costmodel
 
         cap = costmodel.device_capacity_bytes()
@@ -3674,6 +3940,10 @@ class Simulator:
         carry = costmodel.protected_carry_bytes(
             self, tl_plan[0], roll=roll,
         )
+        if attr:
+            carry += costmodel.observability_carry_bytes(
+                self, attr=True,
+            )
         return costmodel.ensemble_chunk(
             members, est.peak_bytes_at_block, cap,
             carry_bytes_per_member=carry,
@@ -3694,6 +3964,9 @@ class Simulator:
         member_keys=None,
         member_qps=None,
         member_chaos=None,
+        attribution: bool = False,
+        tail: bool = False,
+        tail_cut: Optional[float] = None,
     ):
         """A Monte Carlo fleet of PROTECTED runs: N members of
         :meth:`run_policies` behind one jitted program per device —
@@ -3702,6 +3975,13 @@ class Simulator:
         (and, under ``member_chaos``, its own jittered failure
         schedule).  A seeds-only member is bit-identical to the solo
         ``run_policies`` with its folded key (pinned).
+
+        ``attribution=True`` threads the critical-path blame pass
+        through every member (needs ``SimParams(attribution=True)``):
+        the returned fleet carries a stacked
+        :class:`~isotope_tpu.metrics.attribution.AttributionSummary`
+        (``attributions``), member k bit-identical to its solo
+        attributed twin.
 
         Returns an :class:`~isotope_tpu.sim.ensemble.EnsembleSummary`
         with the per-member ``TimelineSummary`` and ``PolicySummary``
@@ -3724,7 +4004,8 @@ class Simulator:
             block_size=block_size, trim=trim, window_s=window_s,
             fixed_point_iters=fixed_point_iters, chunk=chunk,
             member_keys=member_keys, member_qps=member_qps,
-            member_chaos=member_chaos,
+            member_chaos=member_chaos, attribution=attribution,
+            tail=tail, tail_cut=tail_cut,
         )
 
     def run_rollouts_ensemble(
@@ -3742,6 +4023,9 @@ class Simulator:
         member_keys=None,
         member_qps=None,
         member_chaos=None,
+        attribution: bool = False,
+        tail: bool = False,
+        tail_cut: Optional[float] = None,
     ):
         """A Monte Carlo fleet of :meth:`run_rollouts` runs — the
         progressive-delivery controller advanced per member in the
@@ -3749,7 +4033,8 @@ class Simulator:
         tables are also compiled).  ``member_chaos`` is rejected here
         (the canary-first kill-split tables are trace constants —
         ROADMAP residual); seeds-only and physics-jittered fleets run.
-        """
+        ``attribution=True`` threads the blame pass through every
+        member (see :meth:`run_policies_ensemble`)."""
         if self._rollouts is None:
             raise ValueError(
                 "rollout fleets need compiled rollout tables "
@@ -3768,7 +4053,8 @@ class Simulator:
             block_size=block_size, trim=trim, window_s=window_s,
             fixed_point_iters=fixed_point_iters, chunk=chunk,
             member_keys=member_keys, member_qps=member_qps,
-            member_chaos=member_chaos,
+            member_chaos=member_chaos, attribution=attribution,
+            tail=tail, tail_cut=tail_cut,
         )
 
     def _run_protected_ensemble(self, load, num_requests, key, spec,
@@ -3776,7 +4062,10 @@ class Simulator:
                                 trim: bool, window_s: Optional[float],
                                 fixed_point_iters: int,
                                 chunk: Optional[int], member_keys,
-                                member_qps, member_chaos):
+                                member_qps, member_chaos,
+                                attribution: bool = False,
+                                tail: bool = False,
+                                tail_cut: Optional[float] = None):
         """Shared tail of the protected fleet runners — the
         :meth:`run_ensemble` planning/dispatch pipeline over the
         protected member program."""
@@ -3784,6 +4073,16 @@ class Simulator:
         from isotope_tpu.metrics import timeline as timeline_mod
         from isotope_tpu.sim import ensemble as ens_mod
 
+        if attribution and not self.params.attribution:
+            raise ValueError(
+                "attributed fleets need SimParams(attribution=True)"
+            )
+        if attribution and tail and tail_cut is None:
+            # ONE pilot (on the fleet key) serves every member; pass
+            # an explicit tail_cut for exact solo-tail equivalence
+            tail_cut = self.estimate_tail_cut(
+                load, num_requests, key, block_size=block_size
+            )
         if spec is None:
             if self.params.ensemble <= 0:
                 raise ValueError(
@@ -3827,10 +4126,21 @@ class Simulator:
                 pl._policy_downed_windows(tspec, base_split=roll)
                 for pl in planners
             ]),)
+        attr_mode = (
+            ("tail" if tail else "mean") if attribution else None
+        )
+        cut_arg = ()
+        if attribution:
+            cut_arg = (jnp.full(
+                (n_mem,),
+                tail_cut if (tail and tail_cut is not None) else np.inf,
+                jnp.float32,
+            ),)
         chunk_sz = chunk if chunk is not None else spec.chunk
         if chunk_sz is None:
             chunk_sz = self.protected_ensemble_chunk(
-                n_mem, args["block"], tl_plan, roll
+                n_mem, args["block"], tl_plan, roll,
+                attr=attribution,
             )
         chunk_sz = max(1, min(int(chunk_sz), n_mem))
         n_chunks = -(-n_mem // chunk_sz)
@@ -3846,9 +4156,10 @@ class Simulator:
             args["block"], args["num_blocks"], args["kind"],
             args["conns"], trim, tl_plan, roll, chunk_sz,
             tables.jittered, tables.mode, chaos_fx is not None,
+            attr=attr_mode,
         )
         padded = self._ensemble_pad_args(
-            self._ensemble_stacked_args(args) + chaos_args,
+            self._ensemble_stacked_args(args) + cut_arg + chaos_args,
             n_mem, n_chunks * chunk_sz,
         )
         parts = []
@@ -3860,14 +4171,15 @@ class Simulator:
                     jax.block_until_ready(parts[-1][0].count)
         out = self._ensemble_concat(parts, n_mem)
         # unpack by construction (the _get_protected ordering):
-        # roll -> (summary, tl, roll[, pol]); policies-only ->
-        # (summary, tl, pol)
+        # roll -> (summary, tl, roll[, pol][, attr]); policies-only ->
+        # (summary, tl, pol[, attr])
         summary, tl = out[0], out[1]
         rest = list(out[2:])
         roll_stack = rest.pop(0) if roll else None
         pol_stack = (
             rest.pop(0) if self._policies is not None else None
         )
+        attr_stack = rest.pop(0) if attribution else None
         return ens_mod.EnsembleSummary(
             spec=spec,
             summaries=summary,
@@ -3877,6 +4189,7 @@ class Simulator:
             timelines=tl,
             policies=pol_stack,
             rollouts=roll_stack,
+            attributions=attr_stack,
         )
 
     def _attribution_tables(self):

@@ -349,6 +349,10 @@ class EnsembleSummary:
     timelines: Optional[object] = None   # TimelineSummary, (N,)-leading
     policies: Optional[object] = None    # PolicySummary, (N,)-leading
     rollouts: Optional[object] = None    # RolloutSummary, (N,)-leading
+    # fleet observability (PR 17): per-member critical-path blame —
+    # an AttributionSummary with (N,)-leading leaves when the fleet
+    # ran with attribution armed
+    attributions: Optional[object] = None
 
     @property
     def members(self) -> int:
@@ -375,6 +379,11 @@ class EnsembleSummary:
         if self.rollouts is None:
             raise ValueError("this fleet carried no rollout series")
         return member_summary(self.rollouts, k)
+
+    def member_attribution(self, k: int):
+        if self.attributions is None:
+            raise ValueError("this fleet carried no attribution")
+        return member_summary(self.attributions, k)
 
     def severity(self, mode: str = "err_peak",
                  slo_s: Optional[float] = None) -> np.ndarray:

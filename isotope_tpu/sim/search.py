@@ -243,6 +243,12 @@ class RungResult:
     severity: np.ndarray     # (width,) this rung's severity per candidate
     survivors: np.ndarray    # global ids advanced (rank order; final rung: the winner)
     summaries: object        # member-stacked RunSummary (np leaves)
+    # per-rung evidence (PR 17): what the rung COST and how close the
+    # cut was — enough for ``isotope-tpu explain`` to narrate the
+    # bracket without re-running it
+    order: Optional[np.ndarray] = None   # (width,) rank order (row indices)
+    traces: int = 0                      # engine traces this rung triggered
+    compile_s: float = 0.0               # jit first-call wall this rung paid
 
 
 @dataclasses.dataclass(frozen=True)
@@ -328,21 +334,56 @@ class SearchSummary:
             "mode": self.mode,
             "winner": self.winner_config(),
             "lineage": [
-                {
-                    "rung": r.rung,
-                    "width": r.width,
-                    "chunk": r.chunk,
-                    "start_block": r.start_block,
-                    "num_blocks": r.num_blocks,
-                    "cum_requests": r.cum_requests,
-                    "candidates": [int(x) for x in r.candidates],
-                    "severity": [float(x) for x in r.severity],
-                    "survivors": [int(x) for x in r.survivors],
-                }
-                for r in self.rungs
+                self._rung_entry(r) for r in self.rungs
             ],
             "spec": self.spec.to_dict(),
         }
+
+    def _rung_entry(self, r: RungResult) -> dict:
+        """One lineage row with its evidence block (PR 17): per-rung
+        trace/compile cost plus the CUT LINE — the last-kept vs
+        first-cut severities (rank channel values) — so ``isotope-tpu
+        explain`` can narrate why the winner beat the runner-up at
+        every rung without re-running the bracket."""
+        entry = {
+            "rung": r.rung,
+            "width": r.width,
+            "chunk": r.chunk,
+            "start_block": r.start_block,
+            "num_blocks": r.num_blocks,
+            "cum_requests": r.cum_requests,
+            "candidates": [int(x) for x in r.candidates],
+            "severity": [float(x) for x in r.severity],
+            "survivors": [int(x) for x in r.survivors],
+        }
+        evidence = {
+            "traces": int(r.traces),
+            "compile_s": round(float(r.compile_s), 4),
+        }
+        if r.order is not None:
+            keep = len(r.survivors)
+            ranked = [int(r.candidates[i]) for i in r.order]
+            evidence["rank_order"] = ranked
+            last_kept = int(r.order[keep - 1])
+            cut = {
+                "kept": keep,
+                "last_kept": {
+                    "candidate": int(r.candidates[last_kept]),
+                    "severity": float(r.severity[last_kept]),
+                },
+            }
+            if keep < r.width:
+                first_cut = int(r.order[keep])
+                cut["first_cut"] = {
+                    "candidate": int(r.candidates[first_cut]),
+                    "severity": float(r.severity[first_cut]),
+                }
+                cut["margin"] = float(
+                    r.severity[first_cut] - r.severity[last_kept]
+                )
+            entry["cut"] = cut
+        entry["evidence"] = evidence
+        return entry
 
 
 def check_doc(doc: dict) -> dict:
@@ -550,9 +591,15 @@ def _run_bracket(sim, load, num_requests: int, key, spec: SearchSpec,
     ids = jnp.arange(pop.members, dtype=jnp.int32)
     lineage = []
     chunk_szs = []
+    rung_costs = []
     advance = _rank_advance_fn()
     traces0 = telemetry.counter_get("engine_traces")
     for r, rp in enumerate(plan):
+        # per-rung cost evidence (PR 17): trace and compile-wall
+        # deltas around the rung's dispatch, so the search artifact
+        # can say WHICH rung paid the compiles
+        rt0 = telemetry.counter_get("engine_traces")
+        rc0 = telemetry.phase_seconds("compile.jit_first_call")
         b0 = np.full((rp.width,), rp.start_block, np.int32)
         summ, carry_out, chunk_sz = dispatch(
             rp, cur + (b0,) + tuple(carry)
@@ -564,6 +611,10 @@ def _run_bracket(sim, load, num_requests: int, key, spec: SearchSpec,
         )
         lineage.append((ids, sev, order, summ))
         chunk_szs.append(chunk_sz)
+        rung_costs.append((
+            int(telemetry.counter_get("engine_traces") - rt0),
+            telemetry.phase_seconds("compile.jit_first_call") - rc0,
+        ))
         cur, carry, ids, tb = cur_n, carry_n, ids_n, tb_n
     traces = int(telemetry.counter_get("engine_traces") - traces0)
     telemetry.gauge_set("search_traces", traces)
@@ -572,8 +623,8 @@ def _run_bracket(sim, load, num_requests: int, key, spec: SearchSpec,
     # horizons on a 1-core host)
     lineage = jax.device_get(lineage)
     rungs = []
-    for rp, (ids_r, sev_r, order_r, summ_r), chunk_sz in zip(
-        plan, lineage, chunk_szs
+    for rp, (ids_r, sev_r, order_r, summ_r), chunk_sz, cost in zip(
+        plan, lineage, chunk_szs, rung_costs
     ):
         ids_np = np.asarray(ids_r)
         order_np = np.asarray(order_r)
@@ -592,6 +643,9 @@ def _run_bracket(sim, load, num_requests: int, key, spec: SearchSpec,
             severity=np.asarray(sev_r),
             survivors=ids_np[order_np[:keep]],
             summaries=summ_r,
+            order=order_np,
+            traces=cost[0],
+            compile_s=cost[1],
         ))
     winner = int(rungs[-1].survivors[0])
     win_row = int(np.where(rungs[-1].candidates == winner)[0][0])

@@ -110,7 +110,8 @@ def _cases(doc: dict, prefer_best: bool = False) -> dict:
             continue
         if k.endswith(("_inflight", "_spread", "_census", "_best",
                        "_compile_s", "_warmup_windows",
-                       "_timeline_overhead", "_mesh_layout_score",
+                       "_timeline_overhead", "_blame_overhead",
+                       "_mesh_layout_score",
                        "_rollout", "_lb", "_ensemble_members",
                        "_ensemble_traces", "_ensemble_solo_rate",
                        "_ensemble_speedup",
@@ -309,6 +310,37 @@ def timeline_failures(new_doc: dict) -> list:
               f"{float(v):+.3f} (threshold {thr:.3f}) {verdict}")
         if bad:
             failures.append(f"{case}.timeline_overhead")
+    return failures
+
+
+def fleetblame_failures(new_doc: dict) -> list:
+    """Opt-in gate (``BENCH_REGRESS_FLEETBLAME_THRESHOLD=<max
+    overhead>``): a fleet case whose measured blame-pass overhead
+    (``<case>_blame_overhead``, the attribution-on vs attribution-off
+    fleet steady-state delta bench.py embeds) exceeds the threshold
+    fails.
+
+    Same discipline as :func:`timeline_failures` — an ABSOLUTE bound:
+    "blame-on costs <= X of blame-off" holds or it doesn't; diffing
+    drifting overheads against each other would let the bound creep.
+    """
+    raw = os.environ.get("BENCH_REGRESS_FLEETBLAME_THRESHOLD")
+    if raw is None or raw == "":
+        return []
+    thr = float(raw)
+    failures = []
+    for k, v in sorted(new_doc.get("extra", {}).items()):
+        if not k.endswith("_blame_overhead") or not isinstance(
+            v, (int, float)
+        ):
+            continue
+        case = k[: -len("_blame_overhead")]
+        bad = float(v) > thr
+        verdict = "REGRESSION" if bad else "OK"
+        print(f"bench_regress: {case}.blame_overhead: "
+              f"{float(v):+.3f} (threshold {thr:.3f}) {verdict}")
+        if bad:
+            failures.append(f"{case}.blame_overhead")
     return failures
 
 
@@ -567,6 +599,7 @@ def main() -> int:
     failures.extend(blame_failures(prev_doc, new_doc))
     failures.extend(spread_failures(prev_doc, new_doc))
     failures.extend(timeline_failures(new_doc))
+    failures.extend(fleetblame_failures(new_doc))
     failures.extend(ensemble_failures(prev_doc, new_doc))
     failures.extend(search_failures(new_doc))
     failures.extend(layout_failures(prev_doc, new_doc))
