@@ -9,7 +9,8 @@ DURATION ?= 120s
 .PHONY: test lint vet-smoke bench telemetry-smoke resilience-smoke \
 	attribution-smoke sparse-smoke timeline-smoke multihost-smoke \
 	policies-smoke rollout-smoke lb-smoke ensemble-smoke \
-	chaosfleet-smoke search-smoke explain-smoke examples \
+	chaosfleet-smoke chaosgrid-smoke search-smoke explain-smoke \
+	examples \
 	canonical tree star multitier auxiliary-services star-auxiliary \
 	latency cpu_mem dot clean
 
@@ -209,6 +210,15 @@ ensemble-smoke:
 # worst member's jittered schedule replaying solo bit-for-bit
 chaosfleet-smoke:
 	$(PY) tools/chaosfleet_smoke.py
+
+# universal-member composition check (PR 18): the four compositions
+# the pre-universal member rejected (ungraceful kills, LB panic,
+# saturated -qps max, rollout kill splits) each run as member-jittered
+# fleets bit-equal to their solo twins, then the ALL-ON fleet
+# (policies + LB panic + rollouts + ungraceful member chaos in one
+# program) with the worst member's postmortem replaying bit-for-bit
+chaosgrid-smoke:
+	$(PY) tools/chaosgrid_smoke.py
 
 # config-search end-to-end check (sim/search.py): a 16-candidate
 # successive-halving bracket over the svc-scale fan-out — the planted

@@ -71,6 +71,7 @@ CASE_ORDER = [
     "ensembleN",
     "search64",
     "svc1000_chaosfleet",
+    "svc1000_composed",
     "realistic50",
     "rollout50",
     "svc10k",
@@ -780,6 +781,86 @@ def run_case(name: str) -> dict:
             ]
         except Exception as e:  # pragma: no cover - capture survival
             out[f"{name}_chaosfleet_split_error"] = str(e)[:200]
+    elif name == "svc1000_composed":
+        # universal member (PR 18): svc1000 with EVERY layer composed
+        # in one fleet program — retry-budget policies, an LB panic
+        # pool on a mid-graph service, a canary rollout on another,
+        # and member-jittered UNGRACEFUL (drain: false) kills.  The
+        # pre-universal member rejected all four of those tables as
+        # host/trace constants; this case exists for GATE COVERAGE of
+        # the full composition at svc scale.  The `<case>_composed_*`
+        # evidence keys and the case rate are EXCLUDED from
+        # bench_regress's rate comparison (coverage, not headline);
+        # its telemetry block carries degraded_to like every case, so
+        # the previously-clean-case gate must see the composed fleet
+        # complete undegraded.
+        from isotope_tpu.compiler import (
+            compile_lb,
+            compile_policies,
+            compile_rollouts,
+        )
+        from isotope_tpu.resilience.faults import ChaosJitterSpec
+        from isotope_tpu.sim.ensemble import EnsembleSpec
+
+        with open("examples/topologies/1000-svc_2000-end.yaml") as f:
+            doc = yaml.safe_load(f)
+        lb_svc = doc["services"][1]["name"]
+        roll_svc = doc["services"][2]["name"]
+        doc["policies"] = {
+            "defaults": {"retry_budget": {"budget_percent": "25%"}},
+            lb_svc: {"lb": {"policy": "least_request",
+                            "panic_threshold": "50%"}},
+        }
+        doc["rollouts"] = {
+            "defaults": {"gates": {"min_samples": 20}},
+            roll_svc: {
+                "steps": ["10%", "50%", "100%"],
+                "bake": "2s",
+                "rollback": {"cooldown": "4s", "max_retries": 1},
+                "canary": {"error_rate": "30%"},
+            },
+        }
+        g = ServiceGraph.decode(doc)
+        compiled_g = compile_graph(g)
+        chaos = (ChaosEvent(lb_svc, 0.05, 0.25, replicas_down=1,
+                            drain=False),)
+        sim = Simulator(
+            compiled_g, SimParams(timeline=True), chaos=chaos,
+            policies=compile_policies(g, compiled_g),
+            rollouts=compile_rollouts(g, compiled_g),
+            lb=compile_lb(g, compiled_g),
+        )
+        jitter = ChaosJitterSpec(time=0.3, magnitude=0.5, seed=0)
+        members = int(os.environ.get("BENCH_COMPOSED_MEMBERS", "8"))
+        spec = EnsembleSpec.of(members)
+        load_e = LoadModel(kind="open", qps=10_000.0)
+        n_e = int(os.environ.get(
+            "BENCH_COMPOSED_REQUESTS", "8192" if on_tpu else "512"
+        ))
+        b_e = min(n_e, 4_096 if on_tpu else 512)
+        traces0 = telemetry.counter_get("engine_traces")
+        last_fleet = {}
+
+        def composed_runner(s_, l_, n_, k_, b_):
+            ens = s_.run_rollouts_ensemble(
+                l_, n_, k_, spec, block_size=b_, window_s=0.05,
+                member_chaos=jitter,
+            )
+            last_fleet["ens"] = ens
+            return ens.pooled()
+
+        med, spread, best, first_s = measure(
+            sim, load_e, n_e, b_e, warm=2, iters=2,
+            runner=composed_runner,
+        )
+        out[f"{name}_composed_members"] = members
+        out[f"{name}_composed_traces"] = int(
+            telemetry.counter_get("engine_traces") - traces0
+        )
+        sev = last_fleet["ens"].severity()
+        out[f"{name}_composed_worst_severity"] = round(
+            float(sev.max()), 6
+        )
     elif name == "realistic50":
         sim = Simulator(
             compile_graph(

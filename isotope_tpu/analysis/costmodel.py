@@ -547,7 +547,7 @@ def timeline_findings(estimate: CostEstimate) -> List[Finding]:
 def protected_carry_bytes(sim, num_windows: int,
                           roll: bool = False) -> float:
     """Per-member bytes of a PROTECTED fleet's stacked scan carry
-    (engine ``_protected_member_fn``): the flight-recorder windowed
+    (engine ``_member_fn`` with ``prot`` armed): the flight-recorder windowed
     accumulator plus the policy / rollout control state, observation
     channels, and actuation series — the terms a plain fleet does not
     carry and VET-T025 accounts for.  All f32."""
@@ -571,8 +571,8 @@ def observability_carry_bytes(sim, attr: bool = False,
                               timeline_windows: Optional[int] = None
                               ) -> float:
     """Per-member bytes of an OBSERVED fleet's stacked observability
-    carry (engine ``_ensemble_member_fn`` with attribution / timeline
-    armed, ``_protected_member_fn`` with attribution armed): the
+    carry (engine ``_member_fn`` with attribution / timeline armed,
+    protected or not): the
     blame reduction's exemplar state plus its reduced
     ``AttributionSummary`` leaves (5 scalars, 11 per-hop vectors, two
     ``(S, 64)`` blame histograms), and the flight recorder's windowed

@@ -134,7 +134,7 @@ def _add_ensemble_args(parser) -> None:
              "pairs, e.g. 'time=0.2,magnitude=0.5,target=0.3[,seed"
              "=K]' — every fleet member survives a DIFFERENT bad "
              "day (needs a [chaos] schedule; composes with "
-             "--policies, not yet with --rollouts)")
+             "--policies AND --rollouts)")
     parser.add_argument(
         "--ensemble-split", default=None, metavar="SPEC",
         help="importance splitting (multilevel/RESTART) over the "
@@ -143,6 +143,13 @@ def _add_ensemble_args(parser) -> None:
              "0.25,threshold=0.5,sev=err_peak[,horizon=0.25]'; the "
              "estimate lands behind <label>.ensemble.json's "
              "'splitting' key")
+    parser.add_argument(
+        "--split-horizon", default=None, type=float, metavar="FRAC",
+        help="splitting screening-horizon fraction in (0, 1] "
+             "(default 0.25): each splitting level simulates FRAC of "
+             "the case's request count — overrides the 'horizon=' "
+             "key of --ensemble-split and is recorded in the "
+             "artifact's splitting block")
 
 
 def _ensemble_config_kwargs(args) -> dict:
@@ -172,6 +179,13 @@ def _ensemble_config_kwargs(args) -> dict:
 
         parse_split_spec(args.ensemble_split)  # fail fast
         out["ensemble_split"] = args.ensemble_split
+    if getattr(args, "split_horizon", None) is not None:
+        h = float(args.split_horizon)
+        if not 0.0 < h <= 1.0:
+            raise SystemExit(
+                "--split-horizon must lie in (0, 1]"
+            )
+        out["ensemble_split_horizon"] = h
     return out
 
 

@@ -275,38 +275,102 @@ def ensemble_take(stacked, idx):
 
 
 class ChaosFx(NamedTuple):
-    """Per-member stacked chaos phase tables (chaos fleets).
+    """Per-member stacked chaos tables (chaos fleets).
 
-    The engine's chaos tables — effective replicas, outage flags, and
-    the policy layer's chaos-downed deltas, all ``(P*Cc, S)`` per
-    phase-combo row — are trace-time CONSTANTS on solo runs.  A fleet
-    whose members each survive a *different* bad day needs them per
-    member; this tuple carries the ``(N,)``-leading stacked versions
-    as TRACED arguments into ``Simulator._simulate_core(chaos_fx=...)``
-    so one compiled fleet program serves every member's schedule.
-    Shape alignment (same P, same window count W) is guaranteed by
+    The engine's chaos tables — effective replicas, outage flags, the
+    policy layer's chaos-downed deltas, the rollout canary-first
+    kill-split tables, the LB panic healthy pools, the ungraceful-kill
+    reset rows, and the saturated finite-population tables — are all
+    trace-time CONSTANTS on solo runs.  A fleet whose members each
+    survive a *different* bad day needs them per member; this tuple
+    carries the ``(N,)``-leading stacked versions as TRACED arguments
+    into ``Simulator._simulate_core(chaos_fx=...)`` so one compiled
+    fleet program serves every member's schedule.  Every field past
+    the first two is an OPTIONAL leaf: ``None`` means the composition
+    does not arm that layer and the leaf vanishes from the jaxpr —
+    :func:`chaos_fx_layout` names the armed fields for a given
+    composition, and the positional packing on both sides of the
+    jitted boundary follows that layout.  Shape alignment (same P,
+    same window count W) is guaranteed by
     ``resilience/faults.jitter_chaos_events`` preserving the solo
     schedule's cut structure and asserted at build time.
     """
 
     eff_replicas_pc: "object"   # (N, P*Cc, S) i32
     svc_down_pc: "object"       # (N, P*Cc, S) bool
-    downed_pc: "object"         # (N, P*Cc, S) f32 | None (policies)
+    downed_pc: "object" = None  # (N, P*Cc, S) f32 (policies)
+    # rollout canary-first kill-split tables (rollouts x chaos)
+    eff_base_roll_pc: "object" = None       # (N, P*Cc, S) i32
+    svc_down_base_roll_pc: "object" = None  # (N, P*Cc, S) bool
+    can_reps_pc: "object" = None            # (N, P*Cc, S) f32
+    svc_down_can_pc: "object" = None        # (N, P*Cc, S) bool
+    downed_base_pc: "object" = None         # (N, P*Cc, S) f32
+    # LB panic healthy pools (lb x chaos)
+    lb_alive_pc: "object" = None            # (N, P*Cc, S) f32
+    # ungraceful-kill (drain=False) resident-request reset rows
+    kill_t: "object" = None                 # (N, E) f32
+    kill_frac: "object" = None              # (N, E, H) f32
+    # saturated -qps max finite-population tables + nominal-time warp
+    sat_p0: "object" = None                 # (N, R, H) f32
+    sat_coef: "object" = None               # (N, R, D+1, H) f32
+    sat_e: "object" = None                  # (N, R, H) f32
+    sat_c: "object" = None                  # (N, R) f32
+    sat_scale: "object" = None              # (N, R, H) f32
+    sat_cuts: "object" = None               # (N, P) f32
+    sat_lam: "object" = None                # (N, P) f32
+    sat_breaks: "object" = None             # (N, P) f32
 
 
-def compile_chaos_members(sim, member_events, with_pol: bool = False):
+def chaos_fx_layout(sim, with_pol: bool, roll: bool,
+                    sat: bool) -> Tuple[str, ...]:
+    """The armed :class:`ChaosFx` fields for one fleet composition.
+
+    Both sides of the jitted boundary — the argument packer
+    (``Simulator._chaos_fx_args``) and the in-trace unpacker
+    (``Simulator._member_chaos_fx``) — derive the positional row
+    layout from THIS function, so a composition flag flip changes the
+    wire format coherently (and the executable cache key already
+    carries the same flags).
+    """
+    fields = ["eff_replicas_pc", "svc_down_pc"]
+    pol = with_pol and sim._policies is not None
+    if pol:
+        fields.append("downed_pc")
+    if roll and sim._rollouts is not None:
+        fields += [
+            "eff_base_roll_pc", "svc_down_base_roll_pc",
+            "can_reps_pc", "svc_down_can_pc",
+        ]
+        if pol:
+            fields.append("downed_base_pc")
+    if (sim._lb is not None and sim._lb.any_panic and not sat):
+        fields.append("lb_alive_pc")
+    if sim._num_kill_events:
+        fields += ["kill_t", "kill_frac"]
+    if sat:
+        fields += [
+            "sat_p0", "sat_coef", "sat_e", "sat_c", "sat_scale",
+            "sat_cuts", "sat_lam", "sat_breaks",
+        ]
+    return tuple(fields)
+
+
+def compile_chaos_members(sim, member_events, with_pol: bool = False,
+                          roll: bool = False, sat_conns: int = 0):
     """Build each member's host-side planner Simulator (its own phase
     reach multipliers, retry-feedback fixed point, and drain windows)
     plus the stacked :class:`ChaosFx` device tables.
 
     ``member_events`` is one jittered ``ChaosEvent`` tuple per member
     (``resilience/faults.jitter_chaos_events``); ``with_pol`` also
-    stacks the policy chaos-down tables (protected fleets read them,
-    plain fleets do not — skip the transfer).  Returns
-    ``(planners, ChaosFx)``.  Raises when a member's schedule breaks
-    the shape-aligned contract (different cut count than the base
-    schedule) — the loud version of the structural invariant the
-    stacked tables rely on.
+    stacks the policy chaos-down tables, ``roll`` the rollout
+    canary-first split tables, and a nonzero ``sat_conns`` the
+    saturated finite-population tables at that connection count
+    (fleets read exactly the :func:`chaos_fx_layout` fields — absent
+    layers skip the transfer).  Returns ``(planners, ChaosFx)``.
+    Raises when a member's schedule breaks the shape-aligned contract
+    (different cut count than the base schedule) — the loud version of
+    the structural invariant the stacked tables rely on.
     """
     import jax.numpy as jnp
     import numpy as np
@@ -326,15 +390,72 @@ def compile_chaos_members(sim, member_events, with_pol: bool = False):
                 "schedules (same event count, distinct solo cuts)"
             )
     telemetry.counter_inc("chaos_fleets_compiled")
+    kw: dict = {}
+    pol = with_pol and sim._policies is not None
+    if pol:
+        kw["downed_pc"] = jnp.stack([pl._downed_pc for pl in planners])
+    if roll and sim._rollouts is not None:
+        kw["eff_base_roll_pc"] = jnp.stack(
+            [pl._eff_base_roll_pc for pl in planners]
+        )
+        kw["svc_down_base_roll_pc"] = jnp.stack(
+            [pl._svc_down_base_roll_pc for pl in planners]
+        )
+        kw["can_reps_pc"] = jnp.stack(
+            [pl._can_reps_pc for pl in planners]
+        )
+        kw["svc_down_can_pc"] = jnp.stack(
+            [pl._svc_down_can_pc for pl in planners]
+        )
+        if pol:
+            kw["downed_base_pc"] = jnp.stack(
+                [pl._downed_base_pc for pl in planners]
+            )
+    if sim._lb is not None and sim._lb.any_panic and not sat_conns:
+        kw["lb_alive_pc"] = jnp.stack(
+            [pl._lb_alive_pc for pl in planners]
+        )
+    if sim._num_kill_events:
+        kw["kill_t"] = jnp.asarray(
+            np.stack([pl._kill_t_np for pl in planners]), jnp.float32
+        )
+        kw["kill_frac"] = jnp.asarray(
+            np.stack([pl._kill_frac_np for pl in planners]),
+            jnp.float32,
+        )
+    if sat_conns:
+        rows = [pl._closed_tables(int(sat_conns)) for pl in planners]
+        kw["sat_p0"] = jnp.stack([r[1] for r in rows])
+        kw["sat_coef"] = jnp.stack([r[2] for r in rows])
+        kw["sat_e"] = jnp.stack([r[3] for r in rows])
+        kw["sat_c"] = jnp.asarray(
+            np.stack([r[4] for r in rows]), jnp.float32
+        )
+        kw["sat_scale"] = jnp.stack([r[5] for r in rows])
+        # the phased nominal-time warp constants, f64 host math
+        # mirroring the solo branch exactly so the f32-cast traced
+        # rows carry identical bits
+        cuts_l, lam_l, breaks_l = [], [], []
+        for pl, r in zip(planners, rows):
+            lam_p = np.maximum(
+                r[0].reshape(P, pl._num_combos).mean(1), 1e-9
+            )
+            cuts_np = np.asarray(pl._phase_starts, np.float64)
+            breaks = np.concatenate(
+                [[0.0], np.cumsum(lam_p[:-1] * np.diff(cuts_np))]
+            )
+            cuts_l.append(cuts_np)
+            lam_l.append(lam_p)
+            breaks_l.append(breaks)
+        kw["sat_cuts"] = jnp.asarray(np.stack(cuts_l), jnp.float32)
+        kw["sat_lam"] = jnp.asarray(np.stack(lam_l), jnp.float32)
+        kw["sat_breaks"] = jnp.asarray(np.stack(breaks_l), jnp.float32)
     fx = ChaosFx(
         eff_replicas_pc=jnp.stack(
             [pl._eff_replicas_pc for pl in planners]
         ),
         svc_down_pc=jnp.stack([pl._svc_down_pc for pl in planners]),
-        downed_pc=(
-            jnp.stack([pl._downed_pc for pl in planners])
-            if with_pol and sim._policies is not None else None
-        ),
+        **kw,
     )
     return planners, fx
 

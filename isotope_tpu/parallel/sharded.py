@@ -672,15 +672,16 @@ class ShardedSimulator:
         spec.check(allow_duplicate_seeds=member_keys is not None)
         sim._check_lb_load(load)
         tables = compile_ensemble(spec)
-        if member_chaos is not None and sim._saturated(load):
-            raise ValueError(
-                "per-member chaos does not support saturated -qps "
-                "max loads (host-constant finite-population tables)"
-            )
+        sat_load = sim._saturated(load)
         member_events, planners, chaos_fx = (
-            sim._resolve_member_chaos(member_chaos, spec.seeds)
+            sim._resolve_member_chaos(
+                member_chaos, spec.seeds,
+                sat_conns=load.connections if sat_load else 0,
+            )
         )
-        chaos_args = sim._chaos_fx_args(chaos_fx, with_pol=False)
+        chaos_args = sim._chaos_fx_args(
+            chaos_fx, with_pol=False, sat=sat_load
+        )
         args = sim._ensemble_args(
             load, num_requests, key, spec, tables,
             member_keys=member_keys, block_size=block_size, trim=trim,
@@ -886,7 +887,7 @@ class ShardedSimulator:
               tuple(d.id for d in self.mesh.devices.flat)))
             + cache_key
         )
-        member = self.sim._ensemble_member_fn(
+        member = self.sim._member_fn(
             args["block"], args["num_blocks"], args["kind"],
             args["conns"], trim, args["sat"], tables.jittered,
             member_chaos=member_chaos, attr=attr, tl_plan=tl_plan,
@@ -1019,7 +1020,7 @@ class ShardedSimulator:
               tuple(d.id for d in self.mesh.devices.flat)))
             + cache_key
         )
-        member = self.sim._ensemble_member_fn(
+        member = self.sim._member_fn(
             block, num_blocks, kind, conns, False, sat,
             tables.jittered, carry_io=True,
         )
@@ -1156,7 +1157,7 @@ class ShardedSimulator:
         sim._check_lb_load(load)
         tables = compile_ensemble(spec)
         member_events, planners, chaos_fx = sim._resolve_member_chaos(
-            member_chaos, spec.seeds, with_pol=True
+            member_chaos, spec.seeds, with_pol=True, roll=roll,
         )
         args = sim._ensemble_args(
             load, num_requests, key, spec, tables,
@@ -1167,8 +1168,10 @@ class ShardedSimulator:
             args["num_blocks"] * args["block"],
             float(args["offered"][0]), window_s,
         )
-        chaos_args = sim._chaos_fx_args(chaos_fx, with_pol=True)
-        if chaos_fx is not None:
+        chaos_args = sim._chaos_fx_args(
+            chaos_fx, with_pol=True, roll=roll
+        )
+        if chaos_fx is not None and sim._policies is not None:
             tspec = timeline_mod.build_spec(
                 self.compiled, tl_plan[0], tl_plan[1]
             )
@@ -1335,10 +1338,12 @@ class ShardedSimulator:
               tuple(d.id for d in self.mesh.devices.flat)))
             + cache_key
         )
-        member = self.sim._protected_member_fn(
+        member = self.sim._member_fn(
             args["block"], args["num_blocks"], args["kind"],
-            args["conns"], trim, tl_plan, roll, tables.jittered,
-            member_chaos_on, attr=attr_mode,
+            args["conns"], trim, False, tables.jittered,
+            member_chaos=member_chaos_on, attr=attr_mode,
+            tl_plan=tl_plan,
+            prot="rollouts" if roll else "policies",
         )
         if tables.mode == "map":
             def local(*xs):

@@ -201,6 +201,12 @@ class ExperimentConfig:
     # lands behind `<label>.ensemble.json`'s schema-versioned
     # "splitting" key (--ensemble-split / TOML [sim] ensemble_split)
     ensemble_split: Optional[str] = None
+    # the splitting screening-horizon fraction (PR 18): overrides the
+    # spec string's ``horizon=`` key so sweeps can tune how much of
+    # the case's request count each splitting level simulates
+    # (--split-horizon / TOML [sim] ensemble_split_horizon); None
+    # defers to the spec string (default 0.25)
+    ensemble_split_horizon: Optional[float] = None
     # config search (sim/search.py): candidates > 0 arms a
     # successive-halving bracket per case (TOML [search] block),
     # writing a `<label>.search.json` isotope-search/v1 artifact with
@@ -256,13 +262,24 @@ class ExperimentConfig:
 
     def split_spec(self):
         """The sweep's importance-splitting config
-        (:class:`~isotope_tpu.sim.splitting.SplitSpec`), or None."""
+        (:class:`~isotope_tpu.sim.splitting.SplitSpec`), or None.
+        ``ensemble_split_horizon`` overrides the spec string's
+        ``horizon=`` key; the resolved value lands in the artifact's
+        splitting block via ``SplitSpec.to_dict``."""
         if not self.ensemble_split:
             return None
+        import dataclasses as _dc
+
         from isotope_tpu.sim.splitting import parse_split_spec
 
         with config_path("sim.ensemble_split"):
-            return parse_split_spec(self.ensemble_split)
+            spec = parse_split_spec(self.ensemble_split)
+        if spec is not None and self.ensemble_split_horizon is not None:
+            with config_path("sim.ensemble_split_horizon"):
+                spec = _dc.replace(
+                    spec, horizon=float(self.ensemble_split_horizon)
+                )
+        return spec
 
     def search_spec(self):
         """The sweep's :class:`~isotope_tpu.sim.search.SearchSpec`
@@ -551,6 +568,14 @@ def _ensemble_kwargs(sim: dict) -> dict:
         with config_path("sim.ensemble_split"):
             parse_split_spec(str(sim["ensemble_split"]))
         out["ensemble_split"] = str(sim["ensemble_split"])
+    if "ensemble_split_horizon" in sim:
+        with config_path("sim.ensemble_split_horizon"):
+            h = float(sim["ensemble_split_horizon"])
+            if not 0.0 < h <= 1.0:
+                raise ValueError(
+                    "ensemble_split_horizon must lie in (0, 1]"
+                )
+        out["ensemble_split_horizon"] = h
     return out
 
 

@@ -475,6 +475,148 @@ class _EnsembleGroups:
             )
         return self.results.pop(label)
 
+    def run_protected(self, label, topo_path, env_name, load, sim,
+                      sharded, use_sharded, n, block, tables_roll,
+                      chaos_jitter, attribution=None, timeline=None):
+        """The same-shape collapse extended to PROTECTED fleets
+        (PR 18): grid cells whose policy/rollout fleet programs share
+        a shape ride ONE ``run_policies_ensemble`` /
+        ``run_rollouts_ensemble`` dispatch.  Each cell keeps its
+        control member on the cell's own run key (and, under
+        ``chaos_jitter``, the solo chaos schedule) so a collapsed
+        cell's members stay bit-identical to its uncollapsed
+        dispatch — the universal member program made the chaos
+        tables traced per-member arguments, which is exactly what
+        lets cells with different jittered schedules share the
+        executable."""
+        import numpy as np
+
+        from isotope_tpu.sim.ensemble import (
+            EnsembleSpec,
+            EnsembleSummary,
+        )
+
+        if label in self.results:
+            telemetry.counter_inc("ensemble_collapsed_cases")
+            return self.results.pop(label)
+        spec = self.spec
+        n_seeds = spec.members
+        group = self._group_for(label, topo_path, env_name, load,
+                                sim, n)
+        roll = tables_roll is not None
+        win, blk = _protected_window_block(
+            sim, load, block, self.config, timeline
+        )
+        member_keys = []
+        member_qps = []
+        seed_scale = (
+            spec.qps_scale
+            if spec.qps_scale is not None
+            else np.ones(n_seeds)
+        )
+        for c in group:
+            cell_key = jax.random.fold_in(self.key, c["idx"])
+            member_keys.append(cell_key)
+            member_keys.extend(
+                jax.random.fold_in(cell_key, s)
+                for s in spec.seeds[1:]
+            )
+            if c["load"].qps is not None:
+                member_qps.extend(
+                    float(c["load"].qps) * seed_scale
+                )
+        member_chaos = None
+        if chaos_jitter is not None \
+                and getattr(sim, "_chaos_events", ()):
+            from isotope_tpu.resilience import faults as faults_mod
+
+            base_events = tuple(sim._chaos_events)
+            reps = sim.compiled.services.replicas_by_name()
+            cell_chaos = [base_events] + [
+                faults_mod.jitter_chaos_events(
+                    base_events, chaos_jitter,
+                    faults_mod.member_event_seeds(
+                        chaos_jitter, s, len(base_events)
+                    ),
+                    reps,
+                )
+                for s in spec.seeds[1:]
+            ]
+            member_chaos = cell_chaos * len(group)
+        if len(group) == 1:
+            group_spec = spec
+            qps_arg = None if load.qps is None else np.asarray(
+                member_qps
+            )
+        else:
+            group_spec = EnsembleSpec(
+                seeds=tuple(range(len(member_keys))),
+                cpu_scale=(
+                    np.tile(spec.cpu_scale, len(group))
+                    if spec.cpu_scale is not None else None
+                ),
+                error_scale=(
+                    np.tile(spec.error_scale, len(group))
+                    if spec.error_scale is not None else None
+                ),
+            )
+            qps_arg = np.asarray(member_qps)
+        runner = sharded if (use_sharded and sharded is not None) \
+            else sim
+        method = getattr(
+            runner,
+            "run_rollouts_ensemble" if roll
+            else "run_policies_ensemble",
+        )
+        obs_kw = {}
+        if attribution is not None:
+            obs_kw = dict(attribution=True, tail=attribution == "tail")
+        with telemetry.phase("ensemble.run"):
+            ens = method(
+                load, n,
+                jax.random.fold_in(self.key, group[0]["idx"]),
+                group_spec, block_size=blk, trim=True, window_s=win,
+                member_keys=member_keys, member_qps=qps_arg,
+                member_chaos=member_chaos, **obs_kw,
+            )
+            jax.block_until_ready(ens.summaries.count)
+        telemetry.counter_inc("protected_fleet_cases")
+        self.completed.update(c["label"] for c in group)
+        for i, c in enumerate(group):
+            sl = slice(i * n_seeds, (i + 1) * n_seeds)
+
+            def cell(stacked, sl=sl):
+                if stacked is None:
+                    return None
+                return jax.tree.map(
+                    lambda x: np.asarray(x)[sl], stacked
+                )
+
+            self.results[c["label"]] = EnsembleSummary(
+                spec=spec,
+                summaries=cell(ens.summaries),
+                offered_qps=np.asarray(ens.offered_qps)[sl],
+                chunk=ens.chunk,
+                member_chaos=(
+                    None if member_chaos is None
+                    else member_chaos[sl]
+                ),
+                timelines=cell(ens.timelines),
+                policies=cell(ens.policies),
+                rollouts=cell(ens.rollouts),
+                attributions=cell(ens.attributions),
+            )
+        if len(group) > 1:
+            telemetry.counter_inc("ensemble_group_dispatches")
+            telemetry.gauge_set("ensemble_group_cells", len(group))
+            print(
+                f"ensemble: collapsed {len(group)} same-shape "
+                f"protected case(s) ({len(member_keys)} members) "
+                "into one dispatch",
+                file=sys.stderr,
+            )
+        return self.results.pop(label)
+
 
 def _vet_gate(mode: str, sim, topo, config, load, block, rungs,
               policy, ensemble=None, protected: bool = False,
@@ -835,66 +977,6 @@ def _protected_window_block(sim, load, block, config, timeline,
     )
 
 
-def _protected_ensemble_run(sim, sharded, use_sharded, load, n,
-                            run_key, block, config, timeline,
-                            tables_roll, ens_spec, chaos_jitter,
-                            attribution=None):
-    """The protected Monte Carlo fleet for one case (PR 15): N
-    members of ``run_policies`` / ``run_rollouts`` behind one jitted
-    program per device — the PROTECTED physics measured
-    distributionally instead of once.  Member 0 is the CONTROL
-    member: it rides the RUN key itself AND (under ``chaos_jitter``)
-    keeps the solo chaos schedule, so it is bit-equal to the solo
-    protected run the pre-fleet runner would have executed (members
-    1..N-1 fold their seeds and survive their own jittered bad days).
-    ``chaos_jitter`` applies to policy fleets only — the rollout
-    kill-split tables are trace constants.  ``attribution`` (``"on"``
-    / ``"tail"``, PR 17) threads the per-member blame pass through
-    the SAME fleet dispatch — no separate solo pass, and the worst
-    member's blame lands in the postmortem."""
-    roll = tables_roll is not None
-    win, block = _protected_window_block(
-        sim, load, block, config, timeline
-    )
-    member_keys = [run_key] + [
-        jax.random.fold_in(run_key, s) for s in ens_spec.seeds[1:]
-    ]
-    member_chaos = None
-    if chaos_jitter is not None and not roll \
-            and getattr(sim, "_chaos_events", ()):
-        from isotope_tpu.resilience import faults as faults_mod
-
-        base_events = tuple(sim._chaos_events)
-        reps = sim.compiled.services.replicas_by_name()
-        member_chaos = [base_events] + [
-            faults_mod.jitter_chaos_events(
-                base_events, chaos_jitter,
-                faults_mod.member_event_seeds(
-                    chaos_jitter, s, len(base_events)
-                ),
-                reps,
-            )
-            for s in ens_spec.seeds[1:]
-        ]
-    runner = sharded if (use_sharded and sharded is not None) else sim
-    method = getattr(
-        runner,
-        "run_rollouts_ensemble" if roll else "run_policies_ensemble",
-    )
-    obs_kw = {}
-    if attribution is not None:
-        obs_kw = dict(attribution=True, tail=attribution == "tail")
-    with telemetry.phase("ensemble.run"):
-        ens = method(
-            load, n, run_key, ens_spec, block_size=block, trim=True,
-            window_s=win, member_keys=member_keys,
-            member_chaos=member_chaos, **obs_kw,
-        )
-        jax.block_until_ready(ens.summaries.count)
-    telemetry.counter_inc("protected_fleet_cases")
-    return ens
-
-
 def _splitting_pass(sim, sharded, use_sharded, topo, load, n,
                     run_key, block, config, timeline, protected,
                     tables_roll, split, chaos_jitter):
@@ -914,7 +996,7 @@ def _splitting_pass(sim, sharded, use_sharded, topo, load, n,
     n_short = max(256, int(n * split.horizon))
     roll = tables_roll is not None
     chaos = tuple(config.chaos)
-    jitter = chaos_jitter if (chaos and not roll) else None
+    jitter = chaos_jitter if chaos else None
     # a distinct key lane: splitting fleets must not replay the
     # measurement members' streams
     base = jax.random.fold_in(run_key, 777_000_001)
@@ -1280,19 +1362,24 @@ def run_experiment(
                                 if ens_spec is not None \
                                         and start_rung == 0:
                                     try:
+                                        # the same-shape collapse
+                                        # serves protected cases too
+                                        # (PR 18): grid cells sharing
+                                        # a fleet shape ride one
+                                        # protected dispatch
                                         ens_summary = \
-                                            _protected_ensemble_run(
-                                                sim, sharded,
-                                                use_sharded, load, n,
-                                                run_key, block,
-                                                config, timeline,
+                                            ens_groups.run_protected(
+                                                label, topo_path,
+                                                env.name, load, sim,
+                                                sharded, use_sharded,
+                                                n, block,
                                                 topo.rollout_tables,
-                                                ens_spec,
                                                 config
                                                 .chaos_jitter_spec(),
                                                 attribution=(
                                                     attribution
                                                 ),
+                                                timeline=timeline,
                                             )
                                         prot_fleet = True
                                         summary = \
@@ -1355,6 +1442,13 @@ def run_experiment(
                                     except Exception as e:
                                         telemetry.counter_inc(
                                             "ensemble_fallbacks"
+                                        )
+                                        # the solo fallback serves
+                                        # this cell: keep later
+                                        # groups from re-dispatching
+                                        # its members
+                                        ens_groups.completed.add(
+                                            label
                                         )
                                         print(
                                             f"warning: protected "

@@ -894,7 +894,6 @@ class Simulator:
         # retries of the killed call and mid-tree truncation effects on
         # downstream metrics are not re-simulated, and closed-loop
         # pacing keeps the uninterrupted latency.
-        kills = []
         back_cum = None
         if any(not ev.drain for ev in chaos):
             # payload-free return legs, one per ancestor edge —
@@ -926,6 +925,15 @@ class Simulator:
                         compiled.hop_parent[hi:nxt]
                     ]
                 hi = nxt
+        # Canonical kill tables: ONE row per drain=False event, in this
+        # schedule's own kill-time order, with surviving (k_before > 0)
+        # events first and fully-down targets as inert zero-fraction
+        # rows at the end.  Row e's RNG fold index is 9_990_000 + e, so
+        # a jittered fleet (same event count by construction) can pass
+        # the rows as stacked traced arguments through one program
+        # while member k replays its solo run bit-for-bit.
+        kill_t: list = []
+        kill_frac: list = []
         for ev in sorted(chaos, key=lambda e: e.start_s):
             if ev.drain:
                 continue
@@ -943,14 +951,23 @@ class Simulator:
             k_before = int(eff[p - 1, s]) if p > 0 else int(t.replicas[s])
             if k_before <= 0:
                 continue  # already fully down: nothing resident to kill
-            cols = np.nonzero(compiled.hop_service == s)[0]
-            # the reset reaches the client over the ancestor-chain
-            # return legs accumulated above
-            back = jnp.asarray(back_cum[cols], jnp.float32)
-            kills.append(
-                (float(ev.start_s), cols, min(down / k_before, 1.0), back)
-            )
-        self._kills = tuple(kills)
+            kill_t.append(float(ev.start_s))
+            kill_frac.append(np.where(
+                compiled.hop_service == s,
+                min(down / k_before, 1.0),
+                0.0,
+            ))
+        self._num_kill_events = sum(1 for ev in chaos if not ev.drain)
+        while len(kill_t) < self._num_kill_events:
+            kill_t.append(0.0)
+            kill_frac.append(np.zeros(compiled.num_hops))
+        self._back_cum_np = back_cum
+        if self._num_kill_events:
+            self._kill_t_np = np.asarray(kill_t)
+            self._kill_frac_np = np.stack(kill_frac)
+        else:
+            self._kill_t_np = None
+            self._kill_frac_np = None
 
         # -- per-(chaos x churn)-phase offered load ------------------------
         # A total outage changes WHERE load flows, not just capacity: a
@@ -2184,44 +2201,33 @@ class Simulator:
         )
 
     def _check_member_chaos(self) -> None:
-        """Reject the combinations whose chaos tables cannot ride as
-        traced per-member arguments (they stay host/trace constants)."""
+        """Per-member chaos needs a base schedule to jitter; every
+        other composition — ungraceful kills, rollouts, lb panic
+        pools, saturated closed loops — now rides as stacked traced
+        :class:`~isotope_tpu.compiler.compile.ChaosFx` leaves (the
+        PR 18 universal-fleet contract)."""
         if not self.has_chaos:
             raise ValueError(
                 "per-member chaos needs a base chaos schedule to "
                 "jitter (Simulator(..., chaos=[...]))"
             )
-        if any(not ev.drain for ev in self._chaos_events):
-            raise ValueError(
-                "per-member chaos does not support ungraceful kills "
-                "(drain=False): the resident-request reset tables are "
-                "per-event host constants"
-            )
-        if self._rollouts is not None:
-            raise ValueError(
-                "per-member chaos does not compose with rollout runs "
-                "yet: the canary-first kill-split tables are trace "
-                "constants (ROADMAP residual)"
-            )
-        if self._lb is not None and getattr(self._lb, "active", False) \
-                and getattr(self._lb, "any_panic", False):
-            raise ValueError(
-                "per-member chaos does not compose with lb panic "
-                "routing yet: the healthy-pool tables are trace "
-                "constants (ROADMAP residual)"
-            )
 
     def _resolve_member_chaos(self, member_chaos, seeds,
-                              with_pol: bool = False):
+                              with_pol: bool = False,
+                              roll: bool = False,
+                              sat_conns: int = 0):
         """Normalize the ``member_chaos`` fleet argument.
 
         Accepts a :class:`~isotope_tpu.resilience.faults.ChaosJitterSpec`
         (per-member schedules derived from the member seeds via the
         fold_in discipline), or an explicit per-member list of
         ``ChaosEvent`` sequences (the splitting estimator's re-folded
-        clones).  ``with_pol`` stacks the policy chaos-down tables too
-        (protected fleets only — plain fleets never read them).
-        Returns ``(member_events, planners, chaos_fx)`` —
+        clones).  ``with_pol`` stacks the policy chaos-down tables,
+        ``roll`` the rollout canary-first split tables, and a nonzero
+        ``sat_conns`` the saturated finite-population tables (fleets
+        read exactly the ``chaos_fx_layout`` fields — absent layers
+        skip the transfer).  Returns
+        ``(member_events, planners, chaos_fx)`` —
         ``(None, None, None)`` when off."""
         if member_chaos is None:
             return None, None, None
@@ -2247,18 +2253,24 @@ class Simulator:
                     f"for {len(seeds)} members"
                 )
         planners, fx = compile_chaos_members(
-            self, member_events, with_pol=with_pol
+            self, member_events, with_pol=with_pol, roll=roll,
+            sat_conns=sat_conns,
         )
         return member_events, planners, fx
 
-    def _ensemble_member_fn(self, block: int, num_blocks: int,
-                            kind: str, connections: int, trim: bool,
-                            sat: bool, jittered: bool,
-                            member_chaos: bool = False,
-                            carry_io: bool = False,
-                            attr: Optional[str] = None,
-                            tl_plan: Optional[Tuple[int, float]] = None):
-        """The ONE-member block-scan program the fleet vmaps.
+    def _member_fn(self, block: int, num_blocks: int,
+                   kind: str, connections: int, trim: bool,
+                   sat: bool, jittered: bool,
+                   member_chaos: bool = False,
+                   carry_io: bool = False,
+                   attr: Optional[str] = None,
+                   tl_plan: Optional[Tuple[int, float]] = None,
+                   prot: Optional[str] = None):
+        """The ONE universal member block-scan program every fleet
+        maps — plain, observed, protected, and search-bracket members
+        are all flag combinations of the same body, with every layer
+        an OPTIONAL leaf of one scan carry: absent layers ride as
+        ``None`` and vanish from the jaxpr.
 
         Body-identical to the plain ``_get_summary`` scan (same
         fold_in layout, same summarize/reduce), so a seeds-only member
@@ -2268,10 +2280,11 @@ class Simulator:
         just batched).
 
         ``carry_io`` is the search-bracket contract (sim/search.py):
-        the member takes four extra traced arguments after the ten
-        standard ones — a block offset ``b0`` plus the
-        ``(t0, conn_t0, req_off)`` scan carry — and returns
-        ``(summary, carry_out)``.  The per-block RNG folds
+        the member takes extra traced arguments after the ten standard
+        ones — a block offset ``b0`` plus the flattened scan-carry
+        leaves (plain members: ``(t0, conn_t0, req_off)``; protected
+        members: every leaf of :meth:`_protected_carry0`) — and
+        returns ``(out, carry_out)``.  The per-block RNG folds
         ``1_000_000 + b0 + b`` so a member resumed at ``b0`` draws the
         EXACT streams the unbroken run drew for those blocks; with
         ``b0 == 0`` and zero carries the program is value-identical to
@@ -2280,22 +2293,49 @@ class Simulator:
         ``attr`` / ``tl_plan`` arm the fleet observability pass: the
         member reduces an ``AttributionSummary`` (blame exemplar state
         in the scan carry, per-block blame vectors/hists in the
-        stacked ys — the solo ``_get_summary`` attr body) and/or a
-        ``TimelineSummary`` (carry-resident, the PR 7 recorder body),
-        returning ``(summary[, tl][, attr])``.  With ``attr`` the
-        member takes ONE extra traced argument before the chaos rows:
-        its ``tail_cut`` (``+inf`` = mean attribution).  Member k's
-        blame/windows are bit-identical to its solo ``run_attributed``
-        / ``run_timeline`` twin; with both off this member program is
-        the historical one, untouched."""
+        stacked ys) and/or a ``TimelineSummary`` (carry-resident, the
+        PR 7 recorder body), returning ``(summary[, tl][, attr])``.
+        With ``attr`` the member takes ONE extra traced argument
+        before the chaos rows: its ``tail_cut`` (``+inf`` = mean
+        attribution).  Member k's blame/windows are bit-identical to
+        its solo ``run_attributed`` / ``run_timeline`` twin.
+
+        ``prot`` arms the protected layers: ``"policies"`` /
+        ``"rollouts"`` thread the control state (breakers / budgets /
+        HPA, rollout controller) through the carry exactly like the
+        solo ``_get_protected`` body, returning
+        ``(summary, tl[, roll][, pol][, attr])`` — a seeds-only
+        member reproduces its solo ``run_policies`` / ``run_rollouts``
+        twin bit-for-bit.
+
+        ``member_chaos`` appends the member's stacked chaos rows — the
+        composition's ``chaos_fx_layout`` fields (eff replicas, outage
+        flags, and per the armed layers: policy chaos-down deltas,
+        rollout canary-first split tables, LB panic healthy pools,
+        ungraceful-kill reset rows, saturated finite-population
+        tables), plus, under policies, the recorder-window down table
+        the autoscaler's alive-capacity denominator reads — as
+        trailing traced arguments.  With everything off this member
+        program is the historical one, untouched."""
         from isotope_tpu.sim import summary as summary_mod
 
+        protected = prot is not None
+        roll = prot == "rollouts"
+        with_pol = protected and self._policies is not None
+        if protected and tl_plan is None:
+            raise ValueError(
+                "protected fleet members need a timeline plan (the "
+                "flight recorder feeds the control loops)"
+            )
         if carry_io and member_chaos:
             raise ValueError(
                 "carry_io fleets (search brackets) do not support "
                 "per-member chaos schedules yet (ROADMAP residual)"
             )
-        if carry_io and (attr is not None or tl_plan is not None):
+        if carry_io and (
+            attr is not None
+            or (tl_plan is not None and not protected)
+        ):
             raise ValueError(
                 "carry_io fleets (search brackets) do not carry the "
                 "attribution/timeline reductions (screen first, then "
@@ -2318,84 +2358,184 @@ class Simulator:
             tspec = timeline_mod.build_spec(
                 self.compiled, tl_plan[0], tl_plan[1]
             )
+        if roll:
+            from isotope_tpu.sim import rollout as rollout_mod
+
+            rdtab = rollout_mod.device_tables(self._rollouts)
+        if with_pol:
+            from isotope_tpu.sim import policies as policies_mod
+
+            pdtab = policies_mod.device_tables(self._policies)
+            downed_w_const = self._policy_downed_windows(
+                tspec, base_split=roll
+            )
+            stuck = faults.stuck_breaker()
+            lag = faults.autoscaler_lag()
+            retry_mask = jnp.asarray(self.compiled.hop_attempt > 0)
+        if member_chaos:
+            from isotope_tpu.compiler.compile import chaos_fx_layout
+
+            layout = chaos_fx_layout(self, with_pol, roll, sat)
+            n_rows = len(layout) + (1 if with_pol else 0)
+        else:
+            n_rows = 0
+        tag = (
+            ("rollouts-fleet" if roll else "policies-fleet")
+            if protected else "ensemble"
+        )
+
+        def zero_carry(ex0=None):
+            return self._protected_carry0(
+                connections, tl_plan, roll=roll, with_pol=with_pol
+            )[:-1] + (ex0,)
 
         def member_scan(key, offered_qps, pace_gap, nominal_gap,
                         win_lo, win_hi, visits_pc, phase_windows,
                         cpu_scale, err_scale, *rest):
-            telemetry.record_trace(
-                ("ensemble", self.signature[3], block, num_blocks,
-                 kind, connections, trim, sat, jittered,
-                 member_chaos) + (("carry",) if carry_io else ())
-                + ((attr,) if attr is not None else ())
-                + ((tl_plan,) if tl_plan is not None else ()),
-                tracing=isinstance(key, jax.core.Tracer),
-                requests=block * num_blocks,
-                hops=self.compiled.num_hops,
-            )
-            if carry_io:
-                b0, t0_in, conn_t0_in, req_off_in = rest[:4]
-                chaos_rows = rest[4:]
+            if protected:
+                telemetry.record_trace(
+                    (tag, self.signature[3], block, num_blocks, kind,
+                     connections, trim, tl_plan, with_pol, jittered,
+                     member_chaos)
+                    + (("carry",) if carry_io else ())
+                    + ((attr,) if attr is not None else ()),
+                    tracing=isinstance(key, jax.core.Tracer),
+                    requests=block, hops=self.compiled.num_hops,
+                )
             else:
-                b0 = 0
+                telemetry.record_trace(
+                    (tag, self.signature[3], block, num_blocks,
+                     kind, connections, trim, sat, jittered,
+                     member_chaos)
+                    + (("carry",) if carry_io else ())
+                    + ((attr,) if attr is not None else ())
+                    + ((tl_plan,) if tl_plan is not None else ()),
+                    tracing=isinstance(key, jax.core.Tracer),
+                    requests=block * num_blocks,
+                    hops=self.compiled.num_hops,
+                )
+            b0 = 0
+            tail_cut = None
+            chaos_rows = ()
+            if carry_io:
+                b0 = rest[0]
+                carry_leaves = rest[1:]
+            else:
+                pos = 0
                 if attr is not None:
                     tail_cut = rest[0]
-                    chaos_rows = rest[1:]
-                else:
-                    chaos_rows = rest
-            cfx = (
-                self._member_chaos_fx(chaos_rows)
-                if member_chaos else None
-            )
+                    pos = 1
+                chaos_rows = rest[pos:pos + n_rows]
+            if member_chaos:
+                cfx = self._member_chaos_fx(
+                    chaos_rows[:len(layout)], layout
+                )
+                downed_w = (
+                    chaos_rows[len(layout)] if with_pol else None
+                )
+            else:
+                cfx = None
+                downed_w = downed_w_const if with_pol else None
 
-            def core(kb, t0, conn_t0, req_off):
-                return self._simulate_core(
+            def body(carry, b):
+                ((t0, conn_t0, req_off), tl_acc, robs_acc,
+                 rstate, roll_acc, pobs_acc, pstate, pol_acc,
+                 ex) = carry
+                rfx = rollout_mod.effects(rstate) if roll else None
+                pfx = (
+                    policies_mod.effects(pstate)
+                    if with_pol else None
+                )
+                kb = jax.random.fold_in(key, 1_000_000 + b0 + b)
+                res, t_end, conn_end = self._simulate_core(
                     block, kind, connections, kb, offered_qps,
-                    pace_gap, offered_qps, nominal_gap, t0, conn_t0,
-                    req_off,
+                    pace_gap, offered_qps, nominal_gap, t0,
+                    conn_t0, req_off,
                     sat_conns=connections if sat else 0,
                     visits_pc=visits_pc,
                     phase_windows=phase_windows,
+                    policy_fx=pfx,
+                    rollout_fx=rfx,
                     cpu_scale=cpu_scale if jittered else None,
                     err_scale=err_scale if jittered else None,
                     chaos_fx=cfx,
                 )
-
-            if observed:
-                # fleet observability body: timeline accumulator and
-                # blame exemplar state ride the carry as optional
-                # leaves (absent = None, the _get_protected idiom)
-                def body(carry, b):
-                    (t0, conn_t0, req_off), tl_acc, ex = carry
-                    kb = jax.random.fold_in(key, 1_000_000 + b)
-                    res, t_end, conn_end = core(
-                        kb, t0, conn_t0, req_off
+                s = summary_mod.summarize(
+                    res, None,
+                    window=(win_lo, win_hi) if trim else None,
+                )
+                if tl_plan is not None:
+                    tl_acc = timeline_mod.accumulate(
+                        tl_acc,
+                        timeline_mod.timeline_block(
+                            res, tspec, packed=packed
+                        ),
                     )
-                    s = summary_mod.summarize(
-                        res, None,
-                        window=(win_lo, win_hi) if trim else None,
+                if protected:
+                    t_done = (
+                        jnp.min(conn_end)
+                        if kind == CLOSED_LOOP
+                        else t_end
                     )
-                    if tl_plan is not None:
-                        tl_acc = timeline_mod.accumulate(
-                            tl_acc,
-                            timeline_mod.timeline_block(
-                                res, tspec, packed=packed
-                            ),
+                if roll:
+                    robs_acc = (
+                        robs_acc
+                        + rollout_mod.observe_block(res, tspec)
+                    )
+                    rstate, rdelta = rollout_mod.advance(
+                        rstate, rdtab, robs_acc, t_done, tspec
+                    )
+                    roll_acc = rollout_mod.accumulate_summary(
+                        roll_acc, rdelta
+                    )
+                if with_pol:
+                    pobs_acc = (
+                        pobs_acc
+                        + policies_mod.observe_block(
+                            res, tspec, retry_mask
                         )
-                    ys = s
-                    if attr is not None:
-                        a, ex = attribution.attribute_block(
-                            res, atables,
-                            tail_cut=(
-                                tail_cut if attr == "tail" else None
-                            ),
-                            top_k=top_k, ex_state=ex,
-                            packed=packed,
-                        )
-                        ys = (s, a)
-                    return (
-                        (t_end, conn_end, req_off + per), tl_acc, ex
-                    ), ys
+                    )
+                    pstate, pdelta = policies_mod.advance(
+                        pstate, pdtab, tl_acc, pobs_acc, t_done,
+                        tspec, stuck_breaker=stuck,
+                        downed_w=downed_w,
+                    )
+                    pol_acc = policies_mod.accumulate_summary(
+                        pol_acc, pdelta
+                    )
+                ys = s
+                if attr is not None:
+                    a, ex = attribution.attribute_block(
+                        res, atables,
+                        tail_cut=(
+                            tail_cut if attr == "tail" else None
+                        ),
+                        top_k=top_k, ex_state=ex,
+                        packed=packed,
+                    )
+                    ys = (s, a)
+                return (
+                    (t_end, conn_end, req_off + per),
+                    tl_acc, robs_acc, rstate, roll_acc,
+                    pobs_acc, pstate, pol_acc, ex,
+                ), ys
 
+            if carry_io:
+                if protected:
+                    carry0 = jax.tree.unflatten(
+                        jax.tree.structure(zero_carry()),
+                        carry_leaves,
+                    )
+                else:
+                    t0_in, conn_t0_in, req_off_in = carry_leaves
+                    carry0 = (
+                        (
+                            jnp.asarray(t0_in, jnp.float32),
+                            jnp.asarray(conn_t0_in, jnp.float32),
+                            jnp.asarray(req_off_in, jnp.float32),
+                        ),
+                    ) + zero_carry()[1:]
+            else:
                 ex0 = None
                 if attr is not None:
                     k0 = min(top_k, block) if top_k > 0 else 0
@@ -2406,90 +2546,121 @@ class Simulator:
                         if k0 > 0
                         else None
                     )
-                carry0 = (
-                    (
-                        jnp.float32(0.0),
-                        jnp.zeros((c,), jnp.float32),
-                        jnp.float32(0.0),
-                    ),
-                    (
-                        timeline_mod.zeros_summary(tspec, packed=packed)
-                        if tl_plan is not None else None
-                    ),
-                    ex0,
+                carry0 = zero_carry(ex0)
+            carry_out, ys = jax.lax.scan(
+                body, carry0, jnp.arange(num_blocks)
+            )
+            (_, tl_final, robs_final, _, roll_final, _, _,
+             pol_final, ex_final) = carry_out
+            if roll:
+                roll_final = rollout_mod.attach_observations(
+                    roll_final, robs_final
                 )
-                (_, tl_final, ex_final), ys = jax.lax.scan(
-                    body, carry0, jnp.arange(num_blocks)
-                )
+            if attr is not None:
+                parts, aparts = ys
+                summary = summary_mod.reduce_stacked(parts)
+                a_out = attribution.reduce_stacked(aparts, ex_final)
+            else:
+                summary = summary_mod.reduce_stacked(ys)
+            if protected:
+                out = (summary, tl_final)
+                if roll:
+                    out = out + (roll_final,)
+                if with_pol:
+                    out = out + (pol_final,)
                 if attr is not None:
-                    parts, aparts = ys
-                    a_out = attribution.reduce_stacked(
-                        aparts, ex_final
-                    )
-                else:
-                    parts = ys
-                out = (summary_mod.reduce_stacked(parts),)
+                    out = out + (a_out,)
+                if carry_io:
+                    return out, carry_out
+                return out
+            if observed:
+                out = (summary,)
                 if tl_plan is not None:
                     out = out + (tl_final,)
                 if attr is not None:
                     out = out + (a_out,)
                 return out
-
-            def body(carry, b):
-                t0, conn_t0, req_off = carry
-                kb = jax.random.fold_in(key, 1_000_000 + b0 + b)
-                res, t_end, conn_end = core(kb, t0, conn_t0, req_off)
-                s = summary_mod.summarize(
-                    res, None,
-                    window=(win_lo, win_hi) if trim else None,
-                )
-                return (t_end, conn_end, req_off + per), s
-
             if carry_io:
-                carry0 = (
-                    jnp.asarray(t0_in, jnp.float32),
-                    jnp.asarray(conn_t0_in, jnp.float32),
-                    jnp.asarray(req_off_in, jnp.float32),
-                )
-            else:
-                carry0 = (
-                    jnp.float32(0.0),
-                    jnp.zeros((c,), jnp.float32),
-                    jnp.float32(0.0),
-                )
-            carry_out, parts = jax.lax.scan(
-                body, carry0, jnp.arange(num_blocks)
-            )
-            out = summary_mod.reduce_stacked(parts)
-            if carry_io:
-                return out, carry_out
-            return out
+                return summary, carry_out[0]
+            return summary
 
         return member_scan
 
-    @staticmethod
-    def _member_chaos_fx(chaos_rows):
-        """ONE member's :class:`~isotope_tpu.compiler.compile.ChaosFx`
-        from the trailing positional chaos arguments of a fleet member
-        program (eff rows, outage rows[, policy downed rows])."""
-        from isotope_tpu.compiler.compile import ChaosFx
+    def _protected_carry0(self, connections: int,
+                          tl_plan: Optional[Tuple[int, float]],
+                          roll: bool = False,
+                          with_pol: Optional[bool] = None):
+        """The solo zero scan carry of the universal member body —
+        every layer an optional pytree leaf: ``((t0, conn_t0,
+        req_off), timeline, rollout obs/state/summary, policy
+        obs/state/summary, exemplars)``, with ``None`` for the layers
+        the composition leaves off.  The carry-I/O fleet contract
+        flattens exactly these leaves (:meth:`zero_protected_carry`
+        stacks them per member)."""
+        if with_pol is None:
+            with_pol = self._policies is not None
+        c = max(connections, 1)
+        tl0 = None
+        if tl_plan is not None:
+            from isotope_tpu.metrics import timeline as timeline_mod
 
-        return ChaosFx(
-            eff_replicas_pc=chaos_rows[0],
-            svc_down_pc=chaos_rows[1],
-            downed_pc=chaos_rows[2] if len(chaos_rows) > 2 else None,
+            tspec = timeline_mod.build_spec(
+                self.compiled, tl_plan[0], tl_plan[1]
+            )
+            S = self.compiled.num_services
+            W = tspec.num_windows
+            tl0 = timeline_mod.zeros_summary(
+                tspec, packed=self.params.packed_carries
+            )
+        robs0 = rstate0 = racc0 = None
+        if roll:
+            from isotope_tpu.sim import rollout as rollout_mod
+
+            rdtab = rollout_mod.device_tables(self._rollouts)
+            robs0 = jnp.zeros((S, 2, W, 4))
+            rstate0 = rollout_mod.init_state(rdtab)
+            racc0 = rollout_mod.zeros_summary(tspec, S)
+        pobs0 = pstate0 = pacc0 = None
+        if with_pol:
+            from isotope_tpu.sim import policies as policies_mod
+
+            pdtab = policies_mod.device_tables(self._policies)
+            pobs0 = jnp.zeros((S, W))
+            pstate0 = policies_mod.init_state(
+                pdtab, lag_periods=faults.autoscaler_lag()
+            )
+            pacc0 = policies_mod.zeros_summary(tspec, S)
+        return (
+            (
+                jnp.float32(0.0),
+                jnp.zeros((c,), jnp.float32),
+                jnp.float32(0.0),
+            ),
+            tl0, robs0, rstate0, racc0, pobs0, pstate0, pacc0, None,
         )
 
     @staticmethod
-    def _chaos_fx_args(fx, with_pol: bool):
+    def _member_chaos_fx(chaos_rows, layout):
+        """ONE member's :class:`~isotope_tpu.compiler.compile.ChaosFx`
+        from the trailing positional chaos arguments of a fleet member
+        program — the positional order is ``layout``
+        (:func:`~isotope_tpu.compiler.compile.chaos_fx_layout`), the
+        same tuple :meth:`_chaos_fx_args` packed with."""
+        from isotope_tpu.compiler.compile import ChaosFx
+
+        return ChaosFx(**dict(zip(layout, chaos_rows)))
+
+    def _chaos_fx_args(self, fx, with_pol: bool, roll: bool = False,
+                       sat: bool = False):
         """The stacked trailing chaos arguments matching
-        :meth:`_member_chaos_fx`'s unpack order."""
+        :meth:`_member_chaos_fx`'s unpack order (the composition's
+        ``chaos_fx_layout``)."""
         if fx is None:
             return ()
-        out = (fx.eff_replicas_pc, fx.svc_down_pc)
-        if with_pol:
-            out = out + (fx.downed_pc,)
-        return out
+        from isotope_tpu.compiler.compile import chaos_fx_layout
+
+        layout = chaos_fx_layout(self, with_pol, roll, sat)
+        return tuple(getattr(fx, f) for f in layout)
 
     def _get_ensemble(self, block: int, num_blocks: int, kind: str,
                       connections: int, trim: bool, sat: bool,
@@ -2510,7 +2681,7 @@ class Simulator:
                      chunk_members, jittered, mode, member_chaos,
                      attr, tl_plan)
         if cache_key not in self._ensemble_fns:
-            member = self._ensemble_member_fn(
+            member = self._member_fn(
                 block, num_blocks, kind, connections, trim, sat,
                 jittered, member_chaos=member_chaos, attr=attr,
                 tl_plan=tl_plan,
@@ -2551,7 +2722,7 @@ class Simulator:
         cache_key = (block, num_blocks, kind, connections, sat,
                      chunk_members, jittered, mode)
         if cache_key not in self._search_fns:
-            member = self._ensemble_member_fn(
+            member = self._member_fn(
                 block, num_blocks, kind, connections, False, sat,
                 jittered, carry_io=True,
             )
@@ -2959,14 +3130,10 @@ class Simulator:
                 load, num_requests, key, block_size=block_size
             )
         tables = compile_ensemble(spec)
-        if member_chaos is not None and self._saturated(load):
-            raise ValueError(
-                "per-member chaos does not support saturated -qps max "
-                "loads (the finite-population tables are host "
-                "constants per schedule); pace the closed loop"
-            )
+        sat_load = self._saturated(load)
         member_events, planners, chaos_fx = self._resolve_member_chaos(
-            member_chaos, spec.seeds
+            member_chaos, spec.seeds,
+            sat_conns=load.connections if sat_load else 0,
         )
         args = self._ensemble_args(
             load, num_requests, key, spec, tables,
@@ -3047,7 +3214,7 @@ class Simulator:
                     jnp.float32,
                 ),)
             stacked = stacked + self._chaos_fx_args(
-                chaos_fx, with_pol=False
+                chaos_fx, with_pol=False, sat=args["sat"]
             )
         padded = self._ensemble_pad_args(
             stacked, n_mem, n_chunks * chunk_sz,
@@ -3100,6 +3267,27 @@ class Simulator:
             jnp.zeros((n_mem,), jnp.float32),
         )
 
+    def zero_protected_carry(self, n_mem: int, connections: int,
+                             tl_plan: Tuple[int, float],
+                             roll: bool = False):
+        """The fresh-start member-stacked PROTECTED scan carry — the
+        carry-I/O contract of :meth:`run_policies_ensemble` /
+        :meth:`run_rollouts_ensemble`: every leaf of the universal
+        member carry (:meth:`_protected_carry0` — clocks, timeline
+        accumulator, rollout obs/state/summary, policy
+        obs/state/summary) broadcast along a leading member axis.
+        A protected search bracket resuming from exactly these zeros
+        at ``block_offset=0`` is bit-identical to the unbroken
+        protected fleet."""
+        carry = self._protected_carry0(connections, tl_plan, roll=roll)
+        return jax.tree.map(
+            lambda x: jnp.broadcast_to(
+                jnp.asarray(x)[None],
+                (n_mem,) + jnp.shape(jnp.asarray(x)),
+            ),
+            carry,
+        )
+
     def run_search(self, load: LoadModel, num_requests: int,
                    key: jax.Array, spec, *,
                    block_size: int = 65_536,
@@ -3111,6 +3299,26 @@ class Simulator:
         return search_mod.run_search(
             self, load, num_requests, key, spec,
             block_size=block_size, chunk=chunk,
+        )
+
+    def run_search_protected(self, load: LoadModel, num_requests: int,
+                             key: jax.Array, spec, *,
+                             roll: bool = False,
+                             block_size: int = 65_536,
+                             chunk: Optional[int] = None,
+                             window_s: Optional[float] = None):
+        """Successive halving over a PROTECTED population — each
+        candidate a full policies/rollouts member whose breakers,
+        budgets, HPA, and rollout controller carry BETWEEN rungs via
+        the :meth:`run_policies_ensemble` carry-I/O contract, ranked
+        by any severity channel including ``trips`` (breaker trips +
+        budget ejections).  sim/search.py
+        :func:`run_search_protected`."""
+        from isotope_tpu.sim import search as search_mod
+
+        return search_mod.run_search_protected(
+            self, load, num_requests, key, spec, roll=roll,
+            block_size=block_size, chunk=chunk, window_s=window_s,
         )
 
     def plan_timeline_windows(
@@ -3665,248 +3873,34 @@ class Simulator:
 
     # -- protected ensembles: chaos fleets (sim/ensemble.py) ------------
 
-    def _protected_member_fn(self, block: int, num_blocks: int,
-                             kind: str, connections: int, trim: bool,
-                             tl_plan: Tuple[int, float], roll: bool,
-                             jittered: bool, member_chaos: bool,
-                             attr: Optional[str] = None):
-        """The ONE-member PROTECTED block-scan program the fleet maps:
-        the :meth:`_get_protected` body (policy / rollout state riding
-        the scan carry next to the flight recorder) with the fleet
-        calling convention of :meth:`_ensemble_member_fn` — so a
-        seeds-only member reproduces its solo ``run_policies`` /
-        ``run_rollouts`` twin bit-for-bit, and the whole fleet batches
-        under one vmap / ``lax.map``.  No collector (per-service
-        series stay out of fleet programs).
-
-        ``attr`` threads the critical-path blame reduction through
-        the same member body (exemplar state in the carry, per-block
-        blame in the stacked ys — the :meth:`_get_protected` attr
-        branch): the member takes ONE extra traced ``tail_cut``
-        argument before its chaos rows and appends an
-        ``AttributionSummary`` LAST to its output tuple, so member
-        k's fleet blame is bit-identical to its solo attributed
-        ``run_policies`` / ``run_rollouts`` twin.
-
-        ``member_chaos`` appends the member's stacked chaos rows
-        (eff replicas, outage flags, policy chaos-down deltas, and the
-        recorder-window down table the autoscaler's alive-capacity
-        denominator reads) as trailing traced arguments."""
-        from isotope_tpu.metrics import timeline as timeline_mod
-        from isotope_tpu.sim import summary as summary_mod
-
-        with_pol = self._policies is not None
-        tag = "rollouts-fleet" if roll else "policies-fleet"
-        c = max(connections, 1)
-        per = block // c
-        tspec = timeline_mod.build_spec(
-            self.compiled, tl_plan[0], tl_plan[1]
-        )
-        S = self.compiled.num_services
-        W = tspec.num_windows
-        packed = self.params.packed_carries
-        if roll:
-            from isotope_tpu.sim import rollout as rollout_mod
-
-            rdtab = rollout_mod.device_tables(self._rollouts)
-        if with_pol:
-            from isotope_tpu.sim import policies as policies_mod
-
-            pdtab = policies_mod.device_tables(self._policies)
-            downed_w_const = self._policy_downed_windows(
-                tspec, base_split=roll
-            )
-            stuck = faults.stuck_breaker()
-            lag = faults.autoscaler_lag()
-            retry_mask = jnp.asarray(self.compiled.hop_attempt > 0)
-        if attr is not None:
-            from isotope_tpu.metrics import attribution
-
-            atables = self._attribution_tables()
-            top_k = self.params.attribution_top_k
-
-        def member_scan(key, offered_qps, pace_gap, nominal_gap,
-                        win_lo, win_hi, visits_pc, phase_windows,
-                        cpu_scale, err_scale, *rest):
-            telemetry.record_trace(
-                (tag, self.signature[3], block, num_blocks, kind,
-                 connections, trim, tl_plan, with_pol, jittered,
-                 member_chaos)
-                + ((attr,) if attr is not None else ()),
-                tracing=isinstance(key, jax.core.Tracer),
-                requests=block, hops=self.compiled.num_hops,
-            )
-            if attr is not None:
-                tail_cut = rest[0]
-                chaos_rows = rest[1:]
-            else:
-                chaos_rows = rest
-            if member_chaos:
-                cfx = self._member_chaos_fx(chaos_rows)
-                downed_w = chaos_rows[3] if with_pol else None
-            else:
-                cfx = None
-                downed_w = downed_w_const if with_pol else None
-
-            def body(carry, b):
-                ((t0, conn_t0, req_off), tl_acc, robs_acc,
-                 rstate, roll_acc, pobs_acc, pstate, pol_acc,
-                 ex) = carry
-                rfx = rollout_mod.effects(rstate) if roll else None
-                pfx = (
-                    policies_mod.effects(pstate)
-                    if with_pol else None
-                )
-                kb = jax.random.fold_in(key, 1_000_000 + b)
-                res, t_end, conn_end = self._simulate_core(
-                    block, kind, connections, kb, offered_qps,
-                    pace_gap, offered_qps, nominal_gap, t0,
-                    conn_t0, req_off,
-                    visits_pc=visits_pc,
-                    phase_windows=phase_windows,
-                    policy_fx=pfx,
-                    rollout_fx=rfx,
-                    cpu_scale=cpu_scale if jittered else None,
-                    err_scale=err_scale if jittered else None,
-                    chaos_fx=cfx,
-                )
-                s = summary_mod.summarize(
-                    res, None,
-                    window=(win_lo, win_hi) if trim else None,
-                )
-                tl_acc = timeline_mod.accumulate(
-                    tl_acc,
-                    timeline_mod.timeline_block(
-                        res, tspec, packed=packed
-                    ),
-                )
-                t_done = (
-                    jnp.min(conn_end)
-                    if kind == CLOSED_LOOP
-                    else t_end
-                )
-                if roll:
-                    robs_acc = (
-                        robs_acc
-                        + rollout_mod.observe_block(res, tspec)
-                    )
-                    rstate, rdelta = rollout_mod.advance(
-                        rstate, rdtab, robs_acc, t_done, tspec
-                    )
-                    roll_acc = rollout_mod.accumulate_summary(
-                        roll_acc, rdelta
-                    )
-                if with_pol:
-                    pobs_acc = (
-                        pobs_acc
-                        + policies_mod.observe_block(
-                            res, tspec, retry_mask
-                        )
-                    )
-                    pstate, pdelta = policies_mod.advance(
-                        pstate, pdtab, tl_acc, pobs_acc, t_done,
-                        tspec, stuck_breaker=stuck,
-                        downed_w=downed_w,
-                    )
-                    pol_acc = policies_mod.accumulate_summary(
-                        pol_acc, pdelta
-                    )
-                ys = s
-                if attr is not None:
-                    a, ex = attribution.attribute_block(
-                        res, atables,
-                        tail_cut=(
-                            tail_cut if attr == "tail" else None
-                        ),
-                        top_k=top_k, ex_state=ex,
-                        packed=packed,
-                    )
-                    ys = (s, a)
-                return (
-                    (t_end, conn_end, req_off + per),
-                    tl_acc, robs_acc, rstate, roll_acc,
-                    pobs_acc, pstate, pol_acc, ex,
-                ), ys
-
-            ex0 = None
-            if attr is not None:
-                k0 = min(top_k, block) if top_k > 0 else 0
-                H = self.compiled.num_hops
-                ex0 = (
-                    attribution.empty_exemplars(k0, H)
-                    if k0 > 0
-                    else None
-                )
-            carry0 = (
-                (
-                    jnp.float32(0.0),
-                    jnp.zeros((c,), jnp.float32),
-                    jnp.float32(0.0),
-                ),
-                timeline_mod.zeros_summary(tspec, packed=packed),
-                jnp.zeros((S, 2, W, 4)) if roll else None,
-                rollout_mod.init_state(rdtab) if roll else None,
-                (
-                    rollout_mod.zeros_summary(tspec, S)
-                    if roll else None
-                ),
-                jnp.zeros((S, W)) if with_pol else None,
-                (
-                    policies_mod.init_state(pdtab, lag_periods=lag)
-                    if with_pol else None
-                ),
-                (
-                    policies_mod.zeros_summary(tspec, S)
-                    if with_pol else None
-                ),
-                ex0,
-            )
-            (
-                (_, tl_final, robs_final, _, roll_final, _, _,
-                 pol_final, ex_final),
-                ys,
-            ) = jax.lax.scan(body, carry0, jnp.arange(num_blocks))
-            if roll:
-                roll_final = rollout_mod.attach_observations(
-                    roll_final, robs_final
-                )
-            if attr is not None:
-                parts, aparts = ys
-                summary = summary_mod.reduce_stacked(parts)
-                a_out = attribution.reduce_stacked(aparts, ex_final)
-            else:
-                summary = summary_mod.reduce_stacked(ys)
-            out = (summary, tl_final)
-            if roll:
-                out = out + (roll_final,)
-            if with_pol:
-                out = out + (pol_final,)
-            if attr is not None:
-                out = out + (a_out,)
-            return out
-
-        return member_scan
-
     def _get_protected_ensemble(self, block: int, num_blocks: int,
                                 kind: str, connections: int,
                                 trim: bool, tl_plan: Tuple[int, float],
                                 roll: bool, chunk_members: int,
                                 jittered: bool, mode: str,
                                 member_chaos: bool,
-                                attr: Optional[str] = None):
+                                attr: Optional[str] = None,
+                                carry_io: bool = False):
         """One jitted PROTECTED fleet program over a
         ``chunk_members``-wide member axis (the :meth:`_get_ensemble`
         batching applied to the protected member scan).  The control
         state is per member — each member's breakers / budgets / HPA /
         rollout controller react to ITS OWN bad day — which is exactly
-        why the stacked carry batches for free under vmap."""
+        why the stacked carry batches for free under vmap.
+
+        ``carry_io`` is the protected search-bracket program: the
+        member takes ``(b0, *carry_leaves)`` after the standard ten
+        arguments and returns ``(out, carry)`` — the contract
+        :meth:`zero_protected_carry` documents."""
         cache_key = ("prot-ens", block, num_blocks, kind, connections,
                      trim, tl_plan, roll, chunk_members, jittered,
-                     mode, member_chaos, attr)
+                     mode, member_chaos, attr, carry_io)
         if cache_key not in self._ensemble_fns:
-            member = self._protected_member_fn(
-                block, num_blocks, kind, connections, trim, tl_plan,
-                roll, jittered, member_chaos, attr=attr,
+            member = self._member_fn(
+                block, num_blocks, kind, connections, trim, False,
+                jittered, member_chaos=member_chaos,
+                carry_io=carry_io, attr=attr, tl_plan=tl_plan,
+                prot="rollouts" if roll else "policies",
             )
             if mode == "map":
                 def fleet(*xs):
@@ -3967,6 +3961,9 @@ class Simulator:
         attribution: bool = False,
         tail: bool = False,
         tail_cut: Optional[float] = None,
+        carry_in=None,
+        return_carry: bool = False,
+        block_offset: int = 0,
     ):
         """A Monte Carlo fleet of PROTECTED runs: N members of
         :meth:`run_policies` behind one jitted program per device —
@@ -3982,6 +3979,20 @@ class Simulator:
         :class:`~isotope_tpu.metrics.attribution.AttributionSummary`
         (``attributions``), member k bit-identical to its solo
         attributed twin.
+
+        The carry export (protected search brackets, sim/search.py):
+        ``block_offset`` resumes every member's per-block RNG at that
+        block index, ``carry_in`` seeds the FULL protected scan carry
+        (clocks + timeline accumulator + policy/rollout control
+        state, member-stacked; ``None`` = the
+        :meth:`zero_protected_carry` fresh start), and
+        ``return_carry`` returns ``(summary, carry_out)`` so the next
+        rung continues each survivor's breakers / budgets / recorder
+        where this segment stopped.  A bracket's rung 0 at
+        ``block_offset=0`` with zero carries is bit-identical to the
+        unbroken protected fleet (pinned by tests).  These knobs
+        require ``trim=False``, no ``member_chaos``, and no
+        ``attribution``.
 
         Returns an :class:`~isotope_tpu.sim.ensemble.EnsembleSummary`
         with the per-member ``TimelineSummary`` and ``PolicySummary``
@@ -4006,6 +4017,8 @@ class Simulator:
             member_keys=member_keys, member_qps=member_qps,
             member_chaos=member_chaos, attribution=attribution,
             tail=tail, tail_cut=tail_cut,
+            carry_in=carry_in, return_carry=return_carry,
+            block_offset=block_offset,
         )
 
     def run_rollouts_ensemble(
@@ -4026,15 +4039,22 @@ class Simulator:
         attribution: bool = False,
         tail: bool = False,
         tail_cut: Optional[float] = None,
+        carry_in=None,
+        return_carry: bool = False,
+        block_offset: int = 0,
     ):
         """A Monte Carlo fleet of :meth:`run_rollouts` runs — the
         progressive-delivery controller advanced per member in the
         stacked scan carry (plus the PR 9 policy loops when policy
-        tables are also compiled).  ``member_chaos`` is rejected here
-        (the canary-first kill-split tables are trace constants —
-        ROADMAP residual); seeds-only and physics-jittered fleets run.
-        ``attribution=True`` threads the blame pass through every
-        member (see :meth:`run_policies_ensemble`)."""
+        tables are also compiled).  ``member_chaos`` composes with the
+        rollout split: each member's canary-first kill-split tables
+        ride as traced rows next to its chaos schedule (chaos ×
+        rollout fleets), with member k bit-identical to its solo
+        chaos ``run_rollouts`` twin.  ``attribution=True`` threads the
+        blame pass through every member, and the
+        ``carry_in``/``return_carry``/``block_offset`` carry export
+        works as in :meth:`run_policies_ensemble` (protected search
+        brackets)."""
         if self._rollouts is None:
             raise ValueError(
                 "rollout fleets need compiled rollout tables "
@@ -4055,6 +4075,8 @@ class Simulator:
             member_keys=member_keys, member_qps=member_qps,
             member_chaos=member_chaos, attribution=attribution,
             tail=tail, tail_cut=tail_cut,
+            carry_in=carry_in, return_carry=return_carry,
+            block_offset=block_offset,
         )
 
     def _run_protected_ensemble(self, load, num_requests, key, spec,
@@ -4065,7 +4087,10 @@ class Simulator:
                                 member_qps, member_chaos,
                                 attribution: bool = False,
                                 tail: bool = False,
-                                tail_cut: Optional[float] = None):
+                                tail_cut: Optional[float] = None,
+                                carry_in=None,
+                                return_carry: bool = False,
+                                block_offset: int = 0):
         """Shared tail of the protected fleet runners — the
         :meth:`run_ensemble` planning/dispatch pipeline over the
         protected member program."""
@@ -4102,7 +4127,7 @@ class Simulator:
         self._check_lb_load(load)
         tables = compile_ensemble(spec)
         member_events, planners, chaos_fx = self._resolve_member_chaos(
-            member_chaos, spec.seeds, with_pol=True
+            member_chaos, spec.seeds, with_pol=True, roll=roll,
         )
         args = self._ensemble_args(
             load, num_requests, key, spec, tables,
@@ -4111,12 +4136,23 @@ class Simulator:
             member_qps=member_qps, planners=planners,
         )
         n_mem = spec.members
+        carry_run = (
+            carry_in is not None or return_carry or block_offset != 0
+        )
+        if carry_run and (trim or chaos_fx is not None or attribution):
+            raise ValueError(
+                "the protected carry export (carry_in/return_carry/"
+                "block_offset) requires trim=False, no member_chaos, "
+                "and no attribution"
+            )
         tl_plan = self.plan_timeline_windows(
             args["num_blocks"] * args["block"],
             float(args["offered"][0]), window_s,
         )
-        chaos_args = self._chaos_fx_args(chaos_fx, with_pol=True)
-        if chaos_fx is not None:
+        chaos_args = self._chaos_fx_args(
+            chaos_fx, with_pol=True, roll=roll
+        )
+        if chaos_fx is not None and self._policies is not None:
             # the recorder-window chaos-down table the autoscaler's
             # alive-capacity denominator reads, per member
             tspec = timeline_mod.build_spec(
@@ -4156,21 +4192,37 @@ class Simulator:
             args["block"], args["num_blocks"], args["kind"],
             args["conns"], trim, tl_plan, roll, chunk_sz,
             tables.jittered, tables.mode, chaos_fx is not None,
-            attr=attr_mode,
+            attr=attr_mode, carry_io=carry_run,
         )
+        stacked = (
+            self._ensemble_stacked_args(args) + cut_arg + chaos_args
+        )
+        if carry_run:
+            if carry_in is None:
+                carry_in = self.zero_protected_carry(
+                    n_mem, args["conns"], tl_plan, roll=roll,
+                )
+            b0 = jnp.full((n_mem,), int(block_offset), jnp.int32)
+            stacked = stacked + (b0,) + tuple(
+                jax.tree.leaves(carry_in)
+            )
         padded = self._ensemble_pad_args(
-            self._ensemble_stacked_args(args) + cut_arg + chaos_args,
-            n_mem, n_chunks * chunk_sz,
+            stacked, n_mem, n_chunks * chunk_sz,
         )
         parts = []
+        carry_parts = []
         with self._detail_ctx():
             for ci in range(n_chunks):
                 sl = slice(ci * chunk_sz, (ci + 1) * chunk_sz)
-                parts.append(fn(*(x[sl] for x in padded)))
+                out = fn(*(x[sl] for x in padded))
+                if carry_run:
+                    out, carry_out = out
+                    carry_parts.append(carry_out)
+                parts.append(out)
                 if n_chunks > 1:
                     jax.block_until_ready(parts[-1][0].count)
         out = self._ensemble_concat(parts, n_mem)
-        # unpack by construction (the _get_protected ordering):
+        # unpack by construction (the universal member ordering):
         # roll -> (summary, tl, roll[, pol][, attr]); policies-only ->
         # (summary, tl, pol[, attr])
         summary, tl = out[0], out[1]
@@ -4180,7 +4232,7 @@ class Simulator:
             rest.pop(0) if self._policies is not None else None
         )
         attr_stack = rest.pop(0) if attribution else None
-        return ens_mod.EnsembleSummary(
+        ens = ens_mod.EnsembleSummary(
             spec=spec,
             summaries=summary,
             offered_qps=args["offered"],
@@ -4191,6 +4243,9 @@ class Simulator:
             rollouts=roll_stack,
             attributions=attr_stack,
         )
+        if return_carry:
+            return ens, self._ensemble_concat(carry_parts, n_mem)
+        return ens
 
     def _attribution_tables(self):
         """Blame-sweep index tables (metrics/attribution.py), built
@@ -4844,31 +4899,39 @@ class Simulator:
                 # phase's MVA throughput: the q-th request (globally)
                 # nominally fires at Rinv(q), R(t) = cumulative requests
                 # under the per-phase rates.
-                thr = self._closed_tables(sat_conns)[0]  # np (R,)
                 P_n = int(self._phase_starts.shape[0])
-                lam_p = np.maximum(
-                    thr.reshape(P_n, self._num_combos).mean(1), 1e-9
-                )
-                cuts_np = np.asarray(self._phase_starts, np.float64)
-                r_breaks = np.concatenate(
-                    [[0.0], np.cumsum(lam_p[:-1] * np.diff(cuts_np))]
-                )
+                if chaos_fx is not None and chaos_fx.sat_lam is not None:
+                    # a chaos fleet member's own warp rows, traced
+                    cuts_f = chaos_fx.sat_cuts
+                    lam_f = chaos_fx.sat_lam
+                    breaks_f = chaos_fx.sat_breaks
+                else:
+                    thr = self._closed_tables(sat_conns)[0]  # np (R,)
+                    lam_p = np.maximum(
+                        thr.reshape(P_n, self._num_combos).mean(1),
+                        1e-9,
+                    )
+                    cuts_np = np.asarray(
+                        self._phase_starts, np.float64
+                    )
+                    r_breaks = np.concatenate(
+                        [[0.0], np.cumsum(lam_p[:-1] * np.diff(cuts_np))]
+                    )
+                    cuts_f = jnp.asarray(cuts_np, jnp.float32)
+                    lam_f = jnp.asarray(lam_p, jnp.float32)
+                    breaks_f = jnp.asarray(r_breaks, jnp.float32)
 
                 def warp(idx):
                     q = idx * float(sat_conns)
                     k_ph = jnp.clip(
-                        jnp.searchsorted(
-                            jnp.asarray(r_breaks, jnp.float32), q,
-                            side="right",
-                        )
+                        jnp.searchsorted(breaks_f, q, side="right")
                         - 1,
                         0,
                         P_n - 1,
                     )
                     return (
-                        jnp.asarray(cuts_np, jnp.float32)[k_ph]
-                        + (q - jnp.asarray(r_breaks, jnp.float32)[k_ph])
-                        / jnp.asarray(lam_p, jnp.float32)[k_ph]
+                        cuts_f[k_ph]
+                        + (q - breaks_f[k_ph]) / lam_f[k_ph]
                     )
 
                 nominal = warp(
@@ -4957,7 +5020,11 @@ class Simulator:
                 # so the HPA-scaled BASELINE arm only absorbs the
                 # remainder of the delta.
                 if chaos_fx is not None:
-                    downed = chaos_fx.downed_pc
+                    downed = (
+                        chaos_fx.downed_base_pc
+                        if rollout_fx is not None and self.has_chaos
+                        else chaos_fx.downed_pc
+                    )
                 else:
                     downed = (
                         self._downed_base_pc
@@ -4983,9 +5050,14 @@ class Simulator:
                 policy_fx is not None
                 and (pol.any_hpa or pol.any_ejection)
             ):
-                # static baseline capacity under chaos: the canary-
-                # first split's remainder, not the full-delta table
-                eff_replicas_pc = self._eff_base_roll_pc
+                # baseline capacity under chaos: the canary-first
+                # split's remainder, not the full-delta table (a chaos
+                # fleet member's own stacked rows when traced)
+                eff_replicas_pc = (
+                    chaos_fx.eff_base_roll_pc
+                    if chaos_fx is not None
+                    else self._eff_base_roll_pc
+                )
         # -- panic-threshold routing (sim/lb.py) ---------------------------
         # When the healthy fraction of a pool (after outlier ejection
         # and chaos kills) drops below the service's panic threshold,
@@ -5006,15 +5078,27 @@ class Simulator:
                 total = policy_fx.total[None, :]
                 alive = policy_fx.alive[None, :]
                 if self.has_chaos:
-                    alive = alive - (
-                        self._downed_base_pc
-                        if rollout_fx is not None
-                        else self._downed_pc
-                    )
+                    if chaos_fx is not None:
+                        alive = alive - (
+                            chaos_fx.downed_base_pc
+                            if rollout_fx is not None
+                            else chaos_fx.downed_pc
+                        )
+                    else:
+                        alive = alive - (
+                            self._downed_base_pc
+                            if rollout_fx is not None
+                            else self._downed_pc
+                        )
                 alive = jnp.maximum(alive, 0.0)
             else:
                 total = self._lb_total_row
-                alive = self._lb_alive_pc
+                alive = (
+                    chaos_fx.lb_alive_pc
+                    if chaos_fx is not None
+                    and chaos_fx.lb_alive_pc is not None
+                    else self._lb_alive_pc
+                )
             lam_pc, panic_fail_pc = self._lb_mod.panic_split(
                 lbd, lam_pc, alive, total
             )
@@ -5028,6 +5112,13 @@ class Simulator:
         # so every station's service rate scales by 1/s — the one
         # knob that moves BOTH the wait law and the service draws
         mu = self._mu if cpu_scale is None else self._mu / cpu_scale
+        if rollout_fx is not None:
+            can_reps_pc = (
+                chaos_fx.can_reps_pc
+                if chaos_fx is not None
+                and chaos_fx.can_reps_pc is not None
+                else self._can_reps_pc
+            )
         if lbd is not None and not sat_conns:
             qp = self._lb_mod.wait_params(
                 self._lb, lbd, lam_pc, mu, eff_replicas_pc,
@@ -5041,7 +5132,7 @@ class Simulator:
                     self._lb, lbd, lam_can,
                     self._canary_mu if cpu_scale is None
                     else self._canary_mu / cpu_scale,
-                    self._can_reps_pc, self._k_max,
+                    can_reps_pc, self._k_max,
                 )
         else:
             qp = queueing.mmk_params(
@@ -5055,7 +5146,7 @@ class Simulator:
                     lam_can,
                     self._canary_mu if cpu_scale is None
                     else self._canary_mu / cpu_scale,
-                    self._can_reps_pc,
+                    can_reps_pc,
                     self._k_max,
                 )
         svc_down_pc = (
@@ -5066,7 +5157,11 @@ class Simulator:
         if rollout_fx is not None and self.has_chaos:
             # baseline-arm outage flags (canary downs selected per hop
             # below); utilization reporting follows the baseline arm
-            svc_down_pc = self._svc_down_base_roll_pc
+            svc_down_pc = (
+                chaos_fx.svc_down_base_roll_pc
+                if chaos_fx is not None
+                else self._svc_down_base_roll_pc
+            )
         hop_svc = self._hop_service  # (H,)
         # Per-hop parameter tables are tiny (P*Cc, H); expanding them over
         # the request axis with a direct (N, H) 2D gather is catastrophically
@@ -5082,7 +5177,12 @@ class Simulator:
             p_wait_c_ph = qp_can.p_wait[:, hop_svc]
             rate_c_ph = qp_can.wait_rate[:, hop_svc]
             down_c_ph = (
-                self._svc_down_can_pc[:, hop_svc]
+                (
+                    chaos_fx.svc_down_can_pc
+                    if chaos_fx is not None
+                    and chaos_fx.svc_down_can_pc is not None
+                    else self._svc_down_can_pc
+                )[:, hop_svc]
                 if self.has_chaos
                 else None
             )
@@ -5227,14 +5327,21 @@ class Simulator:
                 eval_poly = partial(_horner, coef_h=coef_R[0])
             else:
                 # per-phase tables selected by each request's arrival
-                # phase (``oh`` from the phase-table expansion above)
-                (_, p0_R, coef_R, e_R, c_R,
-                 scale_R) = self._closed_tables(sat_conns)
+                # phase (``oh`` from the phase-table expansion above);
+                # a chaos fleet member's own stacked rows when traced
+                if chaos_fx is not None and chaos_fx.sat_p0 is not None:
+                    p0_R = chaos_fx.sat_p0
+                    coef_R = chaos_fx.sat_coef
+                    e_R = chaos_fx.sat_e
+                    c_col = chaos_fx.sat_c[:, None]
+                    scale_R = chaos_fx.sat_scale
+                else:
+                    (_, p0_R, coef_R, e_R, c_R,
+                     scale_R) = self._closed_tables(sat_conns)
+                    c_col = jnp.asarray(c_R)[:, None]
                 p0_h = jnp.matmul(oh, p0_R, precision=hi)
                 e_n = jnp.matmul(oh, e_R, precision=hi)
-                c_n = jnp.matmul(
-                    oh, jnp.asarray(c_R)[:, None], precision=hi
-                )
+                c_n = jnp.matmul(oh, c_col, precision=hi)
                 scale_n = jnp.matmul(oh, scale_R, precision=hi)
                 z = z_wait
                 zproj = (z * e_n).sum(-1, keepdims=True)
@@ -5974,26 +6081,38 @@ class Simulator:
         # ungraceful kills: a request whose hop on the killed service is
         # in flight at the kill instant dies (transport) w.p. down/k —
         # the client sees the reset at ~the kill time (see __init__)
-        if self._kills:
+        if self._num_kill_events:
+            # the rows are either this schedule's own constants or a
+            # fleet member's stacked traced rows — identical values on
+            # either path, so the bit-equality pin holds by the same
+            # traced-vs-constant argument the chaos phase tables use
+            if chaos_fx is not None and chaos_fx.kill_t is not None:
+                kill_t = chaos_fx.kill_t        # (E,) f32
+                kill_frac = chaos_fx.kill_frac  # (E, H) f32
+            else:
+                kill_t = jnp.asarray(self._kill_t_np, jnp.float32)
+                kill_frac = jnp.asarray(self._kill_frac_np, jnp.float32)
+            back_h = jnp.asarray(self._back_cum_np, jnp.float32)  # (H,)
             died_any = jnp.zeros(n, bool)
-            for i, (t_k, cols, frac, back) in enumerate(self._kills):
+            for i in range(self._num_kill_events):
+                t_k = kill_t[i]
                 strad = (
-                    hop_sent[:, cols]
-                    & (hop_start[:, cols] < t_k)
-                    & (hop_start[:, cols] + hop_lat[:, cols] > t_k)
+                    hop_sent
+                    & (hop_start < t_k)
+                    & (hop_start + hop_lat > t_k)
                 )
                 coin = (
                     jax.random.uniform(
                         jax.random.fold_in(key, 9_990_000 + i),
                         strad.shape,
                     )
-                    < frac
+                    < kill_frac[i][None, :]
                 )
                 died_h = strad & coin
                 died = died_h.any(axis=1) & ~died_any
                 # the earliest reset to reach the client wins: the
                 # shortest payload-free return path among killed hops
-                ret = jnp.where(died_h, back[None, :], jnp.inf).min(1)
+                ret = jnp.where(died_h, back_h[None, :], jnp.inf).min(1)
                 reset_lat = jnp.maximum(t_k - arrivals, 0.0) + jnp.where(
                     jnp.isfinite(ret), ret, 0.0
                 )

@@ -764,6 +764,229 @@ def _emulated_dispatch(sh, args, tables, spec, chunk, plan):
     return dispatch
 
 
+_RANK_ADVANCE_PROT = None
+
+
+def _rank_advance_protected_fn():
+    """The protected bracket's jitted rank-and-advance: same lexsort +
+    gather as :func:`_rank_advance_fn`, with the stacked
+    ``PolicySummary`` threaded in so the ``trips`` severity channel
+    (breaker trips + budget ejections) can rank the population, and
+    the FULL protected carry pytree gathered (clocks + recorder +
+    control state) so survivors keep their breakers and budgets."""
+    global _RANK_ADVANCE_PROT
+    if _RANK_ADVANCE_PROT is None:
+        import functools
+
+        import jax
+        import jax.numpy as jnp
+
+        from isotope_tpu.compiler.compile import ensemble_take
+        from isotope_tpu.sim.splitting import severity_scores_device
+
+        @functools.partial(
+            jax.jit, static_argnames=("rank", "slo_s", "keep")
+        )
+        def advance(summ, pol, tb, ids, cur, carry, *,
+                    rank, slo_s, keep):
+            sev = severity_scores_device(
+                rank, summ, slo_s, policies=pol
+            )
+            order = jnp.lexsort((tb, sev))
+            surv = order[:keep]
+            return (
+                sev,
+                order,
+                ensemble_take(cur, surv),
+                ensemble_take(carry, surv),
+                jnp.take(ids, surv),
+                jnp.take(tb, surv),
+            )
+
+        _RANK_ADVANCE_PROT = advance
+    return _RANK_ADVANCE_PROT
+
+
+def run_search_protected(sim, load, num_requests: int, key,
+                         spec: SearchSpec, *, roll: bool = False,
+                         block_size: int = 65_536,
+                         chunk: Optional[int] = None,
+                         window_s: Optional[float] = None
+                         ) -> SearchSummary:
+    """A successive-halving bracket over a PROTECTED population — the
+    config-search residual (a): each candidate is a full
+    ``run_policies`` / ``run_rollouts`` member whose breakers,
+    budgets, HPA, and rollout controller ride the carry BETWEEN rungs
+    via the :meth:`Simulator.run_policies_ensemble` carry-I/O
+    contract.  Survivors continue their control state where the rung
+    stopped — a breaker that tripped at the screening horizon is still
+    open when the next rung resumes.
+
+    Ranking goes through the same device severity channels, with the
+    ``trips`` channel (breaker trips + budget ejections from the
+    stacked ``PolicySummary``) available to rank control-plane pain
+    directly.  The flight-recorder window grid is planned ONCE over
+    the full horizon (the carry's windowed accumulator must keep one
+    static shape across rungs), so a 1-rung bracket is bit-identical
+    to the protected fleet at the same horizon, and rung 0's member
+    rows replay the protected fleet's exact streams (fold
+    ``1_000_000 + b``, zero carries)."""
+    import jax
+    import jax.numpy as jnp
+
+    from isotope_tpu.compiler.compile import compile_ensemble
+
+    if roll and sim._rollouts is None:
+        raise ValueError(
+            "protected rollout brackets need compiled rollout tables "
+            "(Simulator(..., rollouts=...))"
+        )
+    if not roll and sim._policies is None:
+        raise ValueError(
+            "protected policy brackets need compiled policy tables "
+            "(Simulator(..., policies=...))"
+        )
+    if not sim.params.timeline:
+        raise ValueError(
+            "protected brackets need SimParams(timeline=True) — the "
+            "flight recorder is the control loop's observation side"
+        )
+    if sim._saturated(load):
+        raise ValueError(
+            "protected brackets do not support saturated -qps max "
+            "loads (see run_policies)"
+        )
+    spec.check()
+    sim._check_lb_load(load)
+    pop = spec.candidates
+    tables = compile_ensemble(pop)
+    args = sim._ensemble_args(
+        load, num_requests, key, pop, tables,
+        block_size=block_size, trim=False,
+    )
+    block, conns = args["block"], args["conns"]
+    plan = plan_bracket(spec, num_requests, block)
+    tl_plan = sim.plan_timeline_windows(
+        args["num_blocks"] * block, float(args["offered"][0]),
+        window_s,
+    )
+    with_pol = sim._policies is not None
+    telemetry.counter_inc("search_protected_runs")
+    telemetry.gauge_set("search_candidates", pop.members)
+    telemetry.gauge_set("search_rungs", spec.rungs)
+    telemetry.set_meta(
+        "search_path", "protected-rollouts" if roll else "protected"
+    )
+    cap = chunk if chunk is not None else spec.chunk
+    if cap is None:
+        cap = sim.protected_ensemble_chunk(
+            plan[0].bucket, block, tl_plan, roll,
+        )
+
+    def dispatch(rp, xs):
+        chunk_sz = max(1, min(rp.bucket, _floor_pow2(cap)))
+        n_chunks = -(-rp.width // chunk_sz)
+        total = n_chunks * chunk_sz
+        fn = sim._get_protected_ensemble(
+            block, rp.num_blocks, args["kind"], conns, False,
+            tl_plan, roll, chunk_sz, tables.jittered, tables.mode,
+            False, attr=None, carry_io=True,
+        )
+        padded = sim._ensemble_pad_args(xs, rp.width, total)
+        if n_chunks == 1 and chunk_sz == rp.width:
+            out, cout = fn(*padded)
+            return out, cout, chunk_sz
+        parts, carries = [], []
+        for ci in range(n_chunks):
+            sl = slice(ci * chunk_sz, (ci + 1) * chunk_sz)
+            out, cout = fn(*(x[sl] for x in padded))
+            parts.append(out)
+            carries.append(cout)
+            if n_chunks > 1:
+                jax.block_until_ready(parts[-1][0].count)
+        return (
+            _device_concat(parts, rp.width),
+            _device_concat(carries, rp.width),
+            chunk_sz,
+        )
+
+    cur = sim._ensemble_stacked_args(args)
+    carry = sim.zero_protected_carry(
+        pop.members, conns, tl_plan, roll=roll,
+    )
+    tb = tiebreak_draws(spec)
+    ids = jnp.arange(pop.members, dtype=jnp.int32)
+    lineage = []
+    chunk_szs = []
+    rung_costs = []
+    advance = _rank_advance_protected_fn()
+    traces0 = telemetry.counter_get("engine_traces")
+    for r, rp in enumerate(plan):
+        rt0 = telemetry.counter_get("engine_traces")
+        rc0 = telemetry.phase_seconds("compile.jit_first_call")
+        b0 = np.full((rp.width,), rp.start_block, np.int32)
+        out, carry_out, chunk_sz = dispatch(
+            rp, cur + (b0,) + tuple(jax.tree.leaves(carry))
+        )
+        # out = (summary, tl[, roll][, pol]) — the universal member
+        # ordering; pol feeds the trips severity channel
+        summ = out[0]
+        pol = out[2 + (1 if roll else 0)] if with_pol else None
+        keep = plan[r + 1].width if r + 1 < len(plan) else 1
+        sev, order, cur_n, carry_n, ids_n, tb_n = advance(
+            summ, pol, tb, ids, cur, carry_out,
+            rank=spec.rank, slo_s=spec.slo_s, keep=keep,
+        )
+        lineage.append((ids, sev, order, summ))
+        chunk_szs.append(chunk_sz)
+        rung_costs.append((
+            int(telemetry.counter_get("engine_traces") - rt0),
+            telemetry.phase_seconds("compile.jit_first_call") - rc0,
+        ))
+        cur, carry, ids, tb = cur_n, carry_n, ids_n, tb_n
+    traces = int(telemetry.counter_get("engine_traces") - traces0)
+    telemetry.gauge_set("search_traces", traces)
+    lineage = jax.device_get(lineage)
+    rungs = []
+    for rp, (ids_r, sev_r, order_r, summ_r), chunk_sz, cost in zip(
+        plan, lineage, chunk_szs, rung_costs
+    ):
+        ids_np = np.asarray(ids_r)
+        order_np = np.asarray(order_r)
+        keep = (
+            plan[rp.rung + 1].width
+            if rp.rung + 1 < len(plan) else 1
+        )
+        rungs.append(RungResult(
+            rung=rp.rung,
+            width=rp.width,
+            chunk=int(chunk_sz),
+            start_block=rp.start_block,
+            num_blocks=rp.num_blocks,
+            cum_requests=rp.cum_requests,
+            candidates=ids_np,
+            severity=np.asarray(sev_r),
+            survivors=ids_np[order_np[:keep]],
+            summaries=summ_r,
+            order=order_np,
+            traces=cost[0],
+            compile_s=cost[1],
+        ))
+    winner = int(rungs[-1].survivors[0])
+    win_row = int(np.where(rungs[-1].candidates == winner)[0][0])
+    return SearchSummary(
+        spec=spec,
+        block=block,
+        plan=plan,
+        rungs=rungs,
+        winner=winner,
+        winner_severity=float(rungs[-1].severity[win_row]),
+        offered_qps=args["offered"],
+        traces=traces,
+        mode=tables.mode,
+    )
+
+
 def run_search_sharded(sh, load, num_requests: int, key,
                        spec: SearchSpec, *,
                        block_size: int = 65_536,

@@ -56,7 +56,9 @@ from isotope_tpu.sim.ensemble import wilson_interval
 SPLIT_SCHEMA = "isotope-splitting/v1"
 
 #: severity statistics the estimator can rank members by
-SEVERITIES = ("err_peak", "err_share", "p99")
+#: ("trips" ranks PROTECTED fleets by breaker-trip + budget-ejection
+#: events — the severity channel protected search brackets screen on)
+SEVERITIES = ("err_peak", "err_share", "p99", "trips")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -299,6 +301,7 @@ def severity_scores(
     spec: SplitSpec,
     summaries,
     timelines=None,
+    policies=None,
 ) -> np.ndarray:
     """Per-member severity from a fleet's stacked outputs.
 
@@ -308,8 +311,16 @@ def severity_scores(
       ``err_share`` when no timeline rode the fleet;
     - ``err_share``: the run-long client error share;
     - ``p99``: the member's p99 latency in units of ``spec.slo_s``
-      (severity 1.0 == exactly at the SLO — "SLO-violation depth").
+      (severity 1.0 == exactly at the SLO — "SLO-violation depth");
+    - ``trips``: breaker trips + retry-budget ejections summed over
+      services from the stacked ``PolicySummary`` (``policies``) —
+      the control-plane severity of a PROTECTED fleet; falls back to
+      ``err_share`` when no policy summary rode the fleet.
     """
+    if spec.severity == "trips" and policies is not None:
+        trips = np.asarray(policies.trips, np.float64)       # (N, S)
+        ej = np.asarray(policies.ejections, np.float64)      # (N, S)
+        return trips.sum(axis=-1) + ej.sum(axis=-1)
     if spec.severity == "p99":
         if spec.slo_s is None or spec.slo_s <= 0:
             raise ValueError(
@@ -337,6 +348,7 @@ def severity_scores_device(
     severity: str,
     summaries,
     slo_s=None,
+    policies=None,
 ):
     """On-device twin of :func:`severity_scores` over a member-stacked
     fleet summary — the rank channel of the search brackets
@@ -348,12 +360,19 @@ def severity_scores_device(
     client error share; ``err_peak`` falls back to ``err_share``
     exactly like the host function does when no recorder timeline
     rode the fleet (search fleets carry none — VET-T026 warns at the
-    spec layer).  Every bracket path (solo, sharded, emulated) ranks
+    spec layer); ``trips`` sums breaker trips + budget ejections from
+    the stacked ``PolicySummary`` (``policies`` — the protected
+    bracket's rank channel), falling back to ``err_share`` on plain
+    fleets.  Every bracket path (solo, sharded, emulated) ranks
     through THIS function, so severities — and therefore survivor
     lineages — are bit-identical across them.
     """
     import jax.numpy as jnp
 
+    if severity == "trips" and policies is not None:
+        trips = jnp.asarray(policies.trips, jnp.float32)
+        ej = jnp.asarray(policies.ejections, jnp.float32)
+        return trips.sum(axis=-1) + ej.sum(axis=-1)
     if severity == "p99":
         if slo_s is None or slo_s <= 0:
             raise ValueError(

@@ -116,6 +116,8 @@ def test_supported_bounds_grid():
     )
 
 
+@pytest.mark.slow
+@pytest.mark.slow
 def test_engine_pallas_on_matches_off():
     """End to end: pallas_census=True (interpreter on CPU) reproduces
     the op-by-op engine within 1 ULP on floats, exactly on discrete

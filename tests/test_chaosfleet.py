@@ -222,6 +222,7 @@ def test_chaos_jitter_parse():
         faults.parse_chaos_jitter("tim=0.2")
 
 
+@pytest.mark.slow
 def test_member_chaos_identity_matches_plain_fleet(psim):
     """Per-member chaos OFF (and the identity jitter) = the PR 12
     fleet bit-for-bit: the traced chaos rows carry the same values the
@@ -270,7 +271,7 @@ def test_member_chaos_member_matches_solo_schedule(psim, storm):
 
 def test_member_chaos_rejections(storm):
     _, compiled, pol = storm
-    # no chaos schedule to jitter
+    # no chaos schedule to jitter — still a loud error
     nochaos = Simulator(compiled, SimParams(timeline=True),
                         policies=pol)
     with pytest.raises(ValueError, match="base chaos schedule"):
@@ -278,18 +279,228 @@ def test_member_chaos_rejections(storm):
             OPEN, N, KEY, EnsembleSpec.of(2),
             member_chaos=faults.ChaosJitterSpec(time=0.1),
         )
-    # ungraceful kills keep host-constant reset tables
-    ungraceful = Simulator(
-        compiled, SimParams(timeline=True),
-        chaos=(ChaosEvent("worker", 0.1, 0.3, replicas_down=3,
-                          drain=False),),
-        policies=pol,
+
+
+def test_protected_carry_export_bit_equal(psim):
+    """The run_policies_ensemble carry-I/O contract: exporting the
+    member carry perturbs NOTHING (zero carry_in + block_offset 0 is
+    bit-identical to the plain fleet), and the carry comes back as a
+    member-stacked pytree a later segment (or a search rung) can
+    resume from."""
+    spec = EnsembleSpec.of(2, mode="map")
+    kw = dict(block_size=BLOCK, window_s=WIN)
+    plain = psim.run_policies_ensemble(OPEN, N, KEY, spec, **kw)
+    ens, carry = psim.run_policies_ensemble(
+        OPEN, N, KEY, spec, return_carry=True, **kw
     )
-    with pytest.raises(ValueError, match="ungraceful"):
-        ungraceful.run_ensemble(
-            OPEN, N, KEY, EnsembleSpec.of(2),
-            member_chaos=faults.ChaosJitterSpec(time=0.1),
+    for a, b in zip(jax.tree.leaves(plain.summaries),
+                    jax.tree.leaves(ens.summaries)):
+        assert np.array_equal(np.asarray(a), np.asarray(b))
+    assert np.array_equal(
+        np.asarray(plain.policies.trips),
+        np.asarray(ens.policies.trips),
+    )
+    leaves = jax.tree.leaves(carry)
+    assert leaves
+    assert all(np.asarray(x).shape[:1] == (2,) for x in leaves)
+    # the export path keeps its preconditions loud
+    with pytest.raises(ValueError, match="carry"):
+        psim.run_policies_ensemble(
+            OPEN, N, KEY, spec, trim=True, return_carry=True, **kw
         )
+
+
+# -- universal member compositions (PR 18) ----------------------------------
+#
+# The four compositions the pre-universal member REJECTED (ungraceful
+# kills, LB panic pools, saturated -qps max, rollout kill splits) now
+# simulate — their tables became traced per-member arguments of the
+# ONE member program.  Each pin: the composed fleet's member k is
+# BIT-IDENTICAL to the solo Simulator built with member k's jittered
+# schedule.
+
+UNGRACEFUL = (ChaosEvent("worker", 0.1, 0.3, replicas_down=3,
+                         drain=False),)
+SAT = LoadModel(kind="closed", qps=None, connections=8)
+REPS = {"entry": 4, "worker": 4}
+
+LB_YAML = """
+policies:
+  worker:
+    lb: {policy: least_request, panic_threshold: 50%}
+"""
+
+ROLLOUT_YAML = """
+rollouts:
+  defaults:
+    gates: {min_samples: 20}
+  worker:
+    steps: [10%, 50%, 100%]
+    bake: 2s
+    rollback: {cooldown: 4s, max_retries: 1}
+    canary: {error_rate: 30%}
+"""
+
+BASE_YAML = STORM.split("policies:")[0]
+
+
+def _jittered(events, k):
+    return faults.jitter_chaos_events(
+        events, JITTER,
+        faults.member_event_seeds(JITTER, k, len(events)), REPS,
+    )
+
+
+def _pin_member(stacked, solo, k, names=("latency_hist", "count")):
+    for name in names:
+        assert np.array_equal(
+            np.asarray(getattr(stacked, name))[k],
+            np.asarray(getattr(solo, name)),
+        ), name
+
+
+def test_chaos_x_ungraceful_member_matches_solo():
+    """Ungraceful (drain: false) kill resets jitter per member."""
+    c = compile_graph(ServiceGraph.from_yaml(BASE_YAML))
+    jit = _jittered(UNGRACEFUL, 1)
+    ens = Simulator(c, chaos=UNGRACEFUL).run_ensemble(
+        OPEN, N, KEY, EnsembleSpec.of(2, mode="map"),
+        block_size=BLOCK, member_chaos=[UNGRACEFUL, jit],
+    )
+    solo = Simulator(c, chaos=jit).run_summary(
+        OPEN, N, jax.random.fold_in(KEY, 1), block_size=BLOCK
+    )
+    _pin_member(ens.summaries, solo, 1)
+
+
+def test_chaos_x_lb_panic_member_matches_solo():
+    """LB panic healthy-pool tables jitter per member."""
+    from isotope_tpu.compiler import compile_lb
+
+    g = ServiceGraph.from_yaml(BASE_YAML + LB_YAML)
+    c = compile_graph(g)
+    lbt = compile_lb(g, c)
+    jit = _jittered(CHAOS, 1)
+    ens = Simulator(c, chaos=CHAOS, lb=lbt).run_ensemble(
+        OPEN, N, KEY, EnsembleSpec.of(2, mode="map"),
+        block_size=BLOCK, member_chaos=[CHAOS, jit],
+    )
+    solo = Simulator(c, chaos=jit, lb=lbt).run_summary(
+        OPEN, N, jax.random.fold_in(KEY, 1), block_size=BLOCK
+    )
+    _pin_member(ens.summaries, solo, 1)
+
+
+def test_chaos_x_saturated_member_matches_solo():
+    """Finite-population (-qps max) MVA tables jitter per member."""
+    c = compile_graph(ServiceGraph.from_yaml(BASE_YAML))
+    jit = _jittered(CHAOS, 1)
+    ens = Simulator(c, chaos=CHAOS).run_ensemble(
+        SAT, N, KEY, EnsembleSpec.of(2, mode="map"),
+        block_size=BLOCK, member_chaos=[CHAOS, jit],
+    )
+    solo = Simulator(c, chaos=jit).run_summary(
+        SAT, N, jax.random.fold_in(KEY, 1), block_size=BLOCK
+    )
+    _pin_member(ens.summaries, solo, 1)
+
+
+def test_chaos_x_rollout_member_matches_solo(storm):
+    """Canary-first kill-split tables jitter per member — the rollout
+    fleet composition the pre-universal member rejected outright."""
+    from isotope_tpu.compiler import compile_rollouts
+
+    g = ServiceGraph.from_yaml(STORM + ROLLOUT_YAML)
+    c = compile_graph(g)
+    pol = compile_policies(g, c)
+    rt = compile_rollouts(g, c)
+    jit = _jittered(CHAOS, 1)
+    sim = Simulator(c, SimParams(timeline=True), chaos=CHAOS,
+                    policies=pol, rollouts=rt)
+    ens = sim.run_rollouts_ensemble(
+        OPEN, N, KEY, EnsembleSpec.of(2, mode="map"),
+        block_size=BLOCK, trim=True, window_s=WIN,
+        member_chaos=[CHAOS, jit],
+    )
+    solo_sim = Simulator(c, SimParams(timeline=True), chaos=jit,
+                         policies=pol, rollouts=rt)
+    solo = solo_sim.run_rollouts(
+        OPEN, N, jax.random.fold_in(KEY, 1), block_size=BLOCK,
+        trim=True, window_s=WIN,
+    )
+    _pin_member(ens.summaries, solo[0], 1)
+    assert np.array_equal(
+        np.asarray(ens.rollouts.weight)[1],
+        np.asarray(solo[2].weight),
+    )
+
+
+def test_all_on_member_matches_solo():
+    """Everything at once: policies + LB panic + rollout kill split +
+    UNGRACEFUL member-jittered chaos in one fleet program."""
+    from isotope_tpu.compiler import compile_lb, compile_rollouts
+
+    all_on = STORM.replace(
+        "  worker:\n    breaker:",
+        "  worker:\n    lb: {policy: least_request, "
+        "panic_threshold: 50%}\n    breaker:",
+    ) + ROLLOUT_YAML
+    g = ServiceGraph.from_yaml(all_on)
+    c = compile_graph(g)
+    pol = compile_policies(g, c)
+    rt = compile_rollouts(g, c)
+    lbt = compile_lb(g, c)
+    jit = _jittered(UNGRACEFUL, 1)
+    sim = Simulator(c, SimParams(timeline=True), chaos=UNGRACEFUL,
+                    policies=pol, rollouts=rt, lb=lbt)
+    ens = sim.run_rollouts_ensemble(
+        OPEN, N, KEY, EnsembleSpec.of(2, mode="map"),
+        block_size=BLOCK, trim=True, window_s=WIN,
+        member_chaos=[UNGRACEFUL, jit],
+    )
+    solo_sim = Simulator(c, SimParams(timeline=True), chaos=jit,
+                         policies=pol, rollouts=rt, lb=lbt)
+    solo = solo_sim.run_rollouts(
+        OPEN, N, jax.random.fold_in(KEY, 1), block_size=BLOCK,
+        trim=True, window_s=WIN,
+    )
+    _pin_member(ens.summaries, solo[0], 1)
+    assert np.array_equal(
+        np.asarray(ens.rollouts.weight)[1],
+        np.asarray(solo[2].weight),
+    )
+
+
+def test_composed_sharded_matches_emulated():
+    """The rollout x member-chaos composition agrees across the
+    sharded device-mesh path and its emulated twin."""
+    from isotope_tpu.compiler import compile_rollouts
+    from isotope_tpu.parallel import (
+        MeshSpec,
+        ShardedSimulator,
+        build_mesh,
+    )
+
+    g = ServiceGraph.from_yaml(STORM + ROLLOUT_YAML)
+    c = compile_graph(g)
+    sh = ShardedSimulator(
+        c, build_mesh(MeshSpec(data=2, svc=2)),
+        SimParams(timeline=True), CHAOS,
+        policies=compile_policies(g, c),
+        rollouts=compile_rollouts(g, c),
+    )
+    spec = EnsembleSpec.of(4, mode="map")
+    kw = dict(block_size=BLOCK, window_s=WIN, member_chaos=JITTER)
+    a = sh.run_rollouts_ensemble(OPEN, N, KEY, spec, **kw)
+    b = sh.run_rollouts_ensemble_emulated(OPEN, N, KEY, spec, **kw)
+    assert np.array_equal(
+        np.asarray(a.summaries.latency_hist),
+        np.asarray(b.summaries.latency_hist),
+    )
+    assert np.array_equal(
+        np.asarray(a.rollouts.weight),
+        np.asarray(b.rollouts.weight),
+    )
 
 
 # -- protected fleets (engine) ----------------------------------------------
@@ -344,6 +555,7 @@ def test_protected_fleet_severity_and_doc(pfleet):
     assert doc_member_quantiles(doc).shape == (3, 3)
 
 
+@pytest.mark.slow
 def test_protected_fleet_vmap_matches_map(psim, pfleet):
     v = psim.run_policies_ensemble(
         OPEN, N, KEY, EnsembleSpec.of(3, mode="vmap"),
@@ -359,6 +571,8 @@ def test_protected_fleet_vmap_matches_map(psim, pfleet):
     )
 
 
+@pytest.mark.slow
+@pytest.mark.slow
 def test_sharded_protected_fleet_bit_equal_twin(storm):
     from isotope_tpu.parallel import (
         MeshSpec,

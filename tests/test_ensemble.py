@@ -202,6 +202,8 @@ def test_vet_m004_reports_auto_chunk():
 # -- sharded == emulated twin ------------------------------------------
 
 
+@pytest.mark.slow
+@pytest.mark.slow
 def test_sharded_fleet_bit_equals_emulated_twin(compiled):
     from isotope_tpu.parallel import (
         EmulatedMesh,

@@ -127,6 +127,8 @@ def test_member_k_blame_bit_equals_solo_open(asim, obs4):
     assert _leaves_equal(solo_tl, obs4.member_timeline(k))
 
 
+@pytest.mark.slow
+@pytest.mark.slow
 def test_member_k_blame_bit_equals_solo_closed(asim):
     fleet = asim.run_ensemble(
         CLOSED, N, KEY, EnsembleSpec.of(3), block_size=BLOCK,
@@ -148,6 +150,8 @@ def test_chunked_observed_equals_unchunked(asim, obs4):
     assert _leaves_equal(obs4.timelines, chunked.timelines)
 
 
+@pytest.mark.slow
+@pytest.mark.slow
 def test_tail_mode_fleet_equals_solo(asim):
     cut = 0.012
     fleet = asim.run_ensemble(
@@ -165,6 +169,7 @@ def test_tail_mode_fleet_equals_solo(asim):
 # -- sharded == emulated twin == engine --------------------------------
 
 
+@pytest.mark.slow
 def test_sharded_observed_fleet_bit_equal(compiled, asim, obs4):
     from isotope_tpu.parallel import (
         MeshSpec,
@@ -215,6 +220,8 @@ policies:
 """
 
 
+@pytest.mark.slow
+@pytest.mark.slow
 def test_protected_fleet_blame_bit_equals_solo():
     g = ServiceGraph.from_yaml(STORM)
     compiled = compile_graph(g)
@@ -390,6 +397,7 @@ def test_vet_m006_fires_on_over_capacity_observed_fleet(monkeypatch):
 # -- runner + explain subcommand ---------------------------------------
 
 
+@pytest.mark.slow
 def test_runner_fleet_blame_artifacts_and_explain(tmp_path):
     from isotope_tpu.commands.explain_cmd import run_explain_cmd
     from isotope_tpu.runner.config import (

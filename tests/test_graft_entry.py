@@ -3,6 +3,7 @@ import sys
 import pathlib
 
 import jax
+import pytest
 
 sys.path.insert(0, str(pathlib.Path(__file__).parent.parent))
 
@@ -16,5 +17,7 @@ def test_entry_jits_single_device():
     assert int(res.hop_events) == 2048 * 121
 
 
+@pytest.mark.slow
+@pytest.mark.slow
 def test_dryrun_multichip_8():
     __graft_entry__.dryrun_multichip(8)

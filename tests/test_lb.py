@@ -607,6 +607,8 @@ def test_policies_simulator_keeps_bucketed_plan():
     assert any(isinstance(p, ScanBucketPlan) for p in sim._plan)
 
 
+@pytest.mark.slow
+@pytest.mark.slow
 def test_protected_scan_bucket_pins_to_unrolled():
     """The acceptance pin: run_policies under the default bucketed
     plan vs the unrolled plan — <= 1 ULP on every leaf (same law,

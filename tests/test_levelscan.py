@@ -133,14 +133,19 @@ def _assert_equivalent(compiled, load=OPEN, n=256, params=(), chaos=(),
     return sim_scan, sim_unrl
 
 
+@pytest.mark.slow
 def test_tree121_equivalent():
     _assert_equivalent(_tree121())
 
 
+@pytest.mark.slow
+@pytest.mark.slow
 def test_skewed_multitier_equivalent():
     _assert_equivalent(_multitier())
 
 
+@pytest.mark.slow
+@pytest.mark.slow
 def test_retry_timeout_equivalent():
     _assert_equivalent(_retry_graph())
 
@@ -152,6 +157,8 @@ def test_retry_timeout_closed_loop_equivalent():
     )
 
 
+@pytest.mark.slow
+@pytest.mark.slow
 def test_chaos_equivalent():
     _assert_equivalent(
         _retry_graph(),
@@ -159,6 +166,8 @@ def test_chaos_equivalent():
     )
 
 
+@pytest.mark.slow
+@pytest.mark.slow
 def test_sparse_island_mix_equivalent():
     """A forced-sparse hub level keeps its unrolled specialized path
     while the levels around it scan — both executors must agree."""

@@ -196,6 +196,7 @@ def test_hierarchical_merge_matches_flat_statistics(compiled):
 ])
 @pytest.mark.parametrize("load,trim", [(OPEN, False), (OPEN, True),
                                        (CLOSED, False)])
+@pytest.mark.slow
 def test_overlap_equivalence(compiled, spec, load, trim):
     """ISSUE satellite: overlap on == off — exact on integer-valued
     fields, f32 reduction-order noise on float sums (the pipelined
@@ -229,6 +230,8 @@ def test_overlap_equivalence(compiled, spec, load, trim):
     )
 
 
+@pytest.mark.slow
+@pytest.mark.slow
 def test_overlap_equivalence_eager(compiled):
     """The satellite's eager pin: under jax.disable_jit the overlap
     body executes its collectives op-by-op and must still reproduce

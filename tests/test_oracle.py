@@ -290,6 +290,8 @@ def test_open_loop_fidelity(name, yaml_text, rho):
         ("tree13", TREE13, 0.90, 0.05, 0.05),
     ],
 )
+@pytest.mark.slow
+@pytest.mark.slow
 def test_open_loop_high_rho_envelope(name, yaml_text, rho, tol_p50, tol_p99):
     load = LoadModel(kind="open", qps=rho * MU)
     fidelity_case(
@@ -349,6 +351,8 @@ def test_closed_loop_saturated_throughput():
         ("star9", STAR9, (-0.23, 0.03), (-0.16, 0.03)),
     ],
 )
+@pytest.mark.slow
+@pytest.mark.slow
 def test_closed_loop_saturated_fidelity(name, yaml_text, tol_p50, tol_p99):
     # The reference's CANONICAL experiment mode: qps="max", 64
     # connections (isotope/example-config.toml [client]); r3's +79% p99
@@ -481,6 +485,8 @@ def test_closed_loop_saturated_heavy_tails(service_time, param, tol_p50,
     )
 
 
+@pytest.mark.slow
+@pytest.mark.slow
 def test_closed_loop_saturated_fork_join_throughput():
     # fork-join saturated throughput: self-consistent fixed point lands
     # within 8% of the oracle (r4 measured: tree13 +6.3%, star9 +5.2%).

@@ -58,6 +58,8 @@ COUNT_FIELDS = (
 )
 
 
+@pytest.mark.slow
+@pytest.mark.slow
 @pytest.mark.parametrize("tail", [False, True])
 def test_packed_equals_unpacked_exactly(compiled, tail):
     s1, a1 = _attr(compiled, packed=True, tail=tail)
@@ -78,6 +80,7 @@ def test_packed_equals_unpacked_exactly(compiled, tail):
         )
 
 
+@pytest.mark.slow
 def test_packed_dtypes(compiled):
     _, a = _attr(compiled, packed=True, tail=True)
     for f in COUNT_FIELDS:
@@ -90,12 +93,15 @@ def test_packed_dtypes(compiled):
         assert np.asarray(getattr(a, f)).dtype == np.float32, f
 
 
+@pytest.mark.slow
 def test_packed_default_on(compiled):
     assert SimParams().packed_carries is True
     _, a = _attr(compiled, packed=True)
     assert np.asarray(a.count).dtype == np.int32
 
 
+@pytest.mark.slow
+@pytest.mark.slow
 def test_packed_sharded_matches_emulated_twin(compiled):
     """int32 carries through the mesh psum stay bit-equal to the
     host-merged emulated twin (integer addition is associative)."""

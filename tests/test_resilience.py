@@ -247,6 +247,7 @@ def _ulp_diff(a, b) -> float:
     return float(np.max(np.where(same, 0.0, diff)))
 
 
+@pytest.mark.slow
 def test_sharded_gather_oom_degrades_to_identical_results():
     """ISSUE acceptance: OOM injected at sharded.gather -> the ladder
     completes the run on the single-device rung with every summary

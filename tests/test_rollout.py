@@ -460,6 +460,9 @@ def tables_names(t):
 # -- sharded twin ----------------------------------------------------------
 
 
+@pytest.mark.slow
+@pytest.mark.slow
+@pytest.mark.slow
 def test_sharded_rollouts_bit_equal_to_emulated_twin(canary_case):
     from isotope_tpu.parallel import (
         MeshSpec,
@@ -483,6 +486,9 @@ def test_sharded_rollouts_bit_equal_to_emulated_twin(canary_case):
     assert np.asarray(dev[2].rollbacks).sum() >= 1.0
 
 
+@pytest.mark.slow
+@pytest.mark.slow
+@pytest.mark.slow
 def test_sharded_protected_attribution_bit_equal(canary_case):
     """ROADMAP open item (c): the sharded protected run reduces blame
     with the run_attributed collectives, bit-equal to the emulated
@@ -537,6 +543,9 @@ def test_sharded_rollouts_reject_svc_mesh(canary_case):
         )
 
 
+@pytest.mark.slow
+@pytest.mark.slow
+@pytest.mark.slow
 def test_emulated_mesh_rollout_twin_runs(canary_case):
     from isotope_tpu.parallel import MeshSpec, ShardedSimulator
     from isotope_tpu.parallel.mesh import EmulatedMesh

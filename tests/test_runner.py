@@ -156,6 +156,8 @@ def test_heavy_tail_toml_plumbing(tmp_path):
     assert results and results[0].flat["p50"] > 0
 
 
+@pytest.mark.slow
+@pytest.mark.slow
 def test_sweep_profile_captures_traces(tmp_path):
     import glob
 

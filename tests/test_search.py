@@ -485,3 +485,123 @@ def test_vet_m005_widest_rung_capacity(sim, monkeypatch):
         ),
         64, 8,
     ) == []
+
+
+# -- protected brackets (PR 18) ----------------------------------------------
+#
+# Successive halving over a PROTECTED population: every candidate is a
+# full run_policies member whose breakers / budgets / HPA ride the
+# carry between rungs via the run_policies_ensemble carry-I/O
+# contract.  The pins: rung 0 bit-equal to the protected fleet at the
+# screening horizon; the winner's carry-continued trajectory equal to
+# the unbroken fleet's member row on every exact field; the "trips"
+# severity channel ranks by breaker trips + budget ejections.
+
+STORM_YAML = """
+services:
+- name: entry
+  isEntrypoint: true
+  numReplicas: 4
+  script:
+  - call: {service: worker, timeout: 850us, retries: 2}
+- name: worker
+  numReplicas: 4
+  errorRate: 0.5%
+policies:
+  defaults:
+    retry_budget: {budget_percent: 25%}
+  worker:
+    breaker: {max_pending: 6, max_connections: 64,
+              consecutive_errors: 5, base_ejection: 2s}
+    autoscaler: {min_replicas: 2, max_replicas: 8,
+                 target_utilization: 60%, sync_period: 1s,
+                 stabilization_window: 3s}
+"""
+
+P_OPEN = LoadModel(kind="open", qps=4_000.0)
+P_N, P_BLOCK, P_WIN = 2_048, 1_024, 0.25
+
+
+@pytest.fixture(scope="module")
+def psim():
+    from isotope_tpu.compiler import compile_policies
+    from isotope_tpu.sim.config import SimParams
+
+    g = ServiceGraph.from_yaml(STORM_YAML)
+    c = compile_graph(g)
+    return Simulator(c, SimParams(timeline=True),
+                     policies=compile_policies(g, c))
+
+
+@pytest.fixture(scope="module")
+def ppop():
+    return EnsembleSpec.from_jitter(
+        8, qps_jitter=0.2, cpu_jitter=0.1, error_jitter=0.3
+    )
+
+
+@pytest.fixture(scope="module")
+def psrch(psim, ppop):
+    return psim.run_search_protected(
+        P_OPEN, P_N, KEY, SearchSpec(candidates=ppop, eta=4, rungs=2),
+        block_size=P_BLOCK, window_s=P_WIN,
+    )
+
+
+def test_protected_bracket_rung0_bit_equal_protected_fleet(
+    psim, ppop, psrch
+):
+    r0 = psrch.rungs[0]
+    ens = psim.run_policies_ensemble(
+        P_OPEN, r0.cum_requests, KEY, ppop,
+        block_size=P_BLOCK, window_s=P_WIN,
+    )
+    for a, b in zip(jtu.tree_leaves(r0.summaries),
+                    jtu.tree_leaves(ens.summaries)):
+        assert np.array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_protected_bracket_winner_resume_bit_equal_fleet(
+    psim, ppop, psrch
+):
+    """The carry contract's acceptance pin: the winner's rung-0 +
+    rung-1 segments (control state carried between rungs) accumulate
+    to the UNBROKEN full-horizon fleet's member row on every exact
+    field — the survivor kept its breakers and budgets."""
+    full = psim.run_policies_ensemble(
+        P_OPEN, P_N, KEY, ppop, block_size=P_BLOCK, window_s=P_WIN,
+    )
+    k = psrch.winner
+    win = psrch.winner_summary()
+    for name in ("count", "error_count", "latency_hist"):
+        assert np.array_equal(
+            np.asarray(getattr(win, name)),
+            np.asarray(getattr(full.summaries, name))[k],
+        ), name
+
+
+def test_protected_bracket_trips_rank_and_doc(psim, ppop):
+    srch = psim.run_search_protected(
+        P_OPEN, P_N, KEY,
+        SearchSpec(candidates=ppop, eta=4, rungs=2, rank="trips"),
+        block_size=P_BLOCK, window_s=P_WIN,
+    )
+    assert srch.rungs[0].severity.shape == (8,)
+    assert np.all(srch.rungs[0].severity >= 0.0)
+    doc = srch.to_doc()
+    assert doc["rank_effective"] == "trips"
+    check_doc(doc)
+
+
+def test_protected_bracket_rejections(sim, psim, ppop):
+    spec = SearchSpec(candidates=ppop, eta=4, rungs=2)
+    # no policy tables compiled
+    with pytest.raises(ValueError, match="polic"):
+        sim.run_search_protected(OPEN, N, KEY, spec,
+                                 block_size=BLOCK)
+    # saturated -qps max load
+    with pytest.raises(ValueError, match="saturated"):
+        psim.run_search_protected(
+            LoadModel(kind="closed", qps=None, connections=8),
+            P_N, KEY, spec, block_size=P_BLOCK,
+        )

@@ -61,6 +61,7 @@ def test_istio_alias_equals_both():
     assert mean_latency("both") == pytest.approx(mean_latency("ISTIO"))
 
 
+@pytest.mark.slow
 def test_sweep_emits_one_row_per_mode(tmp_path):
     topo = tmp_path / "chain.yaml"
     topo.write_text(CHAIN)

@@ -14,6 +14,7 @@ import dataclasses
 
 import jax
 import numpy as np
+import pytest
 
 from isotope_tpu.compiler import compile_graph
 from isotope_tpu.models.graph import ServiceGraph
@@ -93,10 +94,12 @@ def assert_same(rd, rs):
     )
 
 
+@pytest.mark.slow
 def test_sparse_matches_dense_skewed_level():
     assert_same(*both_encodings(SKEWED))
 
 
+@pytest.mark.slow
 def test_sparse_matches_dense_with_error_rates():
     yaml_text = SKEWED.replace(
         "- name: hub\n", "- name: hub\n  errorRate: 30%\n"
@@ -112,6 +115,7 @@ def test_sparse_matches_dense_with_send_probability():
     assert_same(*both_encodings(yaml_text))
 
 
+@pytest.mark.slow
 def test_sparse_matches_dense_with_retries():
     # retries without timeouts stay transport-free (500-triggered only),
     # so the sparse encoding remains valid under multi-attempt calls

@@ -13,6 +13,7 @@ import dataclasses
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 from isotope_tpu.compiler import compile_graph
 from isotope_tpu.compiler.buckets import (
@@ -122,6 +123,7 @@ def _check(yaml_text, chaos=(), n=20_000, tile_pmax=DEFAULT_TILE_PMAX,
     return dense, tiled, sparse
 
 
+@pytest.mark.slow
 def test_tiled_matches_dense_bitwise_eager():
     _check(SKEWED)
 
@@ -143,6 +145,7 @@ def test_tiled_with_send_probability():
     )
 
 
+@pytest.mark.slow
 def test_tiled_with_retries():
     _check(
         SKEWED.replace(
@@ -320,6 +323,7 @@ def test_summary_scan_path_through_tiles():
     )
 
 
+@pytest.mark.slow
 def test_attribution_oblivious_to_tiling():
     """The blame sweep reads only assembled (N, H) outputs, so an
     attributed tiled run reproduces the sparse engine's blame."""

@@ -3,6 +3,7 @@ import json
 
 import jax
 import numpy as np
+import pytest
 import yaml
 
 from isotope_tpu import cli
@@ -103,6 +104,7 @@ def test_unsent_hops_produce_no_spans():
     assert len(doc["traceEvents"]) == int(sent.sum())
 
 
+@pytest.mark.slow
 def test_cli_trace_export(tmp_path, capsys):
     topo = tmp_path / "t.yaml"
     topo.write_text(TOPO)

@@ -306,6 +306,8 @@ def test_oversized_topology_trips_oom_rung_selection():
     )
 
 
+@pytest.mark.slow
+@pytest.mark.slow
 def test_runner_gate_preselects_rung_and_records_degraded(
     tmp_path, monkeypatch
 ):

@@ -119,10 +119,16 @@ def _cases(doc: dict, prefer_best: bool = False) -> dict:
                        "_chaosfleet_worst_severity",
                        "_chaosfleet_split_p",
                        "_chaosfleet_split_evals",
+                       "_composed", "_composed_members",
+                       "_composed_traces",
+                       "_composed_worst_severity",
                        "_search_candidates", "_search_rungs",
                        "_search_traces", "_search_sequential_rate",
                        "_search_speedup")):
-            continue  # evidence / variance keys, not rates
+            # evidence / variance keys, not rates — "_composed" also
+            # drops the svc1000_composed COVERAGE case's rate (its
+            # telemetry degraded_to gate still applies)
+            continue
         cases[k] = float(v)
     if prefer_best:
         for k in list(cases):

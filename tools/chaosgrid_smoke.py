@@ -1,0 +1,252 @@
+"""chaosgrid-smoke: the universal-member composition grid end-to-end.
+
+PR 18 collapsed the ensemble member programs into ONE scan body whose
+chaos tables, policy state, rollout state, and LB tables are optional
+pytree leaves — and promoted the four host/trace constants that used
+to make whole compositions impossible (canary-first kill splits,
+ungraceful-kill resets, LB panic pools, saturated finite-population
+tables) to stacked traced per-member arguments.  This smoke drives
+the composition grid the old member REJECTED, then the all-on case:
+
+1. **Grid**: each formerly-rejected composition (chaos x ungraceful,
+   chaos x LB panic, chaos x saturated ``-qps max``, chaos x rollout)
+   runs as a member-jittered fleet, and the jittered member is
+   BIT-IDENTICAL to the solo Simulator built with its schedule.
+
+2. **All-on fleet**: policies + LB panic + rollout kill split +
+   UNGRACEFUL member-jittered chaos in ONE jitted program.  The kill
+   windows differ across members and the severity statistic spreads.
+
+3. **Worst-member postmortem**: the most-severe all-on member's
+   jittered schedule, replayed through a solo ``run_rollouts``,
+   reproduces the member bit-for-bit — summary histogram AND rollout
+   controller weight series — so the postmortem artifact stays
+   executable even at full composition depth.
+
+``make chaosgrid-smoke`` wires it in next to chaosfleet-smoke.
+"""
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+import numpy as np
+
+BASE = """
+services:
+- name: entry
+  isEntrypoint: true
+  numReplicas: 4
+  script:
+  - call: {service: worker, timeout: 850us, retries: 2}
+- name: worker
+  numReplicas: 4
+  errorRate: 0.5%
+"""
+
+STORM = BASE + """
+policies:
+  defaults:
+    retry_budget: {budget_percent: 25%}
+  worker:
+    breaker: {max_pending: 6, max_connections: 64,
+              consecutive_errors: 5, base_ejection: 2s}
+    autoscaler: {min_replicas: 2, max_replicas: 8,
+                 target_utilization: 60%, sync_period: 1s,
+                 stabilization_window: 3s}
+"""
+
+LB_YAML = """
+policies:
+  worker:
+    lb: {policy: least_request, panic_threshold: 50%}
+"""
+
+ROLLOUT_YAML = """
+rollouts:
+  defaults:
+    gates: {min_samples: 20}
+  worker:
+    steps: [10%, 50%, 100%]
+    bake: 2s
+    rollback: {cooldown: 4s, max_retries: 1}
+    canary: {error_rate: 30%}
+"""
+
+
+def main() -> int:
+    import jax
+
+    from isotope_tpu.compiler import (
+        compile_graph,
+        compile_lb,
+        compile_policies,
+        compile_rollouts,
+    )
+    from isotope_tpu.models.graph import ServiceGraph
+    from isotope_tpu.resilience import faults
+    from isotope_tpu.sim.config import ChaosEvent, LoadModel, SimParams
+    from isotope_tpu.sim.engine import Simulator
+    from isotope_tpu.sim.ensemble import EnsembleSpec
+
+    key = jax.random.PRNGKey(0)
+    open_load = LoadModel(kind="open", qps=4_000.0)
+    sat_load = LoadModel(kind="closed", qps=None, connections=8)
+    n, block, win = 4_096, 1_024, 0.25
+    chaos = (ChaosEvent("worker", 0.1, 0.3, replicas_down=3),)
+    ungraceful = (ChaosEvent("worker", 0.1, 0.3, replicas_down=3,
+                             drain=False),)
+    jitter = faults.ChaosJitterSpec(time=0.3, magnitude=0.5, seed=11)
+    reps = {"entry": 4, "worker": 4}
+
+    def jittered(events, k):
+        return faults.jitter_chaos_events(
+            events, jitter,
+            faults.member_event_seeds(jitter, k, len(events)), reps,
+        )
+
+    # -- 1. the formerly-rejected grid ---------------------------------
+    # each cell: a 2-member fleet ([base schedule, jittered member 1])
+    # whose jittered member must bit-equal the solo Simulator built
+    # with that schedule — count and latency histogram alike
+    def pin(stacked, solo, label):
+        for name in ("count", "latency_hist"):
+            assert np.array_equal(
+                np.asarray(getattr(stacked, name))[1],
+                np.asarray(getattr(solo, name)),
+            ), f"{label}: member 1 != solo ({name})"
+
+    grid = []
+
+    c_plain = compile_graph(ServiceGraph.from_yaml(BASE))
+    jit_u = jittered(ungraceful, 1)
+    ens = Simulator(c_plain, chaos=ungraceful).run_ensemble(
+        open_load, n, key, EnsembleSpec.of(2, mode="map"),
+        block_size=block, member_chaos=[ungraceful, jit_u],
+    )
+    solo = Simulator(c_plain, chaos=jit_u).run_summary(
+        open_load, n, jax.random.fold_in(key, 1), block_size=block
+    )
+    pin(ens.summaries, solo, "chaos x ungraceful")
+    grid.append("ungraceful-kill resets")
+
+    g_lb = ServiceGraph.from_yaml(BASE + LB_YAML)
+    c_lb = compile_graph(g_lb)
+    lbt = compile_lb(g_lb, c_lb)
+    jit_c = jittered(chaos, 1)
+    ens = Simulator(c_lb, chaos=chaos, lb=lbt).run_ensemble(
+        open_load, n, key, EnsembleSpec.of(2, mode="map"),
+        block_size=block, member_chaos=[chaos, jit_c],
+    )
+    solo = Simulator(c_lb, chaos=jit_c, lb=lbt).run_summary(
+        open_load, n, jax.random.fold_in(key, 1), block_size=block
+    )
+    pin(ens.summaries, solo, "chaos x lb-panic")
+    grid.append("LB panic pools")
+
+    ens = Simulator(c_plain, chaos=chaos).run_ensemble(
+        sat_load, n, key, EnsembleSpec.of(2, mode="map"),
+        block_size=block, member_chaos=[chaos, jit_c],
+    )
+    solo = Simulator(c_plain, chaos=jit_c).run_summary(
+        sat_load, n, jax.random.fold_in(key, 1), block_size=block
+    )
+    pin(ens.summaries, solo, "chaos x saturated")
+    grid.append("saturated -qps max")
+
+    g_r = ServiceGraph.from_yaml(STORM + ROLLOUT_YAML)
+    c_r = compile_graph(g_r)
+    pol_r = compile_policies(g_r, c_r)
+    rt_r = compile_rollouts(g_r, c_r)
+    sim_r = Simulator(c_r, SimParams(timeline=True), chaos=chaos,
+                      policies=pol_r, rollouts=rt_r)
+    ens = sim_r.run_rollouts_ensemble(
+        open_load, n, key, EnsembleSpec.of(2, mode="map"),
+        block_size=block, trim=True, window_s=win,
+        member_chaos=[chaos, jit_c],
+    )
+    solo = Simulator(
+        c_r, SimParams(timeline=True), chaos=jit_c,
+        policies=pol_r, rollouts=rt_r,
+    ).run_rollouts(
+        open_load, n, jax.random.fold_in(key, 1), block_size=block,
+        trim=True, window_s=win,
+    )
+    pin(ens.summaries, solo[0], "chaos x rollout")
+    assert np.array_equal(
+        np.asarray(ens.rollouts.weight)[1],
+        np.asarray(solo[2].weight),
+    ), "chaos x rollout: controller weight series diverged"
+    grid.append("canary-first kill splits")
+
+    print(
+        "composition grid: "
+        + ", ".join(grid)
+        + " — each jittered member BIT-EQUAL to its solo twin"
+    )
+
+    # -- 2. the all-on fleet -------------------------------------------
+    all_on = STORM.replace(
+        "  worker:\n    breaker:",
+        "  worker:\n    lb: {policy: least_request, "
+        "panic_threshold: 50%}\n    breaker:",
+    ) + ROLLOUT_YAML
+    g = ServiceGraph.from_yaml(all_on)
+    c = compile_graph(g)
+    pol = compile_policies(g, c)
+    rt = compile_rollouts(g, c)
+    lbt = compile_lb(g, c)
+    sim = Simulator(c, SimParams(timeline=True), chaos=ungraceful,
+                    policies=pol, rollouts=rt, lb=lbt)
+    members = 8
+    fleet = sim.run_rollouts_ensemble(
+        open_load, n, key, EnsembleSpec.of(members, mode="map"),
+        block_size=block, trim=True, window_s=win,
+        member_chaos=jitter,
+    )
+    starts = [evs[0].start_s for evs in fleet.member_chaos]
+    downs = [evs[0].replicas_down for evs in fleet.member_chaos]
+    assert len(set(round(s, 6) for s in starts)) > 1, \
+        "kill timing did not vary across members"
+    sev = fleet.severity()
+    print(
+        f"all-on fleet ({members} members): policies + LB panic + "
+        f"rollout + ungraceful kills in one program; kill starts "
+        f"{min(starts):.2f}..{max(starts):.2f}s, replicas_down "
+        f"{min(downs)}..{max(downs)}, severity "
+        f"{sev.min():.4f}..{sev.max():.4f}"
+    )
+
+    # -- 3. worst-member postmortem ------------------------------------
+    worst = fleet.worst_member()
+    replay_sim = Simulator(
+        c, SimParams(timeline=True), chaos=fleet.member_chaos[worst],
+        policies=pol, rollouts=rt, lb=lbt,
+    )
+    replay = replay_sim.run_rollouts(
+        open_load, n, jax.random.fold_in(key, worst),
+        block_size=block, trim=True, window_s=win,
+    )
+    assert np.array_equal(
+        np.asarray(fleet.member(worst).latency_hist),
+        np.asarray(replay[0].latency_hist),
+    ), "worst-member replay diverged (summary)"
+    assert np.array_equal(
+        np.asarray(fleet.rollouts.weight)[worst],
+        np.asarray(replay[2].weight),
+    ), "worst-member replay diverged (rollout weight)"
+    print(
+        f"worst member {worst} replayed solo from its jittered "
+        "schedule: BIT-EQUAL (summary + rollout controller) — the "
+        "postmortem artifact survives full composition"
+    )
+    print("chaosgrid-smoke OK")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
