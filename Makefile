@@ -6,7 +6,8 @@ PY ?= python
 QPS ?= 1000
 DURATION ?= 120s
 
-.PHONY: test lint vet-smoke bench telemetry-smoke resilience-smoke \
+.PHONY: test lint vet-smoke grad-smoke bench telemetry-smoke \
+	resilience-smoke \
 	attribution-smoke sparse-smoke timeline-smoke multihost-smoke \
 	policies-smoke rollout-smoke lb-smoke ensemble-smoke \
 	chaosfleet-smoke chaosgrid-smoke search-smoke explain-smoke \
@@ -27,7 +28,10 @@ lint:
 # static-analysis end-to-end check: the shipped examples must vet
 # clean, and a seeded-defect run (injected host callback + f64 leak,
 # plus a tiny fake device capacity to trip the OOM verdict) must
-# report the planted rules and exit nonzero.
+# report the planted rules and exit nonzero.  The graddead injection
+# quantizes cpu_scale through floor, so the gradient audit must flip
+# cpu_time_s to gradient-dead (VET-G001) — strict promotes the warn
+# to blocking, hence the leading `!`.
 vet-smoke: lint
 	$(PY) -m isotope_tpu vet examples/topologies/canonical.yaml \
 		examples/topologies/tree-13-services.yaml
@@ -38,7 +42,39 @@ vet-smoke: lint
 	@grep -q "VET-J001" /tmp/isotope_vet_smoke.txt
 	@grep -q "VET-J002" /tmp/isotope_vet_smoke.txt
 	@grep -q "VET-M001" /tmp/isotope_vet_smoke.txt
+	! ISOTOPE_VET_INJECT=graddead $(PY) -m isotope_tpu vet \
+		--grad --strict --suppress "VET-G002,VET-G004" \
+		examples/topologies/chain-3-services.yaml \
+		> /tmp/isotope_vet_grad_inject.txt 2>&1
+	@grep -q "VET-G001" /tmp/isotope_vet_grad_inject.txt
+	@grep -q "floor" /tmp/isotope_vet_grad_inject.txt
 	@echo "vet-smoke: clean examples pass, seeded defects caught"
+
+# gradient-audit end-to-end check: `vet --grad` classifies every
+# registered design knob on the canonical examples (exit 0 — VET-G
+# findings are warn/info), and the isotope-gradaudit/v1 artifact
+# demonstrates all three classes, with the gradient-dead finding
+# naming its killing primitive and jaxpr path.
+grad-smoke:
+	$(PY) -m isotope_tpu vet --grad \
+		--grad-json /tmp/isotope_gradaudit.json \
+		examples/topologies/canonical.yaml \
+		examples/topologies/canonical-errors.yaml
+	$(PY) -c "import json; \
+		doc = json.load(open('/tmp/isotope_gradaudit.json')); \
+		assert doc['schema'] == 'isotope-gradaudit/v1', doc['schema']; \
+		from isotope_tpu.sim.config import DESIGN_PARAMS; \
+		names = {p.name for p in DESIGN_PARAMS}; \
+		audits = doc['audits']; \
+		assert all(set(a['classes']) == names for a in audits); \
+		classes = {c for a in audits for c in a['classes'].values()}; \
+		assert classes == {'differentiable', 'gradient-dead', \
+		                   'trace-constant'}, classes; \
+		err = [k for a in audits for k in a['knobs'] \
+		       if k['name'] == 'error_rate_scale' and k['kills']]; \
+		assert any('lt' in k['kills'][0] for k in err), err; \
+		print('grad-smoke: all', len(names), 'knobs classified,', \
+		      'killer named:', err[0]['kills'][0])"
 
 # bench prints the one-line JSON capture AND gates it against the
 # previous round's driver capture (>15% per-case regression fails).

@@ -13,7 +13,12 @@ inputs —
   retrace hazards — no device execution);
 - :mod:`~isotope_tpu.analysis.costmodel` — the pre-flight cost model
   (FLOPs, peak bytes, critical path; the memory-vs-capacity verdict
-  that pre-selects the resilience ladder's starting rung).
+  that pre-selects the resilience ladder's starting rung);
+- :mod:`~isotope_tpu.analysis.grad_audit` — the gradient audit
+  (opt-in ``vet --grad``: forward taint from every registered design
+  knob through the engine jaxpr, classifying each as differentiable /
+  gradient-dead / trace-constant — the ``isotope-gradaudit/v1``
+  relaxation worklist of the planned ``optimize`` command).
 
 Surfaced as the ``isotope-tpu vet`` subcommand and the opt-in
 ``--vet`` / ``$ISOTOPE_VET`` gate on simulate/sweep/suite.  With the
@@ -27,6 +32,13 @@ from isotope_tpu.analysis.findings import (  # noqa: F401
     Finding,
     Report,
     suppression_patterns,
+)
+from isotope_tpu.analysis.grad_audit import (  # noqa: F401
+    CLASS_CONSTANT,
+    CLASS_DEAD,
+    CLASS_DIFFERENTIABLE,
+    GRAD_INVARS,
+    audit_grad,
 )
 from isotope_tpu.analysis.vet import (  # noqa: F401
     ENV_VET,
@@ -48,6 +60,11 @@ __all__ = [
     "Finding",
     "Report",
     "suppression_patterns",
+    "CLASS_CONSTANT",
+    "CLASS_DEAD",
+    "CLASS_DIFFERENTIABLE",
+    "GRAD_INVARS",
+    "audit_grad",
     "ENV_VET",
     "ENV_VET_SUPPRESS",
     "MEMORY_RULES",

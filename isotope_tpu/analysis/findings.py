@@ -39,6 +39,33 @@ RULES: Dict[str, str] = {
     "VET-T007": "hop count forces the request block under its floor — "
                 "event tensors exceed the HBM element budget",
     "VET-T008": "bucket-plan padding waste exceeds level_bucket_waste",
+    # -- policy / rollout / lb config linter --------------------------------
+    "VET-T010": "circuit-breaker cap (max_pending / max_connections) "
+                "sits below the steady-state queue depth or "
+                "concurrency at the planned qps — the breaker sheds "
+                "healthy traffic permanently",
+    "VET-T011": "autoscaler min_replicas > max_replicas: the "
+                "desired-count clamp is empty",
+    "VET-T012": "retry_budget of 0 while calls to the service set "
+                "retries > 0: every retry is suppressed",
+    "VET-T013": "autoscaler sync_period shorter than the timeline "
+                "window: the control loop reads stale signals",
+    "VET-T014": "policies block does not decode",
+    "VET-T015": "rollouts block does not decode, or a step schedule "
+                "is not strictly increasing / never reaches 100%",
+    "VET-T016": "canary bake shorter than the recorder window: a step "
+                "can promote before one completed window of it",
+    "VET-T017": "canary gate min_samples is unreachable within one "
+                "bake at the planned qps (the rollout holds forever)",
+    "VET-T018": "canary overrides declared without a step schedule: "
+                "the rollout never actuates",
+    "VET-T019": "lb choices_d exceeds the replica count: power-of-d "
+                "degenerates to full-pool least-request",
+    "VET-T020": "ring_hash with a single replica: hash stickiness is "
+                "a no-op",
+    "VET-T021": "lb panic_threshold >= 1.0 or unreachable via outlier "
+                "ejection",
+    "VET-T022": "lb entries do not decode",
     # -- experiment-config linter -----------------------------------------
     "VET-C001": "topology file is missing or unreadable",
     "VET-C002": "duplicate run labels in the sweep grid",
@@ -82,6 +109,28 @@ RULES: Dict[str, str] = {
                 "policy/rollout/timeline carry) exceed device "
                 "capacity; the fleet runs in carry-aware member "
                 "chunks",
+    # -- on-device config search (sim/search.py) ---------------------------
+    "VET-T026": "search spec is undecodable, or the bracket is "
+                "degenerate (population cannot support the rungs, "
+                "non-power-of-eta padding, rank needs uncarried "
+                "timelines)",
+    "VET-M005": "search bracket's widest rung x peak-bytes exceed "
+                "device capacity; the rung runs in member chunks",
+    "VET-M006": "observed fleet members x (peak-bytes + stacked "
+                "blame/timeline carry) exceed device capacity; the "
+                "fleet runs in member chunks",
+    # -- gradient audit (analysis/grad_audit.py) ---------------------------
+    "VET-G001": "design knob is gradient-dead: every tainted path to "
+                "the objective crosses a non-differentiable primitive "
+                "(the finding names the killer and its jaxpr path)",
+    "VET-G002": "design knob is a trace constant: it never enters the "
+                "jaxpr, so every new value recompiles and no soft "
+                "relaxation recovers a gradient",
+    "VET-G003": "design knob's gradient crosses a float scatter-add "
+                "(accumulation order is backend-dependent)",
+    "VET-G004": "objective output carries zero live design-taint: "
+                "planning over it is vacuous until a soft relaxation "
+                "replaces its integer/comparison paths",
 }
 
 

@@ -25,10 +25,12 @@ The auditor never executes the program: the trace-only property is
 pinned by ``tests/test_vet.py`` (no jit first-calls, no backend
 compile seconds, ``Simulator.run`` monkeypatched to raise).
 
-``$ISOTOPE_VET_INJECT`` (comma list of ``callback`` / ``f64``) seeds
-those defects into the traced program — the engine-chaos discipline of
-``ISOTOPE_FAULT_INJECT`` aimed at the auditor, so the detection path is
-exercisable end-to-end from the CLI and smoke targets.
+``$ISOTOPE_VET_INJECT`` (comma list of ``callback`` / ``f64`` /
+``graddead``) seeds those defects into the traced program — the
+engine-chaos discipline of ``ISOTOPE_FAULT_INJECT`` aimed at the
+auditors, so the detection path is exercisable end-to-end from the CLI
+and smoke targets (``graddead`` is consumed by the gradient audit,
+analysis/grad_audit.py, and ignored here).
 """
 from __future__ import annotations
 
@@ -71,10 +73,10 @@ def inject_spec() -> Tuple[str, ...]:
     spec = os.environ.get(ENV_VET_INJECT, "")
     kinds = tuple(k.strip() for k in spec.split(",") if k.strip())
     for k in kinds:
-        if k not in ("callback", "f64"):
+        if k not in ("callback", "f64", "graddead"):
             raise ValueError(
                 f"unknown {ENV_VET_INJECT} kind {k!r} "
-                "(one of: callback, f64)"
+                "(one of: callback, f64, graddead)"
             )
     return kinds
 
@@ -140,9 +142,16 @@ def trace_entry(sim, load, num_requests: int = 8):
     return jax.make_jaxpr(fn)(*args), n
 
 
-def _walk_eqns(jaxpr) -> Iterator[tuple]:
-    """Yield ``(eqn, depth)`` over a jaxpr and every sub-jaxpr
-    (scan/cond/while bodies, pjit calls, custom derivatives)."""
+def iter_eqns(closed_or_jaxpr) -> Iterator[tuple]:
+    """Yield ``(eqn, depth)`` over a jaxpr and every sub-jaxpr.
+
+    The one shared walker of the static passes (this auditor and the
+    gradient audit, analysis/grad_audit.py).  Descends every
+    jaxpr-valued eqn param — scan/cond/while bodies, ``pjit`` calls,
+    ``custom_jvp``/``custom_vjp`` call jaxprs, and lists of branch
+    jaxprs — so a defect wrapped under any of them is still found
+    (pinned by tests/test_vet.py).  Accepts a ClosedJaxpr or a bare
+    Jaxpr."""
     import jax
 
     def rec(jxp, depth):
@@ -160,7 +169,7 @@ def _walk_eqns(jaxpr) -> Iterator[tuple]:
                         elif isinstance(x, jax.core.Jaxpr):
                             yield from rec(x, depth + 1)
 
-    yield from rec(jaxpr, 0)
+    yield from rec(getattr(closed_or_jaxpr, "jaxpr", closed_or_jaxpr), 0)
 
 
 def _fold_sites(rule: str, severity: str, sites: List[str],
@@ -185,11 +194,10 @@ def _fold_sites(rule: str, severity: str, sites: List[str],
 
 def audit_jaxpr(closed_jaxpr) -> List[Finding]:
     """Walk a ClosedJaxpr (incl. sub-jaxprs) for the VET-J rules."""
-    jaxpr = getattr(closed_jaxpr, "jaxpr", closed_jaxpr)
     sync_sites: List[str] = []
     wide_sites: List[str] = []
     scatter_sites: List[str] = []
-    for eqn, depth in _walk_eqns(jaxpr):
+    for eqn, depth in iter_eqns(closed_jaxpr):
         prim = str(eqn.primitive)
         site = f"{prim}@depth{depth}"
         if prim in HOST_SYNC_PRIMITIVES or "callback" in prim:

@@ -6,7 +6,10 @@ gate (runner/run.py) both funnel through here:
 1. **topology & config lint** (topo_lint) — pure host;
 2. **jaxpr audit** (jaxpr_audit) — trace-only, no device execution;
 3. **pre-flight cost model** (costmodel) — memory verdict + ladder
-   rung recommendation.
+   rung recommendation;
+4. **gradient audit** (grad_audit, opt-in via ``--grad``) — the
+   design-knob taint classification feeding the ``optimize``
+   relaxation worklist.
 
 Every finding increments the telemetry registry
 (``isotope_engine_vet_errors_total`` / ``_warnings_total`` render as
@@ -100,6 +103,7 @@ def vet_simulator(
     protected: bool = False,
     split_spec=None,
     search_spec=None,
+    grad: bool = False,
 ) -> Report:
     """Full vet of one built Simulator under one load.
 
@@ -121,6 +125,11 @@ def vet_simulator(
     (VET-T024).  ``search_spec`` (a SearchSpec or its raw ``[search]``
     dict) lints the successive-halving bracket (VET-T026) and runs
     the widest-rung capacity verdict (VET-M005, carry-aware).
+    ``grad=True`` runs the gradient audit (VET-G rules,
+    analysis/grad_audit.py) as a fourth pass — off by default: it
+    traces the full knob-armed engine body a second time.  Its
+    ``isotope-gradaudit/v1`` document lands in
+    ``report.meta['grad']``.
     """
     report = Report(suppress=suppress)
     with telemetry.phase("vet.total"):
@@ -221,6 +230,13 @@ def vet_simulator(
                     carry_bytes_per_member=carry + obs_carry,
                 ),
             }
+        if grad:
+            from isotope_tpu.analysis import grad_audit
+
+            with telemetry.phase("vet.grad"):
+                gfinds, gdoc = grad_audit.audit_grad(sim, load)
+            report.extend(gfinds)
+            report.meta["grad"] = gdoc
         if split_spec is not None:
             report.extend(topo_lint.lint_split(split_spec))
         if search_spec is not None:
@@ -296,6 +312,7 @@ def vet_topology_path(
     suppress=(),
     params=None,
     graph=None,
+    grad: bool = False,
 ) -> Report:
     """Vet one topology YAML end to end (decode -> lint -> build ->
     audit -> cost model).  Decode/compile failures become findings
@@ -340,7 +357,7 @@ def vet_topology_path(
     )
     sub = vet_simulator(
         sim, load, graph=None, entry=entry, trace=trace,
-        device_bytes=device_bytes, suppress=suppress,
+        device_bytes=device_bytes, suppress=suppress, grad=grad,
     )
     # merge: sub already counted itself; move its findings over
     report.findings.extend(sub.findings)
@@ -355,6 +372,7 @@ def vet_config_path(
     trace: bool = True,
     device_bytes: Optional[float] = None,
     suppress=(),
+    grad: bool = False,
 ) -> Report:
     """Vet a sweep TOML: config lint plus every referenced topology."""
     from isotope_tpu.runner.config import load_toml
@@ -375,7 +393,7 @@ def vet_config_path(
         sub = vet_topology_path(
             p, entry=config.entry, trace=trace,
             device_bytes=device_bytes, suppress=suppress,
-            params=config.sim_params(), graph=g,
+            params=config.sim_params(), graph=g, grad=grad,
         )
         report.findings.extend(sub.findings)
         report.suppressed.extend(sub.suppressed)

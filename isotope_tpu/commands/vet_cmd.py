@@ -34,6 +34,16 @@ def register(sub) -> None:
     s.add_argument("--no-trace", action="store_true",
                    help="skip the jaxpr audit / traced cost model "
                         "(lint + plan-table estimates only)")
+    s.add_argument("--grad", action="store_true",
+                   help="run the gradient audit (VET-G rules): "
+                        "classify every registered design knob as "
+                        "differentiable / gradient-dead / "
+                        "trace-constant (off by default: traces the "
+                        "knob-armed engine body)")
+    s.add_argument("--grad-json", default=None, metavar="PATH",
+                   help="write the isotope-gradaudit/v1 artifact "
+                        "(the optimize relaxation worklist) to PATH; "
+                        "implies --grad")
     s.add_argument("--entry", default=None,
                    help="entrypoint override for multi-entry "
                         "topologies")
@@ -50,6 +60,17 @@ def register(sub) -> None:
                         "(default: $ISOTOPE_VET_DEVICE_BYTES, then the "
                         "backend's memory_stats; unknown on CPU)")
     s.set_defaults(func=run_vet)
+
+
+def _collect_grad_docs(path, meta, out) -> None:
+    """Pull per-topology gradient-audit documents out of a report's
+    meta (a topology vet puts the doc at ``meta['grad']``; a sweep
+    TOML nests one per referenced topology path)."""
+    if "grad" in meta:
+        out.append(dict(meta["grad"], topology=str(path)))
+    for k, v in meta.items():
+        if k != "grad" and isinstance(v, dict) and "grad" in v:
+            out.append(dict(v["grad"], topology=str(k)))
 
 
 def run_vet(args) -> int:
@@ -72,23 +93,40 @@ def run_vet(args) -> int:
         duration_s=dur.parse_duration_seconds(args.duration),
     )
 
+    grad = bool(args.grad or args.grad_json)
     merged = Report(suppress=())
+    grad_docs = []
     for path in args.paths:
         if str(path).endswith(".toml"):
             rep = vet_config_path(
                 path, trace=not args.no_trace,
                 device_bytes=args.device_bytes, suppress=suppress,
+                grad=grad,
             )
         else:
             rep = vet_topology_path(
                 path, load=load, entry=args.entry,
                 trace=not args.no_trace,
                 device_bytes=args.device_bytes, suppress=suppress,
+                grad=grad,
             )
         merged.findings.extend(rep.findings)
         merged.suppressed.extend(rep.suppressed)
         if rep.meta:
             merged.meta[str(path)] = rep.meta
+        _collect_grad_docs(path, rep.meta, grad_docs)
+
+    if args.grad_json:
+        import json
+
+        from isotope_tpu.analysis.grad_audit import SCHEMA
+
+        with open(args.grad_json, "w") as f:
+            json.dump(
+                {"schema": SCHEMA, "audits": grad_docs},
+                f, indent=2, sort_keys=True,
+            )
+            f.write("\n")
 
     if args.json:
         print(merged.to_json())

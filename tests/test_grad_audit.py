@@ -1,0 +1,305 @@
+"""Gradient audit (`vet --grad`): taint classification fixtures.
+
+Unit-level: the taint propagation must kill liveness at the known
+killers (floor family, comparisons/integer casts via dtype,
+predicate-only select routes) and survive the smooth paths, including
+through scan/while carries and pjit/custom-vjp sub-jaxprs.  End to
+end: the canonical example's knob classification is pinned
+(tests/data/grad_audit_canonical.json), the pass is trace-only, and
+the seeded `graddead` injection must surface VET-G001.
+"""
+import json
+import pathlib
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from isotope_tpu import cli, telemetry
+from isotope_tpu.analysis import grad_audit, jaxpr_audit
+from isotope_tpu.analysis.vet import vet_topology_path
+from isotope_tpu.compiler import compile_graph
+from isotope_tpu.models.graph import ServiceGraph
+from isotope_tpu.sim.config import DESIGN_PARAMS, LoadModel
+from isotope_tpu.sim.engine import Simulator
+
+ROOT = pathlib.Path(__file__).parent.parent
+OPEN = LoadModel(kind="open", qps=100.0)
+
+CHAIN = {
+    "services": [
+        {"name": "a", "isEntrypoint": True, "script": [{"call": "b"}]},
+        {"name": "b"},
+    ]
+}
+
+
+def _chain_sim():
+    return Simulator(compile_graph(ServiceGraph.decode(CHAIN)))
+
+
+def _write_topo(tmp_path, doc, name="topo.yaml"):
+    import yaml
+
+    p = tmp_path / name
+    p.write_text(yaml.safe_dump(doc))
+    return str(p)
+
+
+def _taint(fn, seed_idx, *avals):
+    """Seed one knob at invar ``seed_idx`` of ``fn``'s jaxpr and run
+    the forward taint; returns (out_taints, state)."""
+    closed = jax.make_jaxpr(fn)(*avals)
+    state = grad_audit._TaintState()
+    in_t = [{} for _ in closed.jaxpr.invars]
+    in_t[seed_idx]["k"] = (True, None)
+    outs = grad_audit._analyze(closed.jaxpr, in_t, "", state)
+    return outs, state
+
+
+F32 = jax.ShapeDtypeStruct((), jnp.float32)
+V32 = jax.ShapeDtypeStruct((8,), jnp.float32)
+
+
+# -- taint propagation units ------------------------------------------------
+
+
+def test_smooth_path_stays_live():
+    outs, _ = _taint(lambda x: jnp.exp(x) * 2.0 + 1.0, 0, F32)
+    assert outs[0]["k"] == (True, None)
+
+
+def test_floor_kills_with_named_site():
+    outs, state = _taint(lambda x: jnp.floor(x) * 2.0, 0, F32)
+    live, killer = outs[0]["k"]
+    assert not live and killer == "floor"
+    assert list(state.kills["k"]) == ["floor"]
+
+
+def test_comparison_dtype_kill_names_the_comparison():
+    outs, _ = _taint(
+        lambda x: (x < 0.5).astype(jnp.float32), 0, F32,
+    )
+    live, killer = outs[0]["k"]
+    assert not live and killer == "lt"
+
+
+def test_predicate_only_select_names_the_feeder():
+    # knob reaches the select ONLY through the predicate: routing,
+    # dead, named select_n<-lt
+    outs, state = _taint(
+        lambda x, y: jnp.where(x < 0.5, y, 2.0), 0, F32, F32,
+    )
+    # jnp.where traces under a `_where` pjit, hence the path prefix
+    live, killer = outs[0]["k"]
+    assert not live and killer == "_where/select_n←lt"
+    assert "lt" in state.kills["k"]  # first kill = the comparison
+
+    # the same select seeded at a BRANCH stays live (smooth path)
+    outs, _ = _taint(
+        lambda x, y: jnp.where(x < 0.5, y, 2.0), 1, F32, F32,
+    )
+    assert outs[0]["k"] == (True, None)
+
+
+def test_integer_cast_kills():
+    outs, _ = _taint(
+        lambda x: x.astype(jnp.int32).astype(jnp.float32), 0, F32,
+    )
+    live, killer = outs[0]["k"]
+    assert not live and killer == "convert_element_type"
+
+
+def test_scan_carry_fixpoint_propagates_liveness():
+    def f(x):
+        def body(c, _):
+            return c * 0.5 + x, c
+        return jax.lax.scan(body, x, jnp.arange(4.0))
+
+    outs, _ = _taint(f, 0, F32)
+    assert outs[0]["k"][0]          # final carry live
+    assert outs[1]["k"][0]          # stacked ys live
+
+
+def test_scan_body_killer_carries_the_path():
+    def f(x):
+        def body(c, _):
+            return jnp.floor(c), None
+        return jax.lax.scan(body, x, jnp.arange(4.0))[0]
+
+    outs, state = _taint(f, 0, F32)
+    live, killer = outs[0]["k"]
+    assert not live and killer == "scan/body/floor"
+    assert "scan/body/floor" in state.kills["k"]
+
+
+def test_while_loop_carry_stays_live():
+    def f(x):
+        def cond(c):
+            return c[1] < 3
+        def body(c):
+            return (c[0] * 2.0, c[1] + 1)
+        return jax.lax.while_loop(cond, body, (x, 0))[0]
+
+    outs, _ = _taint(f, 0, F32)
+    assert outs[0]["k"][0]
+
+
+def test_pjit_body_is_descended():
+    inner = jax.jit(lambda x: jnp.floor(x) * 3.0)
+    outs, _ = _taint(lambda x: inner(x) + 1.0, 0, F32)
+    live, killer = outs[0]["k"]
+    assert not live and killer.endswith("/floor")
+
+
+def test_custom_vjp_body_is_descended():
+    @jax.custom_vjp
+    def f(x):
+        return x * 2.0
+
+    f.defvjp(lambda x: (f(x), x), lambda r, g: (g * 2.0,))
+    outs, _ = _taint(lambda x: f(x) + 1.0, 0, F32)
+    assert outs[0]["k"][0]          # smooth custom-vjp body: live
+
+
+def test_float_scatter_add_records_g003_site():
+    def f(x):
+        return jnp.zeros((4,), jnp.float32).at[0].add(x)
+
+    outs, state = _taint(f, 0, F32)
+    assert outs[0]["k"][0]
+    assert any("scatter" in s for s in state.scatter["k"])
+
+
+def test_iter_eqns_descends_pjit_and_custom_vjp():
+    """Satellite pin: the shared walker (jaxpr_audit.iter_eqns)
+    surfaces defects wrapped under pjit and custom_vjp bodies."""
+    @jax.custom_vjp
+    def noisy(x):
+        jax.debug.callback(lambda v: None, x)
+        return x * 2.0
+
+    noisy.defvjp(lambda x: (noisy(x), x), lambda r, g: (g * 2.0,))
+
+    closed = jax.make_jaxpr(
+        lambda x: jax.jit(noisy)(x) + 1.0
+    )(V32)
+    rules = {f.rule for f in jaxpr_audit.audit_jaxpr(closed)}
+    assert "VET-J001" in rules
+    prims = {str(e.primitive) for e, _ in jaxpr_audit.iter_eqns(closed)}
+    assert "mul" in prims           # reached the innermost body
+
+
+# -- registry & engine classification ---------------------------------------
+
+
+def test_design_params_registry_is_well_formed():
+    names = [p.name for p in DESIGN_PARAMS]
+    assert len(names) == len(set(names))
+    for p in DESIGN_PARAMS:
+        for invar in p.invars:
+            assert invar in grad_audit.GRAD_INVARS, (p.name, invar)
+        if not p.traced:
+            assert p.constant_site, p.name
+
+
+def test_chain_audit_classifies_every_knob(monkeypatch):
+    monkeypatch.delenv("ISOTOPE_VET_INJECT", raising=False)
+    finds, doc = grad_audit.audit_grad(_chain_sim(), OPEN)
+    assert doc["schema"] == grad_audit.SCHEMA
+    assert set(doc["classes"]) == {p.name for p in DESIGN_PARAMS}
+    assert doc["classes"]["qps_scale"] == grad_audit.CLASS_DIFFERENTIABLE
+    assert doc["classes"]["cpu_time_s"] == grad_audit.CLASS_DIFFERENTIABLE
+    assert doc["classes"]["timeout_ladder"] == grad_audit.CLASS_CONSTANT
+    # zero error rates elide the 5xx coin: the knob is inert -> dead
+    assert doc["classes"]["error_rate_scale"] == grad_audit.CLASS_DEAD
+    assert doc["eqns_walked"] > 0
+    rules = {f.rule for f in finds}
+    assert "VET-G001" in rules and "VET-G002" in rules
+    # quantile/error-count objectives carry no live taint (VET-G004)
+    assert "latency_hist" in doc["vacuous_objectives"]
+    (g4,) = [f for f in finds if f.rule == "VET-G004"]
+    assert "latency_hist" in g4.message
+
+
+def test_canonical_classification_is_pinned(monkeypatch):
+    """Tier-1 pin: a refactor that silently kills a
+    previously-differentiable knob (or promotes a trace constant)
+    must fail loudly against tests/data/grad_audit_canonical.json."""
+    monkeypatch.delenv("ISOTOPE_VET_INJECT", raising=False)
+    expected = json.loads(
+        (ROOT / "tests/data/grad_audit_canonical.json").read_text()
+    )
+    g = ServiceGraph.from_yaml_file(
+        str(ROOT / expected["topology"])
+    )
+    _, doc = grad_audit.audit_grad(Simulator(compile_graph(g)), OPEN)
+    assert doc["classes"] == expected["classes"]
+    assert doc["vacuous_objectives"] == expected["vacuous_objectives"]
+
+
+def test_errors_example_names_killing_primitive(monkeypatch):
+    """The shipped canonical-errors example demonstrates the
+    gradient-dead class with a NAMED killer: the 5xx coin's
+    comparison, on the scan body path."""
+    monkeypatch.delenv("ISOTOPE_VET_INJECT", raising=False)
+    g = ServiceGraph.from_yaml_file(
+        str(ROOT / "examples/topologies/canonical-errors.yaml")
+    )
+    finds, doc = grad_audit.audit_grad(Simulator(compile_graph(g)), OPEN)
+    (k,) = [k for k in doc["knobs"] if k["name"] == "error_rate_scale"]
+    assert k["class"] == grad_audit.CLASS_DEAD
+    assert k["kills"] and k["kills"][0] == "scan/body/lt"
+    (f,) = [f for f in finds if f.rule == "VET-G001"]
+    assert "scan/body/lt" in f.message and f.path == "scan/body/lt"
+
+
+def test_grad_audit_is_trace_only(monkeypatch, tmp_path):
+    """Pinned: `vet --grad` performs NO device execution — no jit
+    first-call, no backend compile, engine entry points never run."""
+    monkeypatch.delenv("ISOTOPE_VET_INJECT", raising=False)
+
+    def boom(*a, **k):  # pragma: no cover - must never run
+        raise AssertionError("grad audit executed the engine")
+
+    monkeypatch.setattr(Simulator, "run", boom)
+    monkeypatch.setattr(Simulator, "run_summary", boom)
+    telemetry.reset()
+    path = _write_topo(tmp_path, CHAIN)
+    report = vet_topology_path(path, load=OPEN, grad=True)
+    assert "grad" in report.meta
+    assert telemetry.counter_get("jit_first_calls") == 0.0
+    assert telemetry.phase_seconds("compile.backend") == 0.0
+    # per-rule telemetry counters folded in (vet._count)
+    assert telemetry.counter_get("vet_rule.VET-G002") > 0
+
+
+def test_graddead_injection_surfaces_g001(monkeypatch):
+    monkeypatch.setenv("ISOTOPE_VET_INJECT", "graddead")
+    finds, doc = grad_audit.audit_grad(_chain_sim(), OPEN)
+    assert doc["classes"]["cpu_time_s"] == grad_audit.CLASS_DEAD
+    (f,) = [
+        f for f in finds
+        if f.rule == "VET-G001" and "cpu_time_s" in f.message
+    ]
+    assert "floor" in f.message and f.path == "floor"
+
+
+def test_unknown_inject_kind_still_raises(monkeypatch):
+    monkeypatch.setenv("ISOTOPE_VET_INJECT", "gradded")
+    with pytest.raises(ValueError, match="unknown"):
+        jaxpr_audit.inject_spec()
+
+
+def test_cli_grad_json_artifact(tmp_path, monkeypatch):
+    monkeypatch.delenv("ISOTOPE_VET_INJECT", raising=False)
+    topo = _write_topo(tmp_path, CHAIN)
+    out = tmp_path / "grad.json"
+    # --grad-json implies --grad; VET-G findings are warn/info: exit 0
+    assert cli.main(["vet", "--grad-json", str(out), topo]) == 0
+    doc = json.loads(out.read_text())
+    assert doc["schema"] == "isotope-gradaudit/v1"
+    (audit,) = doc["audits"]
+    assert audit["topology"] == topo
+    assert set(audit["classes"]) == {p.name for p in DESIGN_PARAMS}
+    assert audit["objectives"]["latency_sum"]  # live knobs recorded

@@ -107,6 +107,7 @@ def test_no_entrypoint():
     "examples/topologies/realistic-star-50.yaml",
     "examples/topologies/realistic-auxiliary-services-50.yaml",
     "examples/topologies/two-cluster-canonical.yaml",
+    "examples/topologies/canonical-errors.yaml",
 ])
 def test_shipped_examples_vet_clean(example, monkeypatch):
     monkeypatch.delenv("ISOTOPE_VET_INJECT", raising=False)
@@ -382,6 +383,27 @@ def test_cli_suppression_silences_exit(tmp_path):
     })
     assert cli.main(["vet", path]) == 1
     assert cli.main(["vet", path, "--suppress", "VET-T001"]) == 0
+
+
+def test_grad_rules_registered_and_unknown_raises():
+    for rule in ("VET-G001", "VET-G002", "VET-G003", "VET-G004"):
+        assert rule in RULES
+    suppression_patterns("VET-G*")  # valid family glob
+    with pytest.raises(ValueError, match="unknown vet rule"):
+        suppression_patterns("VET-G999")
+
+
+def test_cli_grad_suppression_silences_exit(tmp_path, monkeypatch):
+    """`--suppress 'VET-G*'` silences the grad gate: under --strict
+    the VET-G warnings (gradient-dead knob, vacuous objectives)
+    promote to a nonzero exit, and the family glob restores 0."""
+    monkeypatch.delenv("ISOTOPE_VET_INJECT", raising=False)
+    path = _write_topo(tmp_path, CHAIN)
+    assert cli.main(["vet", path, "--strict"]) == 0
+    assert cli.main(["vet", "--grad", "--strict", path]) == 1
+    assert cli.main(
+        ["vet", "--grad", "--strict", "--suppress", "VET-G*", path]
+    ) == 0
 
 
 def test_strict_promotes_warnings(tmp_path):

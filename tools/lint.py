@@ -12,7 +12,15 @@ ruff/mypy, and a lint target that silently no-ops teaches nothing:
    ``compile()`` plus an AST pass for unused imports (ruff's F401) —
    the highest-signal subset of the configured ruleset, implemented
    against the same conventions (``# noqa`` respected, ``__init__.py``
-   re-exports exempt, ``__all__`` counts as a use).
+   re-exports exempt, ``__all__`` counts as a use);
+4. **vet rule-table drift check** (always available): every ``VET-*``
+   id README.md cites must exist in ``analysis/findings.RULES`` and
+   every registered rule must appear in README.md (range citations
+   like ``VET-T001..T008`` expand) — the README tables are
+   hand-maintained and this class of drift has already happened once
+   (T010-T022/T026/M005-M006 shipped unregistered, breaking their
+   suppression).  RULES is read by AST, not import, so the check
+   never pays (or depends on) a jax import.
 
 Exit status is nonzero on any finding, so the target composes into CI
 recipes exactly like ``make resilience-smoke``.
@@ -21,6 +29,7 @@ from __future__ import annotations
 
 import ast
 import pathlib
+import re
 import shutil
 import subprocess
 import sys
@@ -143,6 +152,70 @@ def fallback_lint() -> int:
     return findings
 
 
+#: a lone rule id, or a range over a shared letter (VET-T001..T008,
+#: also tolerating a repeated letter on the right: VET-C001..C005)
+_RULE_RE = re.compile(
+    r"VET-([A-Z])(\d{3})(?:\.\.(?:[A-Z])?(\d{3}))?"
+)
+
+
+def registered_rules() -> set:
+    """The rule ids in ``analysis/findings.RULES`` — by AST, so the
+    drift check works without importing the package (or jax)."""
+    src = (REPO / "isotope_tpu" / "analysis" / "findings.py").read_text()
+    tree = ast.parse(src)
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign):
+            targets = node.targets
+        elif isinstance(node, ast.AnnAssign):  # RULES: Dict[...] = {..}
+            targets = [node.target]
+        else:
+            continue
+        for t in targets:
+            if isinstance(t, ast.Name) and t.id == "RULES" and isinstance(
+                node.value, ast.Dict
+            ):
+                return {
+                    k.value for k in node.value.keys
+                    if isinstance(k, ast.Constant)
+                    and isinstance(k.value, str)
+                }
+    return set()
+
+
+def readme_rules() -> set:
+    """Every rule id README.md cites, with ranges expanded."""
+    text = (REPO / "README.md").read_text()
+    out = set()
+    for m in _RULE_RE.finditer(text):
+        letter, lo, hi = m.group(1), int(m.group(2)), m.group(3)
+        hi = int(hi) if hi else lo
+        for n in range(lo, hi + 1):
+            out.add(f"VET-{letter}{n:03d}")
+    return out
+
+
+def rule_table_check() -> int:
+    """README <-> findings.RULES drift; returns #findings."""
+    registered = registered_rules()
+    documented = readme_rules()
+    findings = 0
+    if not registered:
+        print("tools/lint.py: could not parse RULES from "
+              "isotope_tpu/analysis/findings.py")
+        return 1
+    for rule in sorted(documented - registered):
+        print(f"README.md cites {rule} but analysis/findings.RULES "
+              "does not register it (suppression of it would raise)")
+        findings += 1
+    for rule in sorted(registered - documented):
+        print(f"analysis/findings.RULES registers {rule} but "
+              "README.md never documents it (add it to a rule table, "
+              "ranges like VET-T001..T008 count)")
+        findings += 1
+    return findings
+
+
 def _run(cmd) -> int:
     print("+", " ".join(cmd))
     return subprocess.call(cmd, cwd=str(REPO))
@@ -158,7 +231,7 @@ def main() -> int:
     if shutil.which("mypy"):
         ran_external = True
         rc |= _run(["mypy", "isotope_tpu"])
-    n = fallback_lint()
+    n = fallback_lint() + rule_table_check()
     if n:
         print(f"lint: {n} finding(s)")
         rc |= 1
