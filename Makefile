@@ -11,6 +11,7 @@ DURATION ?= 120s
 	attribution-smoke sparse-smoke timeline-smoke multihost-smoke \
 	policies-smoke rollout-smoke lb-smoke ensemble-smoke \
 	chaosfleet-smoke chaosgrid-smoke search-smoke explain-smoke \
+	ingest-smoke \
 	examples \
 	canonical tree star multitier auxiliary-services star-auxiliary \
 	latency cpu_mem dot clean
@@ -273,6 +274,18 @@ search-smoke:
 # blame must replay solo
 explain-smoke:
 	$(PY) tools/explain_smoke.py
+
+# trace-driven ingest self-closure check (PR 20): simulate the
+# power-law fixture with the timeline recorder armed, export the two
+# Prometheus expositions a real scrape would see, ingest them back
+# through readers -> fitters, and pin the reconstruction — per-service
+# error share, mean self-time (90% band share), exact fan-out degree
+# sequence, windowed qps schedule — within report.CLOSURE_TOLERANCES;
+# coverage counters must partition every input line, the emitted TOML
+# must decode through load_toml, vet must be clean, and the fitted
+# topology must re-simulate to the source's client error share
+ingest-smoke:
+	$(PY) tools/ingest_smoke.py
 
 examples:
 	$(PY) tools/gen_examples.py

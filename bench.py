@@ -14,6 +14,13 @@ holds one request block, counters/histograms accumulate on device:
   sequential calls — the unfavorable shape (long scripts, sparse hop
   execution).
 - ``svc10k`` / ``star10k``: the 10k-service realistic shapes.
+- ``svc10k_ingested``: trace-driven replay at scale (ingest/) — the
+  svc10k shape simulated once with the recorder armed, its Prometheus
+  expositions fitted back into a topology, and the FITTED graph's
+  replay measured.  The rate shares the svc10k family (a fit that
+  distorts the topology shows up as a rate break); the host-side fit
+  lands as ``<case>_ingest_*`` evidence keys, which
+  tools/bench_regress.py excludes from the rate gate.
 - ``svc100k_chaos``: BASELINE configs[4] — 100k services + a mid-run
   total outage + Pareto(2.5) heavy tails.
 - ``svc10k_cfg3_10M``: BASELINE configs[3] AND the north-star census —
@@ -76,6 +83,7 @@ CASE_ORDER = [
     "rollout50",
     "svc10k",
     "svc10k_protected",
+    "svc10k_ingested",
     "star10k",
     "svc100k_chaos",
     "svc10k_cfg3_10M",
@@ -86,7 +94,10 @@ CASE_ORDER = [
 # tunneled chip and stretches well past that when the tunnel is busy,
 # so it gets a larger budget.
 CASE_TIMEOUT_S = 1200
-CASE_TIMEOUT_OVERRIDES = {"svc10k_cfg3_10M": 3000}
+# svc10k_ingested compiles TWO 10k-service programs (the recorder-armed
+# source and the fitted replay) on top of the host-side fit
+CASE_TIMEOUT_OVERRIDES = {"svc10k_cfg3_10M": 3000,
+                          "svc10k_ingested": 2400}
 
 
 def _rate(sim, load, num_requests, block_size, *, warm=3, iters=3,
@@ -995,6 +1006,68 @@ def run_case(name: str) -> dict:
             warm=2, iters=2, runner=prot_runner,
         )
         out[f"{name}_lb"] = 1
+    elif name == "svc10k_ingested":
+        # trace-driven replay at scale (PR 20, ingest/): simulate the
+        # svc10k multitier shape ONCE with the flight recorder armed,
+        # export the two Prometheus expositions a real scrape would
+        # see, fit them back into a topology (pure host code), and
+        # measure the FITTED graph's replay throughput.  The case rate
+        # is the replay's hop-events/s — same family as svc10k, so a
+        # fit that loses edges or inflates sleeps breaks the rate; the
+        # `<case>_ingest_*` keys carry the host-side fit evidence
+        # (bench_regress excludes them from the rate comparison).
+        import tempfile as _tempfile
+
+        from isotope_tpu.ingest import fitters, readers
+        from isotope_tpu.metrics import timeline as timeline_mod
+        from isotope_tpu.metrics.prometheus import MetricsCollector
+
+        src_sim = Simulator(
+            compile_graph(
+                ServiceGraph.decode(
+                    realistic_topology(10_000, archetype="multitier",
+                                       seed=0)
+                )
+            ),
+            SimParams(timeline=True, timeline_window_s=1.0),
+        )
+        coll = MetricsCollector(src_sim.compiled)
+        load_i = LoadModel(kind="open", qps=1000.0)
+        n_i = min(blk, 8_192)
+        summary, tl = src_sim.run_timeline(
+            load_i, n_i, jax.random.PRNGKey(0), collector=coll,
+            window_s=1.0,
+        )
+        jax.block_until_ready(summary.count)
+        t0 = time.perf_counter()
+        with _tempfile.TemporaryDirectory() as td:
+            p_full = os.path.join(td, "full.prom")
+            p_tl = os.path.join(td, "timeline.prom")
+            with open(p_full, "w") as f:
+                f.write(coll.full_text(summary))
+            with open(p_tl, "w") as f:
+                f.write(timeline_mod.prometheus_text(
+                    src_sim.compiled, tl
+                ))
+            obs = readers.read_path(p_full)
+            obs = readers.read_path(p_tl, obs=obs)
+        fr = fitters.fit(obs, fitters.FitOptions(label="svc10k"))
+        out[f"{name}_ingest_fit_s"] = round(
+            time.perf_counter() - t0, 3
+        )
+        out[f"{name}_ingest_services"] = len(fr.services)
+        out[f"{name}_ingest_edges"] = len(fr.edges)
+        out[f"{name}_ingest_lines"] = sum(
+            c.lines_parsed for c in obs.inputs
+        )
+        out[f"{name}_ingest_qps"] = round(float(fr.qps_mean or 0), 3)
+
+        sim = Simulator(compile_graph(fr.graph))
+        b = sim.default_block_size()
+        med, spread, best, first_s = measure(
+            sim, LoadModel(kind="open", qps=float(fr.qps_mean or 1000)),
+            b * 2, b, warm=2, iters=2,
+        )
     elif name == "star10k":
         # the star archetype's skewed hub level runs via the sparse
         # call-slot encoding — dense grids made it block-starved

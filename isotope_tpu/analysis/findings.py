@@ -119,6 +119,13 @@ RULES: Dict[str, str] = {
     "VET-M006": "observed fleet members x (peak-bytes + stacked "
                 "blame/timeline carry) exceed device capacity; the "
                 "fleet runs in member chunks",
+    # -- trace-driven ingest (ingest/, analysis/topo_lint.lint_ingest) ----
+    "VET-T027": "fitted qps schedule exceeds the fitted capacity at "
+                "the observed window peak (the reconstructed replay "
+                "will saturate where the source mesh did not)",
+    "VET-T028": "degenerate fit: a service with zero observed samples "
+                "was emitted into the topology (its timing/error "
+                "knobs are defaults, not measurements)",
     # -- gradient audit (analysis/grad_audit.py) ---------------------------
     "VET-G001": "design knob is gradient-dead: every tainted path to "
                 "the objective crosses a non-differentiable primitive "

@@ -986,3 +986,101 @@ def _lint_breaker_capacity(
                     path=f"policies.{name}.breaker.max_connections",
                 ))
     return findings
+
+
+# -- trace-driven ingest (isotope-ingest/v1 artifacts) -----------------
+
+
+def lint_ingest(graph: ServiceGraph, report_doc: dict) -> List[Finding]:
+    """Lint a fitted topology against its own ingest report.
+
+    Host-side companions to the fit: VET-T027 checks the fitted qps
+    schedule's PEAK against the fitted station capacity (expected
+    visits computed by DP over the fitted DAG — an errored parent
+    skips its calls, so visits carry the (1 - errorRate) factor the
+    engine applies); VET-T028 surfaces services the fitter emitted
+    with zero observed samples (graph closure required the node, but
+    every knob on it is a default, not a measurement).
+    """
+    findings: List[Finding] = []
+    fit = report_doc.get("fit", {})
+    entry = report_doc.get("entry")
+    names = [s.name for s in graph.services]
+    idx = {n: i for i, n in enumerate(names)}
+    by_name = {s.name: s for s in graph.services}
+    if entry not in idx:
+        return findings
+
+    # expected visits per entry request: DFS accumulation over the
+    # (acyclic — the fitter broke cycles) fitted call graph
+    visits: Dict[str, float] = {n: 0.0 for n in names}
+    visits[entry] = 1.0
+    order: List[str] = []
+    seen = set()
+
+    def topo(n: str) -> None:
+        # iterative post-order: fitted graphs can be chain-deep
+        stack = [(n, False)]
+        while stack:
+            node, done = stack.pop()
+            if done:
+                order.append(node)
+                continue
+            if node in seen:
+                continue
+            seen.add(node)
+            stack.append((node, True))
+            for t in _call_targets(by_name[node].script):
+                if t in by_name and t not in seen:
+                    stack.append((t, False))
+
+    topo(entry)
+    for node in reversed(order):
+        v = visits[node]
+        if v <= 0:
+            continue
+        svc = by_name[node]
+        passthrough = 1.0 - float(svc.error_rate)
+        for cmd in svc.script:
+            subs = cmd if isinstance(cmd, ConcurrentCommand) else [cmd]
+            for sub in subs:
+                if isinstance(sub, RequestCommand) and (
+                    sub.service_name in visits
+                ):
+                    visits[sub.service_name] += (
+                        v * passthrough * sub.send_probability
+                    )
+
+    schedule = fit.get("qps_schedule") or []
+    cpu_time = float(fit.get("cpu_time_s") or 0.0)
+    if schedule and cpu_time > 0:
+        peak = max(schedule)
+        mu = 1.0 / cpu_time
+        for name in names:
+            v = visits.get(name, 0.0)
+            if v <= 0:
+                continue
+            reps = max(by_name[name].num_replicas, 1)
+            capacity = reps * mu / v
+            if peak > capacity:
+                findings.append(Finding(
+                    "VET-T027", SEV_WARN,
+                    f"window-peak {peak:g} qps x {v:.2f} expected "
+                    f"visits exceeds {name!r}'s fitted station "
+                    f"capacity {capacity:.0f} qps ({reps} replica(s) "
+                    f"at cpu_time {cpu_time * 1e6:.0f}us): the replay "
+                    "saturates where the source did not",
+                    path=f"services[{idx[name]}]",
+                ))
+
+    for row in fit.get("services", []):
+        samples = row.get("observed", {}).get("samples", 0.0)
+        name = row.get("name")
+        if name in idx and (samples or 0.0) <= 0:
+            findings.append(Finding(
+                "VET-T028", SEV_WARN,
+                f"service {name!r} was emitted with zero observed "
+                "samples: its error/timing knobs are fit defaults",
+                path=f"services[{idx[name]}]",
+            ))
+    return findings

@@ -4,7 +4,10 @@ The TPU-native counterpart of the reference's ``service-grapher`` cobra CLI
 (isotope/convert/cmd/root.go:25-28) plus the benchmark runner entry points.
 Subcommands are registered as they are built; ``kubernetes`` and ``graphviz``
 mirror the converter, ``generate`` the topology generators, ``simulate`` /
-``sweep`` the load-test drivers.
+``sweep`` the load-test drivers, and ``ingest`` the reverse path —
+observed telemetry (Prometheus, Envoy stats, CSV traces) fitted back
+into a runnable topology + schedule with an isotope-ingest/v1
+fidelity report.
 """
 from __future__ import annotations
 

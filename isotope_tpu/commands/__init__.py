@@ -3,11 +3,17 @@ from __future__ import annotations
 
 
 def register_all(sub) -> None:
-    from isotope_tpu.commands import convert_cmd, generate_cmd, report_cmd
+    from isotope_tpu.commands import (
+        convert_cmd,
+        generate_cmd,
+        ingest_cmd,
+        report_cmd,
+    )
 
     convert_cmd.register(sub)
     generate_cmd.register(sub)
     generate_cmd.register_pilot(sub)
+    ingest_cmd.register(sub)
     report_cmd.register(sub)
     # simulate_cmd/suite_cmd defer their jax-dependent imports into the
     # handlers (so --help stays instant); a jax-less environment gets a

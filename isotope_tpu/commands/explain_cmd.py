@@ -16,7 +16,11 @@ WITHOUT re-running anything:
 - search docs narrate the bracket: per rung, who was cut at what
   rank-channel value, how close the cut was, what the rung cost
   (engine traces, compile wall), and why the winner beat the
-  runner-up.
+  runner-up;
+- ``isotope-ingest/v1`` docs (``<label>.ingest.json``, the ``ingest``
+  subcommand's fit-fidelity report) render coverage accounting,
+  per-service fitted-vs-observed values, everything dropped with its
+  reason, and the self-closure verdict when present.
 
 Point it at a runner ``--out`` directory to explain every fleet in
 it, or at one artifact file.
@@ -37,7 +41,7 @@ def register(sub) -> None:
     e.add_argument(
         "path",
         help="a runner --out directory, a <label>.fleet-blame.json, "
-             "or a <label>.search.json",
+             "a <label>.search.json, or a <label>.ingest.json",
     )
     e.add_argument("--label", default=None,
                    help="only runs whose label contains this "
@@ -186,13 +190,23 @@ def _search_section(path: pathlib.Path) -> str:
     return f"== {label} ==\n" + _bracket_report(doc)
 
 
+def _ingest_section(path: pathlib.Path, top: int) -> str:
+    from isotope_tpu.ingest import report as ingest_report
+
+    doc = ingest_report.load_doc(str(path))
+    label = doc.get("label") or path.name.replace(".ingest.json", "")
+    return f"== {label} ==\n" + ingest_report.format_report(
+        doc, top=top
+    )
+
+
 def run_explain_cmd(args) -> int:
     # fleet-blame rendering lives with the explainer math; the import
     # is deferred so --help stays instant (commands/__init__ idiom)
     from isotope_tpu.metrics import fleetblame
 
     root = pathlib.Path(args.path)
-    fleet_docs, search_docs = [], []
+    fleet_docs, search_docs, ingest_docs = [], [], []
     if root.is_dir():
         match = (args.label or "")
         fleet_docs = sorted(
@@ -202,15 +216,20 @@ def run_explain_cmd(args) -> int:
         search_docs = sorted(
             p for p in root.glob("*.search.json") if match in p.name
         )
+        ingest_docs = sorted(
+            p for p in root.glob("*.ingest.json") if match in p.name
+        )
     elif root.name.endswith(".search.json"):
         search_docs = [root]
+    elif root.name.endswith(".ingest.json"):
+        ingest_docs = [root]
     else:
         fleet_docs = [root]
-    if not fleet_docs and not search_docs:
+    if not fleet_docs and not search_docs and not ingest_docs:
         print(
-            f"explain: no *.fleet-blame.json or *.search.json under "
-            f"{root} — run with --attribution over an --ensemble (or "
-            f"--search) first",
+            f"explain: no *.fleet-blame.json, *.search.json, or "
+            f"*.ingest.json under {root} — run with --attribution "
+            f"over an --ensemble (or --search / ingest) first",
             file=sys.stderr,
         )
         return 1
@@ -219,6 +238,7 @@ def run_explain_cmd(args) -> int:
         out = {
             "fleets": [_load(p) for p in fleet_docs],
             "searches": [_load(p) for p in search_docs],
+            "ingests": [_load(p) for p in ingest_docs],
         }
         json.dump(out, sys.stdout, indent=2)
         sys.stdout.write("\n")
@@ -229,5 +249,6 @@ def run_explain_cmd(args) -> int:
         for p in fleet_docs
     ]
     sections += [_search_section(p) for p in search_docs]
+    sections += [_ingest_section(p, args.top) for p in ingest_docs]
     print("\n\n".join(sections))
     return 0

@@ -61,6 +61,29 @@ def register(sub) -> None:
     real.add_argument("-o", "--output", default=None)
     real.set_defaults(func=run_realistic)
 
+    pl = kind.add_parser(
+        "powerlaw",
+        help="Zipf out-degree topology (production-shaped fan-out "
+             "skew; the ingest self-closure fixture family)",
+    )
+    pl.add_argument("--services", type=int, default=100)
+    pl.add_argument("--exponent", type=float, default=2.0)
+    pl.add_argument("--max-degree", type=int, default=None)
+    pl.add_argument("--request-size", type=int, default=128)
+    pl.add_argument("--response-size", type=int, default=128)
+    pl.add_argument("--num-replicas", type=int, default=1)
+    pl.add_argument("--seed", type=int, default=0)
+    pl.add_argument(
+        "--sleep-choices", default=None,
+        help='comma-separated per-service sleep pool, e.g. "1ms,4ms"',
+    )
+    pl.add_argument(
+        "--error-rate-choices", default=None,
+        help='comma-separated errorRate pool, e.g. "0%%,1%%,2%%"',
+    )
+    pl.add_argument("-o", "--output", default=None)
+    pl.set_defaults(func=run_powerlaw)
+
 
 def _emit(doc: dict, output) -> int:
     text = yaml.safe_dump(doc, default_flow_style=False, sort_keys=False)
@@ -99,6 +122,26 @@ def run_realistic(args) -> int:
     return _emit(
         generators.replicate_topology(doc, args.instances), args.output
     )
+
+
+def run_powerlaw(args) -> int:
+    doc = generators.powerlaw_topology(
+        num_services=args.services,
+        exponent=args.exponent,
+        max_degree=args.max_degree,
+        request_size=args.request_size,
+        response_size=args.response_size,
+        num_replicas=args.num_replicas,
+        seed=args.seed,
+        sleep_choices=(
+            args.sleep_choices.split(",") if args.sleep_choices else None
+        ),
+        error_rate_choices=(
+            args.error_rate_choices.split(",")
+            if args.error_rate_choices else None
+        ),
+    )
+    return _emit(doc, args.output)
 
 
 def register_pilot(sub) -> None:

@@ -52,10 +52,14 @@ class Sample:
 
 
 _LINE_RE = re.compile(
+    # the label body matches quoted strings as units, so a '}' INSIDE a
+    # label value (cluster="outbound|8080|{tag}") does not end the set;
+    # the timestamp accepts OpenMetrics float/exponent notation
+    # (1.7e12), not just the Prometheus text format's integer ms
     r'^(?P<name>[a-zA-Z_:][a-zA-Z0-9_:]*)'
-    r'(?:\{(?P<labels>[^}]*)\})?'
+    r'(?:\{(?P<labels>(?:[^"{}]|"(?:[^"\\]|\\.)*")*)\})?'
     r'\s+(?P<value>[^\s]+)'
-    r'(?:\s+(?P<ts>-?[0-9]+))?\s*$'
+    r'(?:\s+(?P<ts>[-+0-9.eE]+))?\s*$'
 )
 _LABEL_RE = re.compile(r'([a-zA-Z_][a-zA-Z0-9_]*)="((?:[^"\\]|\\.)*)"')
 # the whole label body must be well-formed pairs, not just contain some
@@ -77,27 +81,101 @@ def _unescape_label(v: str) -> str:
     )
 
 
+def _parse_sample_line(line: str) -> Sample:
+    """One non-comment exposition line -> Sample; raises ValueError with
+    the offending line on any malformation (shape, labels, value, ts)."""
+    m = _LINE_RE.match(line)
+    if not m:
+        raise ValueError(f"unparseable exposition line: {line!r}")
+    body = m.group("labels") or ""
+    if not _LABELS_BODY_RE.match(body):
+        raise ValueError(f"malformed labels in line: {line!r}")
+    labels = {
+        k: _unescape_label(v) for k, v in _LABEL_RE.findall(body)
+    }
+    try:
+        # float() accepts the OpenMetrics specials verbatim: NaN,
+        # +Inf/-Inf, and exponent notation
+        value = float(m.group("value"))
+    except ValueError:
+        raise ValueError(
+            f"unparseable sample value in line: {line!r}"
+        ) from None
+    ts = m.group("ts")
+    ts_ms: Optional[int] = None
+    if ts is not None:
+        try:
+            ts_ms = int(round(float(ts)))
+        except (ValueError, OverflowError):
+            raise ValueError(
+                f"unparseable timestamp in line: {line!r}"
+            ) from None
+    return Sample(m.group("name"), labels, value, timestamp_ms=ts_ms)
+
+
+@dataclasses.dataclass
+class ExpositionParse:
+    """A tolerant parse of one exposition: samples plus line accounting.
+
+    The counters partition the input exactly —
+    ``lines_total == lines_blank + lines_comment + lines_parsed +
+    len(malformed)`` — so consumers (the ingest coverage block) can
+    prove nothing was dropped silently.  Comment lines cover all ``#``
+    families: HELP/TYPE and the OpenMetrics UNIT/EOF markers.
+    """
+
+    samples: List[Sample]
+    lines_total: int = 0
+    lines_blank: int = 0
+    lines_comment: int = 0
+    lines_parsed: int = 0
+    malformed: List[Tuple[int, str]] = dataclasses.field(
+        default_factory=list
+    )
+
+    @property
+    def lines_malformed(self) -> int:
+        return len(self.malformed)
+
+
+def parse_exposition_tolerant(text: str) -> ExpositionParse:
+    """Parse a real-world scrape: malformed lines are COUNTED and
+    carried (1-based line numbers), never raised mid-file, so one bad
+    line cannot abort the ingest of an otherwise-usable exposition.
+    Tolerates OpenMetrics ``# EOF`` / ``# TYPE`` / ``# UNIT`` comment
+    families, ``NaN``/``+Inf`` values, and exponent-notation
+    timestamps."""
+    out = ExpositionParse(samples=[])
+    for lineno, raw in enumerate(text.splitlines(), 1):
+        out.lines_total += 1
+        line = raw.strip()
+        if not line:
+            out.lines_blank += 1
+            continue
+        if line.startswith("#"):
+            out.lines_comment += 1
+            continue
+        try:
+            out.samples.append(_parse_sample_line(line))
+        except ValueError:
+            out.malformed.append((lineno, raw))
+            continue
+        out.lines_parsed += 1
+    return out
+
+
 def parse_exposition(text: str) -> List[Sample]:
-    """Parse the Prometheus text format into flat samples."""
+    """Parse the Prometheus text format into flat samples.
+
+    Strict: the first malformed line raises ValueError (the simulator's
+    own expositions must be pristine).  Scrape ingestion uses
+    :func:`parse_exposition_tolerant`, which counts instead."""
     out: List[Sample] = []
     for line in text.splitlines():
         line = line.strip()
         if not line or line.startswith("#"):
             continue
-        m = _LINE_RE.match(line)
-        if not m:
-            raise ValueError(f"unparseable exposition line: {line!r}")
-        body = m.group("labels") or ""
-        if not _LABELS_BODY_RE.match(body):
-            raise ValueError(f"malformed labels in line: {line!r}")
-        labels = {
-            k: _unescape_label(v) for k, v in _LABEL_RE.findall(body)
-        }
-        ts = m.group("ts")
-        out.append(Sample(
-            m.group("name"), labels, float(m.group("value")),
-            timestamp_ms=int(ts) if ts is not None else None,
-        ))
+        out.append(_parse_sample_line(line))
     return out
 
 

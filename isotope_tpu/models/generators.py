@@ -200,6 +200,93 @@ def realistic_topology(
     }
 
 
+def powerlaw_topology(
+    num_services: int = 100,
+    exponent: float = 2.0,
+    max_degree: Optional[int] = None,
+    request_size: int = 128,
+    response_size: int = 128,
+    num_replicas: int = 1,
+    seed: int = 0,
+    name_prefix: str = "pl-",
+    sleep_choices: Optional[List[str]] = None,
+    error_rate_choices: Optional[List[str]] = None,
+) -> dict:
+    """Power-law (Zipf) out-degree topology: production-shaped skew.
+
+    Real service meshes are dominated by a few high-fan-out aggregators
+    over a long tail of leaf services (the Alibaba cluster-trace call
+    graphs follow a Zipf out-degree law); the BA archetypes skew the
+    IN-degree instead.  This generator draws an out-degree per service
+    from ``Zipf(exponent)`` (minus 1, so leaves are common), sorts the
+    sequence descending, and attaches BFS-style so the biggest hubs sit
+    near the entrypoint — a tree with exactly ``num_services - 1``
+    edges, children called SEQUENTIALLY (the ingest self-closure
+    fixture relies on sequential calls: concurrent groups are only
+    inferable from span traces, not from aggregate expositions).
+
+    ``sleep_choices`` / ``error_rate_choices`` draw one per-service
+    value each from the rng (e.g. ``["1ms", "4ms"]`` /
+    ``["0%", "2%"]``) so fitted-vs-source residuals exercise
+    heterogeneous services, not one global constant.
+    """
+    n = num_services
+    if n < 1:
+        raise ValueError("need at least one service")
+    rng = np.random.default_rng(seed)
+    if max_degree is None:
+        max_degree = max(n // 4, 1)
+    # Zipf support starts at 1; shift so degree 0 (a leaf) is common
+    degrees = np.minimum(rng.zipf(exponent, size=n) - 1, max_degree)
+    degrees = np.sort(degrees)[::-1]
+    # BFS attachment: hand out children (hub-first) until the n-1 edge
+    # budget is spent; later services keep degree 0 and stay leaves
+    children: List[List[int]] = [[] for _ in range(n)]
+    next_child = 1
+    for i in range(n):
+        want = int(degrees[i])
+        take = min(want, n - next_child)
+        if take <= 0:
+            continue
+        children[i] = list(range(next_child, next_child + take))
+        next_child += take
+    if next_child < n:
+        # degree draw too light for the budget: chain the remainder
+        # off the last placed service so the graph stays connected
+        for j in range(next_child, n):
+            children[j - 1].append(j)
+    services = []
+    for i in range(n):
+        svc: dict = {"name": f"{name_prefix}{i}"}
+        if i == 0:
+            svc["isEntrypoint"] = True
+        if error_rate_choices:
+            er = error_rate_choices[int(rng.integers(
+                len(error_rate_choices)
+            ))]
+            if er not in ("0", "0%", 0, 0.0):
+                svc["errorRate"] = er
+        script: List = []
+        if sleep_choices:
+            sl = sleep_choices[int(rng.integers(len(sleep_choices)))]
+            if sl not in ("0", "0s", None):
+                script.append({"sleep": sl})
+        script.extend(
+            {"call": f"{name_prefix}{c}"} for c in children[i]
+        )
+        if script:
+            svc["script"] = script
+        services.append(svc)
+    return {
+        "defaults": {
+            "requestSize": request_size,
+            "responseSize": response_size,
+            "numReplicas": num_replicas,
+        },
+        "services": services,
+    }
+
+
 def with_call_policy(
     doc: dict,
     timeout: Optional[str] = None,

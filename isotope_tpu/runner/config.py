@@ -220,6 +220,13 @@ class ExperimentConfig:
     # the population's jitter spec ("qps=0.2,cpu=0.1,error=0.3[,seed=K]")
     search_jitter: Optional[str] = None
     search_seed: int = 0
+    # trace-driven provenance (ingest/): the raw informational
+    # ``[ingest]`` table an `isotope-tpu ingest` run wrote into the
+    # TOML (label, entry, window count, qps band).  None for
+    # hand-written configs; when set, the runner stamps the rows so
+    # fitted-replay measurements are never compared against
+    # hand-written twins (run.py `_ingest` marker).
+    ingest: Optional[dict] = None
 
     def sim_params(self) -> SimParams:
         return SimParams(
@@ -530,6 +537,10 @@ def load_toml(path) -> ExperimentConfig:
         rollouts=bool(sim.get("rollouts", False)),
         **_ensemble_kwargs(sim),
         **_search_kwargs(doc.get("search", {})),
+        ingest=(
+            dict(doc["ingest"])
+            if isinstance(doc.get("ingest"), dict) else None
+        ),
     )
 
 

@@ -1767,6 +1767,16 @@ def run_experiment(
                         # comparing an lb row against a fifo twin
                         flat["_lb"] = True
                         telemetry.set_meta("lb", "on")
+                    if config.ingest:
+                        # the row replays FITTED telemetry, not a
+                        # hand-written topology — different
+                        # provenance; bench_regress keys on the
+                        # marker so an ingested replay is never
+                        # compared against a hand-written twin
+                        flat["_ingest"] = str(
+                            config.ingest.get("label", "ingested")
+                        )
+                        telemetry.set_meta("ingest", flat["_ingest"])
                     ens_doc = None
                     fb_doc = None
                     if ens_summary is not None:

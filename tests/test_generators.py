@@ -159,3 +159,60 @@ def test_replicate_materializes_defaults_script():
     call = by_name["ns1-root"].script[0]
     assert call.service_name == "ns1-leaf"
     assert int(by_name["ns0-leaf"].response_size) == 64
+
+
+def test_powerlaw_topology_decodes_connected_tree():
+    from isotope_tpu.compiler import compile_graph
+    from isotope_tpu.models.generators import powerlaw_topology
+    from isotope_tpu.models.graph import ServiceGraph
+
+    doc = powerlaw_topology(100, seed=0)
+    g = ServiceGraph.decode(doc)
+    assert len(g.services) == 100
+    # a tree: exactly n-1 edges, every service reachable from pl-0
+    compiled = compile_graph(g, entry="pl-0")
+    reached = {compiled.services.names[i]
+               for i in set(compiled.hop_service.tolist())}
+    assert len(reached) == 100
+    calls = sum(
+        sum(1 for c in (s.get("script") or []) if "call" in c)
+        for s in doc["services"]
+    )
+    assert calls == 99
+
+
+def test_powerlaw_topology_heavy_tail():
+    from isotope_tpu.models.generators import powerlaw_topology
+
+    doc = powerlaw_topology(200, exponent=2.0, seed=1)
+    degs = sorted(
+        (sum(1 for c in (s.get("script") or []) if "call" in c)
+         for s in doc["services"]),
+        reverse=True,
+    )
+    # hub-dominated: the top service out-fans the median by a lot,
+    # and most services are leaves (the Zipf shift makes 0 common)
+    assert degs[0] >= 10
+    assert degs[len(degs) // 2] == 0
+    assert sum(1 for d in degs if d == 0) > len(degs) // 2
+
+
+def test_powerlaw_topology_choice_lists_and_validation():
+    import pytest as _pytest
+
+    from isotope_tpu.models.generators import powerlaw_topology
+    from isotope_tpu.models.graph import ServiceGraph
+
+    doc = powerlaw_topology(
+        40, seed=2,
+        sleep_choices=["1ms", "4ms"],
+        error_rate_choices=["0%", "2%"],
+    )
+    g = ServiceGraph.decode(doc)
+    rates = {float(s.error_rate) for s in g.services}
+    assert rates == {0.0, 0.02}
+    sleeps = {c.seconds for s in g.services
+              for c in s.script if type(c).__name__ == "SleepCommand"}
+    assert sleeps <= {1e-3, 4e-3} and sleeps
+    with _pytest.raises(ValueError):
+        powerlaw_topology(0)

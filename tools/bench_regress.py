@@ -124,7 +124,10 @@ def _cases(doc: dict, prefer_best: bool = False) -> dict:
                        "_composed_worst_severity",
                        "_search_candidates", "_search_rungs",
                        "_search_traces", "_search_sequential_rate",
-                       "_search_speedup")):
+                       "_search_speedup",
+                       "_ingest_fit_s", "_ingest_services",
+                       "_ingest_edges", "_ingest_lines",
+                       "_ingest_qps")):
             # evidence / variance keys, not rates — "_composed" also
             # drops the svc1000_composed COVERAGE case's rate (its
             # telemetry degraded_to gate still applies)

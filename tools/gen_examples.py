@@ -78,6 +78,18 @@ def main() -> None:
             ),
         )
 
+    # Zipf out-degree skew with heterogeneous sleeps/error rates: the
+    # ingest self-closure fixture (tools/ingest_smoke.py simulates it,
+    # exports the exposition, and re-fits it back)
+    dump(
+        "realistic-powerlaw-100.yaml",
+        generators.powerlaw_topology(
+            num_services=100, exponent=2.0, seed=7,
+            sleep_choices=["0", "1ms", "2ms", "4ms", "8ms"],
+            error_rate_choices=["0%", "0%", "1%", "2%", "5%"],
+        ),
+    )
+
 
 if __name__ == "__main__":
     main()

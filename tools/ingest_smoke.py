@@ -1,0 +1,206 @@
+"""ingest-smoke: the trace-driven ingest self-closure loop end-to-end.
+
+PR 20's ground-truth pin: the ingest path is only trustworthy on real
+telemetry if it reconstructs telemetry whose generator we KNOW.  This
+smoke drives the full loop on the power-law fixture:
+
+1. **simulate** examples/topologies/realistic-powerlaw-100.yaml (Zipf
+   fan-out skew, heterogeneous per-service sleeps and error rates)
+   with the timeline recorder armed;
+2. **export** the two expositions a real scrape would see — the full
+   collector text (service_* families) and the timestamped timeline
+   text (timeline_* families);
+3. **ingest** both through the CLI path (readers -> fitters ->
+   artifacts), writing <label>.yaml / .toml / .ingest.json;
+4. **pin closure**: reconstructed per-service error share, mean
+   self-time, fan-out degree sequence, and windowed qps schedule
+   match the source within report.CLOSURE_TOLERANCES; coverage
+   counters partition every input line; the emitted TOML decodes
+   through runner.config.load_toml;
+5. **re-simulate** the fitted topology and check the replayed client
+   error share lands near the source run's, and that vet (lint_graph
+   + lint_ingest) reports no errors on the reconstruction.
+
+``make ingest-smoke`` wires it in next to the other smokes.
+"""
+from __future__ import annotations
+
+import json
+import os
+import pathlib
+import sys
+import tempfile
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+QPS = 50.0
+DURATION_S = 30.0
+SEED = 0
+
+
+def main() -> int:
+    import jax
+
+    from isotope_tpu.analysis.findings import SEV_ERROR
+    from isotope_tpu.analysis.topo_lint import lint_graph, lint_ingest
+    from isotope_tpu.compiler import compile_graph
+    from isotope_tpu.ingest import fitters, readers, report
+    from isotope_tpu.metrics import timeline as timeline_mod
+    from isotope_tpu.metrics.prometheus import MetricsCollector
+    from isotope_tpu.models.graph import ServiceGraph
+    from isotope_tpu.runner.config import load_toml
+    from isotope_tpu.sim import LoadModel, SimParams, Simulator
+
+    rc = 0
+
+    def check(name: str, ok: bool, detail: str) -> None:
+        nonlocal rc
+        status = "ok" if ok else "FAIL"
+        print(f"  {status:<5} {name}: {detail}")
+        if not ok:
+            rc = 1
+
+    root = pathlib.Path(__file__).parent.parent
+    fixture = root / "examples/topologies/realistic-powerlaw-100.yaml"
+    print(f"ingest-smoke: source {fixture.name}, {QPS:g} qps x "
+          f"{DURATION_S:g}s")
+
+    graph = ServiceGraph.from_yaml_file(fixture)
+    compiled = compile_graph(graph)
+    params = SimParams(timeline=True, timeline_window_s=1.0)
+    sim = Simulator(compiled, params)
+    collector = MetricsCollector(compiled)
+    load = LoadModel(kind="open", qps=QPS)
+    n = int(QPS * DURATION_S)
+    summary, tl = sim.run_timeline(
+        load, n, jax.random.PRNGKey(SEED),
+        collector=collector, window_s=1.0,
+    )
+    src_err_share = float(summary.error_count) / max(
+        float(summary.count), 1.0
+    )
+    full_text = collector.full_text(summary)
+    tl_text = timeline_mod.prometheus_text(compiled, tl)
+
+    with tempfile.TemporaryDirectory(prefix="ingest_smoke_") as td:
+        tdp = pathlib.Path(td)
+        (tdp / "full.prom").write_text(full_text)
+        (tdp / "timeline.prom").write_text(tl_text)
+
+        obs = readers.read_path(str(tdp / "full.prom"))
+        obs = readers.read_path(str(tdp / "timeline.prom"), obs=obs)
+        for cov in obs.inputs:
+            parts = (
+                cov.lines_blank + cov.lines_comment + cov.lines_parsed
+                + cov.lines_malformed
+            )
+            check(
+                f"coverage partition {pathlib.Path(cov.path).name}",
+                cov.lines_total == parts
+                and cov.samples_used + cov.samples_ignored
+                == cov.lines_parsed,
+                f"{cov.lines_total} lines = {cov.lines_blank} blank + "
+                f"{cov.lines_comment} comment + {cov.lines_parsed} "
+                f"parsed + {cov.lines_malformed} malformed",
+            )
+
+        fr = fitters.fit(obs, fitters.FitOptions(label="closure"))
+        doc = report.to_doc(fr, obs)
+        closure = report.closure_check(
+            graph, params.cpu_time_s, [QPS], fr
+        )
+        doc["closure"] = closure
+        for c in closure["checks"]:
+            detail = {
+                "error_share":
+                    f"worst |fit-src| {c.get('worst_abs_error', 0)}",
+                "self_time":
+                    f"mean rel {c.get('mean_rel_error', 0):.3f}, "
+                    f"{c.get('services_in_band_share', 0):.0%} of "
+                    f"{c.get('services_eligible', 0)} services in band",
+                "degree_sequence":
+                    f"{sum(c.get('fitted', []))} edges, "
+                    f"top degree {max(c.get('fitted') or [0])}",
+                "qps_schedule":
+                    f"mean rel {c.get('mean_rel_error', 0):.3f}, "
+                    f"{c.get('windows_in_band_share', 0):.0%} windows "
+                    "in band",
+            }.get(c["check"], "")
+            check(f"closure {c['check']}", bool(c["ok"]), detail)
+
+        # nothing silently dropped: the fixture is fully reachable
+        cov_block = doc["coverage"]
+        check(
+            "no unexplained drops",
+            not cov_block["services_dropped"]
+            and not cov_block["edges_dropped"],
+            f"{len(cov_block['services_dropped'])} services / "
+            f"{len(cov_block['edges_dropped'])} edges dropped",
+        )
+
+        # artifacts: YAML validates, TOML decodes, report round-trips
+        out_dir = tdp / "out"
+        out_dir.mkdir()
+        (out_dir / "closure.yaml").write_text(fr.graph.to_yaml())
+        (out_dir / "closure.toml").write_text(fr.toml_text)
+        cfg = load_toml(out_dir / "closure.toml")
+        check(
+            "emitted TOML decodes",
+            cfg.ingest is not None
+            and abs(cfg.qps[0] - fr.qps_mean) < 1e-6,
+            f"[client] qps {cfg.qps[0]:g}, [ingest] label "
+            f"{cfg.ingest and cfg.ingest.get('label')!r}",
+        )
+        report.save_doc(doc, str(out_dir / "closure.ingest.json"))
+        loaded = report.load_doc(str(out_dir / "closure.ingest.json"))
+        check(
+            "isotope-ingest/v1 round-trip",
+            loaded["fit"]["degree_sequence"]
+            == doc["fit"]["degree_sequence"],
+            f"{len(json.dumps(loaded))} bytes",
+        )
+
+        # vet: the reconstruction must lint clean (no errors, and the
+        # well-sampled fixture must not trip the ingest rules)
+        findings = lint_graph(fr.graph, entry=fr.entry)
+        findings += lint_ingest(fr.graph, loaded)
+        errors = [f for f in findings if f.severity == SEV_ERROR]
+        ingest_rules = [
+            f for f in findings if f.rule in ("VET-T027", "VET-T028")
+        ]
+        check(
+            "vet clean",
+            not errors and not ingest_rules,
+            f"{len(findings)} findings, {len(errors)} errors, "
+            f"{len(ingest_rules)} ingest-rule warnings",
+        )
+
+        # re-simulate the reconstruction: the replay must run and land
+        # near the source's client error share (self-closure, not just
+        # syntax)
+        re_compiled = compile_graph(fr.graph)
+        re_sim = Simulator(re_compiled, cfg.sim_params())
+        re_load = LoadModel(kind="open", qps=float(cfg.qps[0]))
+        re_summary, _ = re_sim.run_timeline(
+            re_load, n, jax.random.PRNGKey(SEED),
+            window_s=cfg.timeline_window_s,
+        )
+        re_err_share = float(re_summary.error_count) / max(
+            float(re_summary.count), 1.0
+        )
+        check(
+            "re-simulated error share",
+            abs(re_err_share - src_err_share) <= 0.03,
+            f"source {src_err_share:.4f} vs replay {re_err_share:.4f}",
+        )
+
+    print("ingest-smoke:", "PASS" if rc == 0 else "FAIL")
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())
